@@ -1,0 +1,24 @@
+"""celestia_tpu — a TPU-native data-availability framework.
+
+A from-scratch reimplementation of the capabilities of celestia-app (the
+Celestia DA blockchain state machine) designed TPU-first on JAX/XLA/Pallas:
+
+- ``celestia_tpu.appconsts``  — protocol constants (ref: pkg/appconsts)
+- ``celestia_tpu.namespace``  — 29-byte namespaces (ref: pkg/namespace)
+- ``celestia_tpu.shares``     — 512-byte share wire format (ref: pkg/shares)
+- ``celestia_tpu.blob``       — Blob / BlobTx envelope (ref: pkg/blob)
+- ``celestia_tpu.square``     — deterministic square construction (ref: pkg/square)
+- ``celestia_tpu.inclusion``  — blob share commitments (ref: pkg/inclusion)
+- ``celestia_tpu.da``         — EDS extension + DataAvailabilityHeader (ref: pkg/da)
+- ``celestia_tpu.wrapper``    — erasured namespaced merkle tree (ref: pkg/wrapper)
+- ``celestia_tpu.proof``      — share/tx inclusion proofs (ref: pkg/proof)
+- ``celestia_tpu.ops``        — the TPU compute path: GF(2^8) Reed-Solomon as
+  GF(2) bit-matmuls on the MXU, batched SHA-256 NMT hashing, Pallas kernels
+- ``celestia_tpu.parallel``   — device-mesh sharding of the extend+root pipeline
+- ``celestia_tpu.x``          — state-machine modules (blob/mint/upgrade/...)
+- ``celestia_tpu.app``        — application layer (ABCI-shaped pure functions)
+- ``celestia_tpu.user``       — client signer
+- ``celestia_tpu.native``     — C++ host runtime (CPU codec baseline, sidecar)
+"""
+
+__version__ = "0.1.0"

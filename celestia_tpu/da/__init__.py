@@ -1,0 +1,167 @@
+"""Extended data square + DataAvailabilityHeader.
+
+Reference semantics: pkg/da/data_availability_header.go and the rsmt2d
+extension layout (Q1 = row-extend Q0, Q2 = col-extend Q0, Q3 = row-extend
+Q2), with NMT row/column roots per pkg/wrapper/nmt_wrapper.go: leaves are
+namespace-prefixed shares, where Q0 cells keep their own namespace and all
+parity cells use the parity namespace.
+
+This module is the host-path implementation (numpy + hashlib). The TPU path
+(celestia_tpu.ops.extend_tpu) produces bit-identical results on-device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import numpy as np
+
+from celestia_tpu import namespace as ns
+from celestia_tpu.appconsts import (
+    DEFAULT_SQUARE_SIZE_UPPER_BOUND,
+    MIN_SQUARE_SIZE,
+    NAMESPACE_SIZE,
+    SHARE_SIZE,
+)
+from celestia_tpu.ops import gf256
+from celestia_tpu.ops.nmt_host import merkle_root, nmt_root
+
+PARITY_NS = ns.PARITY_SHARES_NAMESPACE.bytes
+
+MAX_EXTENDED_SQUARE_WIDTH = DEFAULT_SQUARE_SIZE_UPPER_BOUND * 2
+MIN_EXTENDED_SQUARE_WIDTH = MIN_SQUARE_SIZE * 2
+
+
+class ExtendedDataSquare:
+    """2k×2k erasure-extended share matrix, row-major uint8 (2k, 2k, 512)."""
+
+    def __init__(self, squares: np.ndarray, original_width: int):
+        self.data = squares
+        self.original_width = original_width
+
+    @property
+    def width(self) -> int:
+        return 2 * self.original_width
+
+    def row(self, i: int) -> list[bytes]:
+        return [self.data[i, j].tobytes() for j in range(self.width)]
+
+    def col(self, j: int) -> list[bytes]:
+        return [self.data[i, j].tobytes() for i in range(self.width)]
+
+    def flattened_shares(self) -> list[bytes]:
+        return [
+            self.data[i, j].tobytes()
+            for i in range(self.width)
+            for j in range(self.width)
+        ]
+
+    def row_roots(self) -> list[bytes]:
+        return [_axis_root(self.row(i), i, self.original_width) for i in range(self.width)]
+
+    def col_roots(self) -> list[bytes]:
+        return [_axis_root(self.col(j), j, self.original_width) for j in range(self.width)]
+
+
+def _axis_root(cells: list[bytes], axis_index: int, k: int) -> bytes:
+    """NMT root of one row/column, with the wrapper's quadrant namespace rule
+    (pkg/wrapper/nmt_wrapper.go:93-114): leaf = ns ‖ share where ns is the
+    share's own namespace in Q0 and the parity namespace otherwise."""
+    leaves = []
+    for share_index, cell in enumerate(cells):
+        if axis_index < k and share_index < k:
+            nid = cell[:NAMESPACE_SIZE]
+        else:
+            nid = PARITY_NS
+        leaves.append(nid + cell)
+    return nmt_root(leaves)
+
+
+def extend_shares(shares: list[bytes] | np.ndarray) -> ExtendedDataSquare:
+    """shares: k*k row-major 512-byte shares. ref: pkg/da/data_availability_header.go:65"""
+    if isinstance(shares, np.ndarray):
+        if shares.dtype != np.uint8:
+            raise ValueError(f"shares array must be uint8, got {shares.dtype}")
+        flat = shares.reshape(-1, SHARE_SIZE)
+        count = flat.shape[0]
+    else:
+        count = len(shares)
+        flat = np.frombuffer(b"".join(shares), dtype=np.uint8).reshape(count, -1)
+    k = int(round(count**0.5))
+    if count == 0 or k * k != count or (k & (k - 1)) != 0:
+        raise ValueError(f"number of shares must be a square power of two, got {count}")
+    if k > DEFAULT_SQUARE_SIZE_UPPER_BOUND:
+        raise ValueError(f"square size {k} exceeds max {DEFAULT_SQUARE_SIZE_UPPER_BOUND}")
+    if flat.shape[1] != SHARE_SIZE:
+        raise ValueError(f"shares must be {SHARE_SIZE} bytes")
+
+    q0 = flat.reshape(k, k, SHARE_SIZE)
+    eds = np.zeros((2 * k, 2 * k, SHARE_SIZE), dtype=np.uint8)
+    eds[:k, :k] = q0
+    # Q1: extend each original row. leopard_encode is row-batched: shape
+    # (k shards, size); here the "shards" axis is the column index.
+    for i in range(k):
+        eds[i, k:] = gf256.leopard_encode(q0[i])
+    # Q2: extend each original column.
+    for j in range(k):
+        eds[k:, j] = gf256.leopard_encode(q0[:, j])
+    # Q3: extend the Q2 rows (rsmt2d extends the extended rows horizontally).
+    for i in range(k, 2 * k):
+        eds[i, k:] = gf256.leopard_encode(eds[i, :k])
+    return ExtendedDataSquare(eds, k)
+
+
+@dataclasses.dataclass
+class DataAvailabilityHeader:
+    row_roots: list[bytes]
+    column_roots: list[bytes]
+    _hash: bytes | None = dataclasses.field(default=None, compare=False, repr=False)
+
+    def hash(self) -> bytes:
+        """Merkle root over (row_roots ‖ column_roots).
+        ref: pkg/da/data_availability_header.go:92-108"""
+        if self._hash is None:
+            self._hash = merkle_root(list(self.row_roots) + list(self.column_roots))
+        return self._hash
+
+    def validate_basic(self) -> None:
+        if len(self.column_roots) != len(self.row_roots):
+            raise ValueError(
+                "unequal number of row and column roots: "
+                f"row {len(self.row_roots)} col {len(self.column_roots)}"
+            )
+        if len(self.row_roots) < MIN_EXTENDED_SQUARE_WIDTH:
+            raise ValueError(
+                f"minimum valid DataAvailabilityHeader has at least "
+                f"{MIN_EXTENDED_SQUARE_WIDTH} row roots"
+            )
+        if len(self.row_roots) > MAX_EXTENDED_SQUARE_WIDTH:
+            raise ValueError(
+                f"maximum valid DataAvailabilityHeader has at most "
+                f"{MAX_EXTENDED_SQUARE_WIDTH} row roots"
+            )
+        if len(self.hash()) != 32:
+            raise ValueError(f"wrong hash: expected 32 bytes, got {len(self.hash())}")
+
+    def square_size(self) -> int:
+        return len(self.row_roots) // 2
+
+
+def new_data_availability_header(eds: ExtendedDataSquare) -> DataAvailabilityHeader:
+    dah = DataAvailabilityHeader(eds.row_roots(), eds.col_roots())
+    dah.hash()
+    return dah
+
+
+def min_data_availability_header() -> DataAvailabilityHeader:
+    """DAH of a block with one tail-padding share.
+    ref: pkg/da/data_availability_header.go:179"""
+    from celestia_tpu.shares import tail_padding_share
+
+    eds = extend_shares([tail_padding_share().to_bytes()])
+    return new_data_availability_header(eds)
+
+
+def nil_dah_hash() -> bytes:
+    return hashlib.sha256(b"").digest()

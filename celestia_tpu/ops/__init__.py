@@ -1,0 +1,1 @@
+"""TPU compute path: GF(2^8) Reed-Solomon, SHA-256, NMT kernels."""

@@ -1,0 +1,108 @@
+"""Host (CPU, hashlib) Namespaced Merkle Tree — the correctness reference.
+
+Reimplements the nmt v0.20.0 hasher semantics used by the reference
+(pkg/wrapper/nmt_wrapper.go:55-62 configures NamespaceIDSize=29,
+IgnoreMaxNamespace=true, SHA-256):
+
+- node digest format: minNs(29) ‖ maxNs(29) ‖ sha256-digest(32)  (90 bytes)
+- leaf: min=max=leaf namespace; digest = sha256(0x00 ‖ ns ‖ data)
+- inner: minNs = left.minNs; maxNs = right.maxNs, EXCEPT with
+  IgnoreMaxNamespace when the right child's minNs is the maximal (parity)
+  namespace, in which case maxNs = left.maxNs.
+- tree shape: RFC-6962 split (largest power of two strictly less than n).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from celestia_tpu import namespace as ns
+from celestia_tpu.appconsts import NAMESPACE_SIZE
+
+LEAF_PREFIX = b"\x00"
+NODE_PREFIX = b"\x01"
+PARITY_NS_BYTES = ns.PARITY_SHARES_NAMESPACE.bytes
+NMT_ROOT_SIZE = 2 * NAMESPACE_SIZE + 32
+
+
+def hash_leaf(ndata: bytes) -> bytes:
+    """ndata = namespace(29) ‖ data. Returns 90-byte namespaced digest."""
+    nid = ndata[:NAMESPACE_SIZE]
+    digest = hashlib.sha256(LEAF_PREFIX + ndata).digest()
+    return nid + nid + digest
+
+def hash_node(left: bytes, right: bytes, ignore_max_ns: bool = True) -> bytes:
+    left_min, left_max = left[:NAMESPACE_SIZE], left[NAMESPACE_SIZE : 2 * NAMESPACE_SIZE]
+    right_min, right_max = (
+        right[:NAMESPACE_SIZE],
+        right[NAMESPACE_SIZE : 2 * NAMESPACE_SIZE],
+    )
+    min_ns = left_min
+    max_ns = right_max
+    if ignore_max_ns and right_min == PARITY_NS_BYTES:
+        max_ns = left_max
+    digest = hashlib.sha256(NODE_PREFIX + left + right).digest()
+    return min_ns + max_ns + digest
+
+
+def _split_point(n: int) -> int:
+    """Largest power of two strictly less than n (RFC 6962)."""
+    k = 1
+    while k * 2 < n:
+        k *= 2
+    return k
+
+
+def nmt_root(leaves: list[bytes]) -> bytes:
+    """Root over namespaced leaves (each = 29-byte ns ‖ data)."""
+    n = len(leaves)
+    if n == 0:
+        return bytes(2 * NAMESPACE_SIZE) + hashlib.sha256(b"").digest()
+    if n == 1:
+        return hash_leaf(leaves[0])
+    k = _split_point(n)
+    return hash_node(nmt_root(leaves[:k]), nmt_root(leaves[k:]))
+
+
+def nmt_inner_nodes(leaves: list[bytes]) -> list[bytes]:
+    """All node digests of the tree in a list; [0] is the root. Used by the
+    subtree-root cache (pkg/inclusion/nmt_caching.go analogue)."""
+    nodes: list[bytes] = []
+
+    def rec(lo: int, hi: int) -> bytes:
+        if hi - lo == 1:
+            h = hash_leaf(leaves[lo])
+        else:
+            k = _split_point(hi - lo)
+            left = rec(lo, lo + k)
+            right = rec(lo + k, hi)
+            h = hash_node(left, right)
+        nodes.append(h)
+        return h
+
+    root = rec(0, len(leaves))
+    nodes.reverse()
+    assert nodes[0] == root
+    return nodes
+
+
+# --- RFC-6962 plain merkle (tendermint crypto/merkle) for the DAH hash ---
+
+
+def merkle_leaf_hash(leaf: bytes) -> bytes:
+    return hashlib.sha256(LEAF_PREFIX + leaf).digest()
+
+
+def merkle_inner_hash(left: bytes, right: bytes) -> bytes:
+    return hashlib.sha256(NODE_PREFIX + left + right).digest()
+
+
+def merkle_root(items: list[bytes]) -> bytes:
+    """tendermint merkle.HashFromByteSlices (RFC 6962, no leaf duplication)."""
+    n = len(items)
+    if n == 0:
+        return hashlib.sha256(b"").digest()
+    if n == 1:
+        return merkle_leaf_hash(items[0])
+    k = _split_point(n)
+    return merkle_inner_hash(merkle_root(items[:k]), merkle_root(items[k:]))
