@@ -1,0 +1,246 @@
+"""Blob type + BlobTx / IndexWrapper envelopes.
+
+Wire-compatible with the reference protobuf messages
+(proto/celestia/core/v1/blob/blob.proto; envelope logic pkg/blob/blob.go:
+TypeId markers "BLOB" / "INDX" distinguish the envelopes from ordinary
+sdk txs). A minimal hand-rolled proto3 codec keeps the package
+dependency-light; the messages involved use only bytes / uint32 fields.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from celestia_tpu import appconsts
+from celestia_tpu import namespace as ns_pkg
+from celestia_tpu.namespace import Namespace
+
+PROTO_BLOB_TX_TYPE_ID = "BLOB"
+PROTO_INDEX_WRAPPER_TYPE_ID = "INDX"
+
+SUPPORTED_SHARE_VERSIONS = (appconsts.SHARE_VERSION_ZERO,)
+
+
+# --- minimal proto3 wire codec (varint + length-delimited only) ---
+
+
+def uvarint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def read_uvarint(data: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated varint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def _field_bytes(tag: int, payload: bytes) -> bytes:
+    if not payload:
+        return b""
+    return uvarint(tag << 3 | 2) + uvarint(len(payload)) + payload
+
+
+def _field_uint(tag: int, value: int) -> bytes:
+    if value == 0:
+        return b""
+    return uvarint(tag << 3 | 0) + uvarint(value)
+
+
+def _parse_fields(data: bytes):
+    """Yield (tag, wire_type, value) triples; value is int or bytes."""
+    pos = 0
+    while pos < len(data):
+        key, pos = read_uvarint(data, pos)
+        tag, wt = key >> 3, key & 7
+        if wt == 0:
+            val, pos = read_uvarint(data, pos)
+        elif wt == 2:
+            ln, pos = read_uvarint(data, pos)
+            if pos + ln > len(data):
+                raise ValueError("truncated field")
+            val = data[pos : pos + ln]
+            pos += ln
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield tag, wt, val
+
+
+# --- Blob ---
+
+
+@dataclasses.dataclass
+class Blob:
+    namespace_id: bytes  # 28 bytes
+    data: bytes
+    share_version: int
+    namespace_version: int
+
+    def namespace(self) -> Namespace:
+        return ns_pkg.Namespace(self.namespace_version, self.namespace_id)
+
+    def validate(self) -> None:
+        """ref: pkg/blob/blob.go Blob.Validate"""
+        if len(self.namespace_id) != ns_pkg.NAMESPACE_ID_SIZE:
+            raise ValueError(
+                f"namespace id must be {ns_pkg.NAMESPACE_ID_SIZE} bytes"
+            )
+        if self.share_version > appconsts.MAX_SHARE_VERSION:
+            raise ValueError("share version can not be greater than MaxShareVersion")
+        if self.namespace_version > ns_pkg.NAMESPACE_VERSION_MAX:
+            raise ValueError("namespace version can not be greater than MaxNamespaceVersion")
+        if len(self.data) == 0:
+            raise ValueError("blob data can not be empty")
+        # namespace must be valid for its version (e.g. v0 zero-prefix)
+        ns_pkg.new_namespace(self.namespace_version, self.namespace_id)
+
+    def marshal(self) -> bytes:
+        return (
+            _field_bytes(1, self.namespace_id)
+            + _field_bytes(2, self.data)
+            + _field_uint(3, self.share_version)
+            + _field_uint(4, self.namespace_version)
+        )
+
+
+def new_blob(namespace: Namespace, data: bytes, share_version: int = 0) -> Blob:
+    b = Blob(
+        namespace_id=namespace.id,
+        data=bytes(data),
+        share_version=share_version,
+        namespace_version=namespace.version,
+    )
+    b.validate()
+    return b
+
+
+def _require_wt(wt: int, expected: int, tag: int) -> None:
+    # gogoproto rejects wire-type-confused fields; silently coercing them
+    # would be consensus-divergent (and bytes(int) is an allocation DoS).
+    if wt != expected:
+        raise ValueError(f"wrong wire type {wt} for field {tag}")
+
+
+def unmarshal_blob(raw: bytes) -> Blob:
+    b = Blob(b"", b"", 0, 0)
+    for tag, wt, val in _parse_fields(raw):
+        if tag == 1:
+            _require_wt(wt, 2, tag)
+            b.namespace_id = bytes(val)
+        elif tag == 2:
+            _require_wt(wt, 2, tag)
+            b.data = bytes(val)
+        elif tag == 3:
+            _require_wt(wt, 0, tag)
+            b.share_version = int(val)
+        elif tag == 4:
+            _require_wt(wt, 0, tag)
+            b.namespace_version = int(val)
+    return b
+
+
+def sort_blobs(blobs: list[Blob]) -> None:
+    """Stable in-place sort by full namespace bytes. ref: pkg/blob/blob.go:92"""
+    blobs.sort(key=lambda b: b.namespace().bytes)
+
+
+# --- BlobTx envelope ---
+
+
+@dataclasses.dataclass
+class BlobTx:
+    tx: bytes
+    blobs: list[Blob]
+
+
+def marshal_blob_tx(tx: bytes, blobs: list[Blob]) -> bytes:
+    """ref: pkg/blob/blob.go:83 MarshalBlobTx"""
+    out = _field_bytes(1, tx)
+    for b in blobs:
+        out += _field_bytes(2, b.marshal())
+    out += _field_bytes(3, PROTO_BLOB_TX_TYPE_ID.encode())
+    return out
+
+
+def unmarshal_blob_tx(raw: bytes) -> tuple[BlobTx | None, bool]:
+    """Returns (blob_tx, is_blob_tx). ref: pkg/blob/blob.go:58"""
+    try:
+        tx = b""
+        blobs: list[Blob] = []
+        type_id = ""
+        for tag, wt, val in _parse_fields(raw):
+            if tag == 1:
+                _require_wt(wt, 2, tag)
+                tx = bytes(val)
+            elif tag == 2:
+                _require_wt(wt, 2, tag)
+                blobs.append(unmarshal_blob(bytes(val)))
+            elif tag == 3:
+                _require_wt(wt, 2, tag)
+                type_id = bytes(val).decode()
+        if type_id != PROTO_BLOB_TX_TYPE_ID:
+            return None, False
+        return BlobTx(tx=tx, blobs=blobs), True
+    except (ValueError, UnicodeDecodeError):
+        return None, False
+
+
+# --- IndexWrapper (celestia-core's wrapped PFB tx carrying share indexes) ---
+
+
+@dataclasses.dataclass
+class IndexWrapper:
+    tx: bytes
+    share_indexes: list[int]
+
+
+def marshal_index_wrapper(tx: bytes, share_indexes: list[int]) -> bytes:
+    packed = b"".join(uvarint(i) for i in share_indexes)
+    return (
+        _field_bytes(1, tx)
+        + _field_bytes(2, packed)
+        + _field_bytes(3, PROTO_INDEX_WRAPPER_TYPE_ID.encode())
+    )
+
+
+def unmarshal_index_wrapper(raw: bytes) -> tuple[IndexWrapper | None, bool]:
+    try:
+        tx = b""
+        indexes: list[int] = []
+        type_id = ""
+        for tag, wt, val in _parse_fields(raw):
+            if tag == 1:
+                _require_wt(wt, 2, tag)
+                tx = bytes(val)
+            elif tag == 2 and wt == 2:
+                pos = 0
+                while pos < len(val):
+                    idx, pos = read_uvarint(val, pos)
+                    indexes.append(idx)
+            elif tag == 2 and wt == 0:
+                indexes.append(int(val))
+            elif tag == 3:
+                _require_wt(wt, 2, tag)
+                type_id = bytes(val).decode()
+        if type_id != PROTO_INDEX_WRAPPER_TYPE_ID:
+            return None, False
+        return IndexWrapper(tx=tx, share_indexes=indexes), True
+    except (ValueError, UnicodeDecodeError):
+        return None, False

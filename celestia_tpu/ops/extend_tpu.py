@@ -1,0 +1,154 @@
+"""The fused TPU hot path: share square -> EDS -> NMT roots -> DAH hash.
+
+This is the flagship pipeline of the framework — the TPU-native equivalent
+of the reference's ExtendBlock chain (app/extend_block.go:14 ->
+pkg/da/data_availability_header.go:44,65 -> rsmt2d + pkg/wrapper NMTs),
+jitted end-to-end so XLA fuses RS encode, leaf construction, SHA-256 and
+the tree reductions without host round-trips.
+
+Structure exploited on-device:
+
+- Both tree families hash the *same* leaves: the wrapper's namespace rule
+  (pkg/wrapper/nmt_wrapper.go:93-114 — Q0 cells keep their own namespace,
+  parity cells use the parity namespace) depends only on the cell, not on
+  whether it is read row-wise or column-wise. So leaf digests are computed
+  once over the (2k, 2k) grid and reduced along axis 1 (row trees) and
+  axis 0 (column trees).
+- Axis length 2k is a power of two, so the RFC-6962 split (largest power
+  of two < n) degenerates to a perfectly balanced binary tree:
+  level-synchronous pairwise reduction with static shapes at every level.
+- Namespace min/max propagation follows nmt v0.20 with IgnoreMaxNamespace:
+  min = left.min; max = left.max if right.min == parity else right.max.
+  (For the honest squares this path computes — sorted namespaces, parity
+  in Q1/Q2/Q3 — this is exactly the general hasher's result.)
+
+Outputs are byte-identical to celestia_tpu.da (host) and therefore to the
+reference DAH.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from celestia_tpu import namespace as ns
+from celestia_tpu.appconsts import NAMESPACE_SIZE, SHARE_SIZE
+from celestia_tpu.ops import rs_tpu
+from celestia_tpu.ops.sha256_jax import sha256_fixed
+
+_PARITY_NS = np.frombuffer(ns.PARITY_SHARES_NAMESPACE.bytes, dtype=np.uint8)
+_LEAF_PREFIX = np.array([0], dtype=np.uint8)
+_NODE_PREFIX = np.array([1], dtype=np.uint8)
+NMT_NODE_SIZE = 2 * NAMESPACE_SIZE + 32  # 90
+
+
+def _bcast_const(const: np.ndarray, batch_shape: tuple[int, ...]) -> jnp.ndarray:
+    return jnp.broadcast_to(jnp.asarray(const), (*batch_shape, const.shape[0]))
+
+
+def nmt_leaf_nodes(leaf_ns: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
+    """(..., 29) ns + (..., D) data -> (..., 90) NMT leaf nodes."""
+    batch = data.shape[:-1]
+    msg = jnp.concatenate([_bcast_const(_LEAF_PREFIX, batch), leaf_ns, data], axis=-1)
+    digest = sha256_fixed(msg)
+    return jnp.concatenate([leaf_ns, leaf_ns, digest], axis=-1)
+
+
+def nmt_reduce_axis(nodes: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise-reduce (..., n, 90) NMT nodes along axis -2 to roots (..., 90).
+
+    n must be a power of two (always true for EDS axes).
+    """
+    parity = jnp.asarray(_PARITY_NS)
+    while nodes.shape[-2] > 1:
+        left = nodes[..., 0::2, :]
+        right = nodes[..., 1::2, :]
+        batch = left.shape[:-1]
+        msg = jnp.concatenate([_bcast_const(_NODE_PREFIX, batch), left, right], axis=-1)
+        digest = sha256_fixed(msg)
+        min_ns = left[..., :NAMESPACE_SIZE]
+        right_is_parity = jnp.all(
+            right[..., :NAMESPACE_SIZE] == parity, axis=-1, keepdims=True
+        )
+        max_ns = jnp.where(
+            right_is_parity,
+            left[..., NAMESPACE_SIZE : 2 * NAMESPACE_SIZE],
+            right[..., NAMESPACE_SIZE : 2 * NAMESPACE_SIZE],
+        )
+        nodes = jnp.concatenate([min_ns, max_ns, digest], axis=-1)
+    return nodes[..., 0, :]
+
+
+def merkle_root_pow2(items: jnp.ndarray) -> jnp.ndarray:
+    """RFC-6962 merkle root of (..., n, D) items, n a power of two.
+
+    Matches tendermint merkle.HashFromByteSlices for power-of-two counts
+    (pkg/da/data_availability_header.go:92-108 hashes 4k axis roots).
+    """
+    batch = items.shape[:-1]
+    leaves = sha256_fixed(
+        jnp.concatenate([_bcast_const(_LEAF_PREFIX, batch), items], axis=-1)
+    )
+    while leaves.shape[-2] > 1:
+        left = leaves[..., 0::2, :]
+        right = leaves[..., 1::2, :]
+        msg = jnp.concatenate(
+            [_bcast_const(_NODE_PREFIX, left.shape[:-1]), left, right], axis=-1
+        )
+        leaves = sha256_fixed(msg)
+    return leaves[..., 0, :]
+
+
+def _leaf_namespaces(q0_ns: jnp.ndarray, k: int) -> jnp.ndarray:
+    """(k, k, 29) Q0 namespaces -> (2k, 2k, 29) per-cell leaf namespaces."""
+    parity = jnp.broadcast_to(jnp.asarray(_PARITY_NS), (k, k, NAMESPACE_SIZE))
+    top = jnp.concatenate([q0_ns, parity], axis=1)
+    bottom = jnp.concatenate([parity, parity], axis=1)
+    return jnp.concatenate([top, bottom], axis=0)
+
+
+def extend_and_root(
+    shares: jnp.ndarray, m2: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(k, k, 512) uint8 -> (eds (2k,2k,512), row_roots (2k,90),
+    col_roots (2k,90), dah_hash (32,)). m2 = rs_tpu.encode_bit_matrix(k)."""
+    k = shares.shape[0]
+    eds = rs_tpu.extend_square(shares, m2)
+    leaf_ns = _leaf_namespaces(shares[..., :NAMESPACE_SIZE], k)
+    leaf_nodes = nmt_leaf_nodes(leaf_ns, eds)  # (2k, 2k, 90)
+    row_roots = nmt_reduce_axis(leaf_nodes)  # reduce axis 1 -> (2k, 90)
+    col_roots = nmt_reduce_axis(jnp.swapaxes(leaf_nodes, 0, 1))
+    dah = merkle_root_pow2(jnp.concatenate([row_roots, col_roots], axis=0))
+    return eds, row_roots, col_roots, dah
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_for_k(k: int):
+    m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
+
+    @jax.jit
+    def run(shares):
+        return extend_and_root(shares, m2)
+
+    return run
+
+
+def extend_and_root_batched(shares: jnp.ndarray, m2: jnp.ndarray):
+    """(B, k, k, 512) -> batched (eds, row_roots, col_roots, dah).
+
+    The multi-block form: a node that is catching up (state sync / block
+    replay) or serving many proposals extends B squares at once; B is the
+    data-parallel axis when sharded over a mesh (see __graft_entry__).
+    """
+    return jax.vmap(lambda s: extend_and_root(s, m2))(shares)
+
+
+def extend_and_root_device(shares: np.ndarray):
+    """Host entry: (k,k,512) uint8 numpy -> numpy (eds, row_roots, col_roots, dah)."""
+    k = shares.shape[0]
+    fn = _jitted_for_k(k)
+    eds, rows, cols, dah = fn(jnp.asarray(shares))
+    return (np.asarray(eds), np.asarray(rows), np.asarray(cols), np.asarray(dah))

@@ -1,0 +1,101 @@
+"""Reed-Solomon extension on TPU as GF(2) bit-matmuls on the MXU.
+
+Design: the Leopard code (the reference codec, selected at
+pkg/appconsts/global_consts.go:92) is a *linear* map over GF(2^8): parity
+shard j is a fixed GF(256)-linear combination of the k data shards,
+parity_j = sum_i M[j,i] * data_i, with M = ops.gf256.encode_matrix(k).
+Multiplication by a GF(256) constant is itself linear over GF(2)^8, so the
+whole encode expands to a single (8k x 8k) 0/1 matrix over GF(2):
+
+    parity_bits = M2 @ data_bits  (mod 2)
+
+That is an int8 matmul with an int32 accumulator followed by `& 1` — the
+shape of computation the TPU's MXU was built for, and it replaces the
+reference's sequential FFT butterflies (table-lookup-heavy, gather-bound on
+TPU) with one dense contraction batched over all rows/columns of the square
+at once. Bit-exactness is inherited from encode_matrix, which is derived
+from the byte-parity-verified host Leopard implementation.
+
+Layout: a byte is unpacked LSB-first to 8 bit-lanes; contraction index
+q = 8*shard + bit. M2 block (j,i) is the 8x8 companion matrix of
+multiply-by-M[j,i]: M2[8j+r, 8i+c] = bit_r(M[j,i] * x^c).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from celestia_tpu.ops import gf256
+
+
+@functools.lru_cache(maxsize=16)
+def encode_bit_matrix(k: int) -> np.ndarray:
+    """(8k, 8k) uint8 0/1 matrix M2 with parity_bits = M2 @ data_bits mod 2."""
+    m = gf256.encode_matrix(k)  # (k, k) GF(256)
+    mul = gf256.mul_table()
+    powers = (1 << np.arange(8)).astype(np.uint8)  # x^c as bytes
+    # prod[j, i, c] = M[j,i] * x^c  (byte)
+    prod = mul[m[:, :, None], powers[None, None, :]]
+    # bits[j, i, c, r] = bit r of prod
+    bits = (prod[..., None] >> np.arange(8)) & 1
+    # M2[8j+r, 8i+c]
+    m2 = bits.transpose(0, 3, 1, 2).reshape(8 * k, 8 * k)
+    return m2.astype(np.uint8)
+
+
+def unpack_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """uint8 (..., S, B) -> int8 bit-lanes (..., 8S, B), LSB-first per byte.
+
+    S is the shard axis (contraction side), B the byte-position axis.
+    """
+    bits = (x[..., :, None, :] >> jnp.arange(8, dtype=jnp.uint8)[:, None]) & 1
+    return bits.reshape(*x.shape[:-2], 8 * x.shape[-2], x.shape[-1]).astype(jnp.int8)
+
+
+def pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """int32/int8 0/1 (..., 8S, B) -> uint8 (..., S, B), LSB-first per byte."""
+    s8 = bits.shape[-2]
+    b = bits.reshape(*bits.shape[:-2], s8 // 8, 8, bits.shape[-1]).astype(jnp.uint8)
+    weights = (1 << jnp.arange(8, dtype=jnp.uint8))[:, None]
+    return (b * weights).sum(axis=-2).astype(jnp.uint8)
+
+
+def rs_encode_rows(data: jnp.ndarray, m2: jnp.ndarray) -> jnp.ndarray:
+    """Batched Leopard encode: (..., k, B) uint8 -> (..., k, B) parity.
+
+    The second-to-last axis is the shard axis (the k inputs of the code);
+    every leading axis and the trailing byte axis are independent lanes.
+    m2 = encode_bit_matrix(k) as a device array.
+    """
+    bits = unpack_bits(data)  # (..., 8k, B) int8
+    acc = jax.lax.dot_general(
+        m2.astype(jnp.int8),
+        bits,
+        dimension_numbers=(((1,), (bits.ndim - 2,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    # dot_general puts m2's free axis first: (8k, ..., B) -> restore batch axes.
+    acc = jnp.moveaxis(acc, 0, -2)
+    return pack_bits(acc & 1)
+
+
+def extend_square(q0: jnp.ndarray, m2: jnp.ndarray) -> jnp.ndarray:
+    """(k, k, 512) uint8 original square -> (2k, 2k, 512) EDS.
+
+    Quadrant layout per rsmt2d (see celestia_tpu.da): Q1 = row-extend Q0,
+    Q2 = column-extend Q0, Q3 = row-extend Q2.
+    """
+    # q0 is (rows, cols, B): the column index IS the shard axis for row
+    # extension, so the layout already matches rs_encode_rows.
+    q1 = rs_encode_rows(q0, m2)
+    # Column extension: shard axis = rows; swap, encode, swap back.
+    q2 = jnp.swapaxes(rs_encode_rows(jnp.swapaxes(q0, 0, 1), m2), 0, 1)
+    # Q3: rsmt2d extends the extended (Q2) rows horizontally.
+    q3 = rs_encode_rows(q2, m2)
+    top = jnp.concatenate([q0, q1], axis=1)
+    bottom = jnp.concatenate([q2, q3], axis=1)
+    return jnp.concatenate([top, bottom], axis=0)

@@ -135,6 +135,10 @@ class Builder:
             data += bytes(appconsts.COMPACT_SHARE_RESERVED_BYTES)
         self.raw_share_data = data
 
+    def import_raw_share(self, raw: bytes) -> "Builder":
+        self.raw_share_data = bytearray(raw)
+        return self
+
     def available_bytes(self) -> int:
         return appconsts.SHARE_SIZE - len(self.raw_share_data)
 

@@ -1,0 +1,317 @@
+"""Compact & sparse share splitters, worst-case counter, and top-level
+splitting helpers.
+
+Reference semantics: pkg/shares/split_compact_shares.go (length-delimited
+units, reserved-byte pointers, retroactive sequence length),
+split_sparse_shares.go (blob sequences), counter.go (worst-case counting
+with revert), share_splitting.go (SplitTxs / SplitBlobs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from celestia_tpu import appconsts
+from celestia_tpu import blob as blob_pkg
+from celestia_tpu import namespace as ns_pkg
+from celestia_tpu.namespace import Namespace
+
+from . import (
+    Builder,
+    Share,
+    namespace_padding_shares,
+)
+
+
+from celestia_tpu.blob import read_uvarint, uvarint  # noqa: E402
+
+
+def delim_len(n: int) -> int:
+    """Length of the uvarint encoding of n. ref: pkg/shares/delimiter.go"""
+    return len(uvarint(n))
+
+
+def marshal_delimited_tx(tx: bytes) -> bytes:
+    """uvarint(len) ‖ tx. ref: split_compact_shares.go MarshalDelimitedTx"""
+    return uvarint(len(tx)) + tx
+
+
+def parse_delimiter(data: bytes) -> tuple[bytes, int]:
+    """Strip the unit-length delimiter: returns (rest, unit_len)."""
+    if len(data) == 0:
+        return data, 0
+    length, pos = read_uvarint(data, 0)
+    return data[pos:], length
+
+
+@dataclasses.dataclass(frozen=True)
+class Range:
+    start: int
+    end: int
+
+
+class CompactShareSplitter:
+    """Writes length-delimited units compactly across shares.
+    ref: pkg/shares/split_compact_shares.go:31-226"""
+
+    def __init__(self, namespace: Namespace, share_version: int):
+        self.shares: list[Share] = []
+        self.namespace = namespace
+        self.share_version = share_version
+        self.builder = Builder(namespace, share_version, True)
+        self.done = False
+        self.share_ranges: dict[bytes, Range] = {}
+
+    def write_tx(self, tx: bytes) -> None:
+        raw = marshal_delimited_tx(tx)
+        start = len(self.shares)
+        self._write(raw)
+        self.share_ranges[tx_key(tx)] = Range(start, self.count())
+
+    def _write(self, raw: bytes) -> None:
+        if self.done:
+            # writing after Export: re-open the last (padded) share
+            if not self.builder.is_empty_share():
+                self.shares.pop()
+            self.done = False
+
+        self.builder.maybe_write_reserved_bytes()
+        while True:
+            leftover = self.builder.add_data(raw)
+            if leftover is None:
+                break
+            self._stack_pending()
+            raw = leftover
+        if self.builder.available_bytes() == 0:
+            self._stack_pending()
+
+    def _stack_pending(self) -> None:
+        self.shares.append(self.builder.build())
+        self.builder = Builder(self.namespace, self.share_version, False)
+
+    def export(self) -> list[Share]:
+        if self._is_empty():
+            return []
+        if self.done:
+            return self.shares
+
+        bytes_of_padding = 0
+        if not self.builder.is_empty_share():
+            bytes_of_padding = self.builder.zero_pad_if_necessary()
+            self._stack_pending()
+
+        self._write_sequence_len(self._sequence_len(bytes_of_padding))
+        self.done = True
+        return self.shares
+
+    def share_ranges_with_offset(self, offset: int) -> dict[bytes, Range]:
+        return {
+            k: Range(v.start + offset, v.end + offset)
+            for k, v in self.share_ranges.items()
+        }
+
+    def _write_sequence_len(self, sequence_len: int) -> None:
+        if self._is_empty():
+            return
+        b = Builder(self.namespace, self.share_version, True)
+        b.import_raw_share(self.shares[0].to_bytes())
+        b.write_sequence_len(sequence_len)
+        self.shares[0] = b.build()
+
+    def _sequence_len(self, bytes_of_padding: int) -> int:
+        if not self.shares:
+            return 0
+        if len(self.shares) == 1:
+            return appconsts.FIRST_COMPACT_SHARE_CONTENT_SIZE - bytes_of_padding
+        continuation = (len(self.shares) - 1) * (
+            appconsts.CONTINUATION_COMPACT_SHARE_CONTENT_SIZE
+        )
+        return (
+            appconsts.FIRST_COMPACT_SHARE_CONTENT_SIZE
+            + continuation
+            - bytes_of_padding
+        )
+
+    def _is_empty(self) -> bool:
+        return not self.shares and self.builder.is_empty_share()
+
+    def count(self) -> int:
+        if not self.builder.is_empty_share() and not self.done:
+            return len(self.shares) + 1
+        return len(self.shares)
+
+
+class SparseShareSplitter:
+    """Splits blobs into sparse share sequences.
+    ref: pkg/shares/split_sparse_shares.go:19-110"""
+
+    def __init__(self):
+        self.shares: list[Share] = []
+
+    def write(self, blob: blob_pkg.Blob) -> None:
+        blob.validate()
+        if blob.share_version not in blob_pkg.SUPPORTED_SHARE_VERSIONS:
+            raise ValueError(f"unsupported share version: {blob.share_version}")
+
+        raw: bytes | None = blob.data
+        namespace = blob.namespace()
+        b = Builder(namespace, blob.share_version, True)
+        b.write_sequence_len(len(blob.data))
+        while raw is not None:
+            leftover = b.add_data(raw)
+            if leftover is None:
+                b.zero_pad_if_necessary()
+            self.shares.append(b.build())
+            b = Builder(namespace, blob.share_version, False)
+            raw = leftover
+
+    def write_namespace_padding_shares(self, count: int) -> None:
+        if count < 0:
+            raise ValueError("cannot write negative namespaced shares")
+        if count == 0:
+            return
+        if not self.shares:
+            raise ValueError(
+                "cannot write namespace padding shares on an empty splitter"
+            )
+        last = self.shares[-1]
+        self.shares.extend(
+            namespace_padding_shares(last.namespace(), last.version(), count)
+        )
+
+    def export(self) -> list[Share]:
+        return self.shares
+
+    def count(self) -> int:
+        return len(self.shares)
+
+
+class CompactShareCounter:
+    """Worst-case compact share counter with single-step revert.
+    ref: pkg/shares/counter.go:17-87"""
+
+    def __init__(self):
+        self.last_shares = 0
+        self.last_remainder = 0
+        self.shares = 0
+        self.remainder = 0
+
+    def add(self, data_len: int) -> int:
+        data_len += delim_len(data_len)
+        self.last_remainder = self.remainder
+        self.last_shares = self.shares
+
+        if self.shares == 0:
+            first_left = appconsts.FIRST_COMPACT_SHARE_CONTENT_SIZE - self.remainder
+            if data_len >= first_left:
+                data_len -= first_left
+                self.shares += 1
+                self.remainder = 0
+            else:
+                self.remainder += data_len
+                data_len = 0
+
+        cont = appconsts.CONTINUATION_COMPACT_SHARE_CONTENT_SIZE
+        if data_len >= cont - self.remainder:
+            data_len -= cont - self.remainder
+            self.shares += 1
+            self.remainder = 0
+        else:
+            self.remainder += data_len
+            data_len = 0
+
+        if data_len > 0:
+            self.shares += data_len // cont
+            self.remainder = data_len % cont
+
+        diff = self.shares - self.last_shares
+        if self.last_remainder == 0 and self.remainder > 0:
+            diff += 1
+        elif self.last_remainder > 0 and self.remainder == 0:
+            diff -= 1
+        return diff
+
+    def revert(self) -> None:
+        self.shares = self.last_shares
+        self.remainder = self.last_remainder
+
+    def size(self) -> int:
+        return self.shares if self.remainder == 0 else self.shares + 1
+
+
+def tx_key(tx: bytes) -> bytes:
+    """Tx identity = sha256 of the raw bytes (tendermint TxKey)."""
+    import hashlib
+
+    return hashlib.sha256(tx).digest()
+
+
+def extract_share_indexes(txs: list[bytes]) -> list[int] | None:
+    """Collect the share indexes of wrapped PFB txs.
+    ref: pkg/shares/share_splitting.go ExtractShareIndexes"""
+    indexes: list[int] = []
+    for raw in txs:
+        wrapper, is_wrapped = blob_pkg.unmarshal_index_wrapper(raw)
+        if is_wrapped:
+            if not wrapper.share_indexes:
+                return None
+            indexes.extend(wrapper.share_indexes)
+    return indexes
+
+
+def split_txs(
+    txs: list[bytes],
+) -> tuple[list[Share], list[Share], dict[bytes, Range]]:
+    """Split txs into (tx shares, pfb shares, share ranges).
+    ref: pkg/shares/share_splitting.go:46"""
+    tx_writer = CompactShareSplitter(
+        ns_pkg.TX_NAMESPACE, appconsts.SHARE_VERSION_ZERO
+    )
+    pfb_writer = CompactShareSplitter(
+        ns_pkg.PAY_FOR_BLOB_NAMESPACE, appconsts.SHARE_VERSION_ZERO
+    )
+    for tx in txs:
+        _, is_wrapper = blob_pkg.unmarshal_index_wrapper(tx)
+        (pfb_writer if is_wrapper else tx_writer).write_tx(tx)
+
+    tx_shares = tx_writer.export()
+    pfb_shares = pfb_writer.export()
+    ranges = tx_writer.share_ranges_with_offset(0)
+    ranges.update(pfb_writer.share_ranges_with_offset(len(tx_shares)))
+    return tx_shares, pfb_shares, ranges
+
+
+def split_blobs(blobs: list[blob_pkg.Blob]) -> list[Share]:
+    """ref: pkg/shares/share_splitting.go:77"""
+    writer = SparseShareSplitter()
+    for b in blobs:
+        writer.write(b)
+    return writer.export()
+
+
+def compact_shares_needed(sequence_len: int) -> int:
+    """ref: pkg/shares/share_sequence.go:103-121"""
+    if sequence_len == 0:
+        return 0
+    if sequence_len < appconsts.FIRST_COMPACT_SHARE_CONTENT_SIZE:
+        return 1
+    needed = 1
+    seq = sequence_len - appconsts.FIRST_COMPACT_SHARE_CONTENT_SIZE
+    while seq > 0:
+        seq -= appconsts.CONTINUATION_COMPACT_SHARE_CONTENT_SIZE
+        needed += 1
+    return needed
+
+
+def sparse_shares_needed(sequence_len: int) -> int:
+    """ref: pkg/shares/share_sequence.go:124-141"""
+    if sequence_len == 0:
+        return 0
+    if sequence_len < appconsts.FIRST_SPARSE_SHARE_CONTENT_SIZE:
+        return 1
+    needed = 1
+    seq = sequence_len - appconsts.FIRST_SPARSE_SHARE_CONTENT_SIZE
+    while seq > 0:
+        seq -= appconsts.CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+        needed += 1
+    return needed

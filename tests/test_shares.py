@@ -1,0 +1,184 @@
+"""Share splitting / parsing round-trips and layout invariants
+(reference test model: pkg/shares/split_compact_shares_test.go,
+parse_sparse_shares_test.go, counter_test.go)."""
+
+import numpy as np
+import pytest
+
+import celestia_tpu.namespace as ns
+from celestia_tpu import appconsts, blob as blob_pkg
+from celestia_tpu.shares import Share, tail_padding_share
+from celestia_tpu.shares.parse import (
+    parse_blobs,
+    parse_share_sequences,
+    parse_txs,
+)
+from celestia_tpu.shares.splitters import (
+    CompactShareCounter,
+    CompactShareSplitter,
+    SparseShareSplitter,
+    compact_shares_needed,
+    split_blobs,
+    split_txs,
+    sparse_shares_needed,
+)
+
+RNG = np.random.default_rng(0)
+
+
+def rand_tx(size: int) -> bytes:
+    return RNG.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+
+
+def rand_blob(sub_id: bytes, size: int) -> blob_pkg.Blob:
+    return blob_pkg.new_blob(ns.new_v0(sub_id), rand_tx(size), 0)
+
+
+class TestCompactShares:
+    @pytest.mark.parametrize(
+        "sizes",
+        [
+            [1],
+            [100, 200, 300],
+            [474],  # exactly first share content
+            [475],  # spills into continuation
+            [2000, 10, 5000],
+            [1] * 100,
+        ],
+    )
+    def test_roundtrip(self, sizes):
+        txs = [rand_tx(s) for s in sizes]
+        splitter = CompactShareSplitter(ns.TX_NAMESPACE, 0)
+        for tx in txs:
+            splitter.write_tx(tx)
+        shares = splitter.export()
+        assert parse_txs(shares) == txs
+
+    def test_share_layout(self):
+        splitter = CompactShareSplitter(ns.TX_NAMESPACE, 0)
+        splitter.write_tx(b"\x01" * 10)
+        shares = splitter.export()
+        assert len(shares) == 1
+        s = shares[0]
+        assert s.namespace() == ns.TX_NAMESPACE
+        assert s.is_sequence_start()
+        assert s.is_compact_share()
+        # sequence len counts the delimited unit + padding exclusion
+        assert s.sequence_len() == 11  # 1-byte varint + 10 bytes
+        # reserved bytes point at the first unit (right after the header)
+        assert s.reserved_bytes() == 29 + 1 + 4 + 4
+
+    def test_reserved_bytes_second_share(self):
+        # One tx spanning into the second share, then another tx: the second
+        # share's reserved bytes must point at the second tx's start.
+        tx1 = rand_tx(600)
+        tx2 = rand_tx(10)
+        splitter = CompactShareSplitter(ns.TX_NAMESPACE, 0)
+        splitter.write_tx(tx1)
+        splitter.write_tx(tx2)
+        shares = splitter.export()
+        assert len(shares) == 2
+        first_unit_len = 2 + 600  # 2-byte varint
+        spill = first_unit_len - appconsts.FIRST_COMPACT_SHARE_CONTENT_SIZE
+        header = 29 + 1 + 4  # ns + info + reserved (continuation share)
+        assert shares[1].reserved_bytes() == header + spill
+        assert parse_txs(shares) == [tx1, tx2]
+
+    def test_counter_matches_splitter(self):
+        counter = CompactShareCounter()
+        splitter = CompactShareSplitter(ns.TX_NAMESPACE, 0)
+        for size in [10, 474, 478, 1000, 3, 5000]:
+            counter.add(size)
+            splitter.write_tx(rand_tx(size))
+            assert counter.size() == splitter.count()
+
+    def test_counter_revert(self):
+        counter = CompactShareCounter()
+        counter.add(100)
+        before = (counter.shares, counter.remainder)
+        counter.add(5000)
+        counter.revert()
+        assert (counter.shares, counter.remainder) == before
+
+
+class TestSparseShares:
+    @pytest.mark.parametrize("sizes", [[1], [478], [479], [10, 1000, 100000]])
+    def test_roundtrip(self, sizes):
+        blobs = [rand_blob(bytes([i + 1]), s) for i, s in enumerate(sizes)]
+        shares = split_blobs(blobs)
+        parsed = parse_blobs(shares)
+        assert len(parsed) == len(blobs)
+        for got, want in zip(parsed, blobs):
+            assert got.data == want.data
+            assert got.namespace().bytes == want.namespace().bytes
+
+    def test_shares_needed(self):
+        assert sparse_shares_needed(0) == 0
+        assert sparse_shares_needed(1) == 1
+        assert sparse_shares_needed(478) == 1
+        assert sparse_shares_needed(479) == 2
+        assert compact_shares_needed(0) == 0
+        assert compact_shares_needed(474) == 1
+        assert compact_shares_needed(475) == 2
+
+    def test_blob_share_count_matches(self):
+        for size in [1, 477, 478, 479, 10000]:
+            b = rand_blob(b"\x09", size)
+            assert len(split_blobs([b])) == sparse_shares_needed(size)
+
+    def test_namespace_padding_skipped(self):
+        writer = SparseShareSplitter()
+        writer.write(rand_blob(b"\x01", 10))
+        writer.write_namespace_padding_shares(3)
+        writer.write(rand_blob(b"\x02", 10))
+        parsed = parse_blobs(writer.export())
+        assert len(parsed) == 2
+
+
+class TestSplitTxs:
+    def test_pfb_separated(self):
+        normal = [rand_tx(50), rand_tx(60)]
+        pfb = blob_pkg.marshal_index_wrapper(rand_tx(70), [5])
+        tx_shares, pfb_shares, ranges = split_txs(normal + [pfb])
+        assert all(s.namespace() == ns.TX_NAMESPACE for s in tx_shares)
+        assert all(s.namespace() == ns.PAY_FOR_BLOB_NAMESPACE for s in pfb_shares)
+        assert len(ranges) == 3
+        # pfb range is offset past tx shares
+        from celestia_tpu.shares.splitters import tx_key
+
+        r = ranges[tx_key(pfb)]
+        assert r.start >= len(tx_shares)
+
+
+class TestShareSequences:
+    def test_sequences(self):
+        blobs = [rand_blob(b"\x01", 1000), rand_blob(b"\x02", 10)]
+        shares = split_blobs(blobs) + [tail_padding_share()]
+        seqs = parse_share_sequences(shares)
+        assert len(seqs) == 3
+        assert parse_share_sequences(shares, ignore_padding=True)
+        assert len(parse_share_sequences(shares, ignore_padding=True)) == 2
+
+
+class TestBlobTxEnvelopes:
+    def test_blob_tx_roundtrip(self):
+        b = rand_blob(b"\x07", 100)
+        raw = blob_pkg.marshal_blob_tx(b"signed-tx-bytes", [b])
+        btx, ok = blob_pkg.unmarshal_blob_tx(raw)
+        assert ok
+        assert btx.tx == b"signed-tx-bytes"
+        assert len(btx.blobs) == 1
+        assert btx.blobs[0].data == b.data
+
+    def test_not_blob_tx(self):
+        _, ok = blob_pkg.unmarshal_blob_tx(b"\x01\x02\x03")
+        assert not ok
+        _, ok = blob_pkg.unmarshal_blob_tx(rand_tx(100))
+        assert not ok
+
+    def test_index_wrapper_roundtrip(self):
+        raw = blob_pkg.marshal_index_wrapper(b"inner", [1, 500, 70000])
+        w, ok = blob_pkg.unmarshal_index_wrapper(raw)
+        assert ok
+        assert w.tx == b"inner"
+        assert w.share_indexes == [1, 500, 70000]

@@ -1,0 +1,170 @@
+"""Square construction tests (reference model: pkg/square/square_test.go,
+square_fuzz_test.go: Build/Construct equivalence, Deconstruct round-trip,
+commitment-rule layout invariants)."""
+
+import numpy as np
+import pytest
+
+import celestia_tpu.namespace as ns
+from celestia_tpu import appconsts, blob as blob_pkg, inclusion, square
+from celestia_tpu.shares.splitters import sparse_shares_needed
+
+RNG = np.random.default_rng(7)
+
+
+def rand_bytes(n):
+    return RNG.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+def make_blob_tx(blob_sizes, sub_id=None):
+    blobs = [
+        blob_pkg.new_blob(
+            ns.new_v0(sub_id or rand_bytes(5)), rand_bytes(size), 0
+        )
+        for size in blob_sizes
+    ]
+    return blob_pkg.marshal_blob_tx(rand_bytes(64), blobs)
+
+
+class TestBuildConstruct:
+    def test_empty(self):
+        sq, txs = square.build([], 1, 64)
+        assert sq == square.empty_square()
+        assert txs == []
+        assert square.construct([], 1, 64) == square.empty_square()
+
+    def test_only_txs(self):
+        txs = [rand_bytes(100) for _ in range(5)]
+        sq, kept = square.build(txs, 1, 64)
+        assert kept == txs
+        sq2 = square.construct(kept, 1, 64)
+        assert [s.data for s in sq] == [s.data for s in sq2]
+
+    @pytest.mark.parametrize("blob_sizes", [[100], [1000, 2000], [1, 478, 100000]])
+    def test_build_construct_equivalence(self, blob_sizes):
+        txs = [rand_bytes(50), rand_bytes(120)]
+        btxs = [make_blob_tx([s]) for s in blob_sizes]
+        all_txs = txs + btxs
+        sq, kept = square.build(all_txs, 1, appconsts.DEFAULT_GOV_MAX_SQUARE_SIZE)
+        assert kept == all_txs
+        sq2 = square.construct(kept, 1, appconsts.DEFAULT_GOV_MAX_SQUARE_SIZE)
+        assert [s.data for s in sq] == [s.data for s in sq2]
+        # square is a power-of-two square
+        n = len(sq)
+        k = square.square_size(n)
+        assert k * k == n
+
+    def test_blobs_sorted_by_namespace(self):
+        btx1 = make_blob_tx([500], sub_id=b"\x09")
+        btx2 = make_blob_tx([500], sub_id=b"\x01")
+        sq, kept = square.build([btx1, btx2], 1, 64)
+        # blob namespaces in the square must be ascending
+        blob_ns = [
+            s.namespace()
+            for s in sq
+            if not s.namespace().is_reserved()
+        ]
+        assert blob_ns == sorted(blob_ns, key=lambda n: n.bytes)
+
+    def test_deconstruct_roundtrip(self):
+        blob_sizes = [100, 3000]
+        btxs = [make_blob_tx([s]) for s in blob_sizes]
+        txs = [rand_bytes(80)] + btxs
+        sq, kept = square.build(txs, 1, 64)
+
+        # blob sizes keyed by inner-tx bytes: the state machine supplies this
+        sizes_by_tx = {}
+        for btx in btxs:
+            parsed, _ = blob_pkg.unmarshal_blob_tx(btx)
+            sizes_by_tx[parsed.tx] = [len(b.data) for b in parsed.blobs]
+
+        got = square.deconstruct(sq, lambda inner: sizes_by_tx[inner])
+        assert got == kept
+
+    def test_construct_rejects_overflow(self):
+        big = [make_blob_tx([400_000]) for _ in range(10)]
+        with pytest.raises(ValueError):
+            square.construct(big, 1, 2)
+
+    def test_build_drops_overflow(self):
+        big = [make_blob_tx([100_000]) for _ in range(30)]
+        sq, kept = square.build(big, 1, 16)
+        assert len(kept) < 30
+        assert len(sq) <= 16 * 16
+
+    def test_construct_rejects_tx_after_blobtx(self):
+        with pytest.raises(ValueError, match="can not be appended after blob tx"):
+            square.construct([make_blob_tx([100]), rand_bytes(50)], 1, 64)
+
+    def test_fuzz_roundtrip(self):
+        """Random mix of txs and blob txs: Build -> Construct -> Deconstruct."""
+        for trial in range(5):
+            n_txs = int(RNG.integers(0, 5))
+            n_btxs = int(RNG.integers(1, 6))
+            txs = [rand_bytes(int(RNG.integers(1, 2000))) for _ in range(n_txs)]
+            btxs = []
+            for _ in range(n_btxs):
+                n_blobs = int(RNG.integers(1, 4))
+                sizes = [int(RNG.integers(1, 20000)) for _ in range(n_blobs)]
+                btxs.append(make_blob_tx(sizes))
+            sq, kept = square.build(txs + btxs, 1, 64)
+            sq2 = square.construct(kept, 1, 64)
+            assert [s.data for s in sq] == [s.data for s in sq2]
+
+            sizes_by_tx = {}
+            for btx in btxs:
+                parsed, _ = blob_pkg.unmarshal_blob_tx(btx)
+                sizes_by_tx[parsed.tx] = [len(b.data) for b in parsed.blobs]
+            got = square.deconstruct(sq, lambda inner: sizes_by_tx[inner])
+            assert got == kept
+
+
+class TestShareRanges:
+    def test_tx_share_range(self):
+        txs = [rand_bytes(100), rand_bytes(600), make_blob_tx([500])]
+        for i in range(3):
+            r = square.tx_share_range(txs, i, 1)
+            assert 0 <= r.start < r.end
+
+    def test_blob_share_range(self):
+        txs = [rand_bytes(100), make_blob_tx([5000])]
+        r = square.blob_share_range(txs, 1, 0, 1)
+        assert r.end - r.start == sparse_shares_needed(5000)
+        # the blob's start index obeys the subtree-width alignment
+        width = inclusion.sub_tree_width(
+            sparse_shares_needed(5000), appconsts.DEFAULT_SUBTREE_ROOT_THRESHOLD
+        )
+        assert r.start % width == 0
+
+
+class TestCommitmentRules:
+    def test_subtree_width(self):
+        assert inclusion.sub_tree_width(1, 64) == 1
+        assert inclusion.sub_tree_width(64, 64) == 1
+        assert inclusion.sub_tree_width(65, 64) == 2
+        assert inclusion.sub_tree_width(129, 64) == 4
+
+    def test_blob_min_square_size(self):
+        assert inclusion.blob_min_square_size(0) == 1
+        assert inclusion.blob_min_square_size(1) == 1
+        assert inclusion.blob_min_square_size(2) == 2
+        assert inclusion.blob_min_square_size(5) == 4
+        assert inclusion.blob_min_square_size(17) == 8
+
+    def test_mmr_sizes(self):
+        assert inclusion.merkle_mountain_range_sizes(11, 4) == [4, 4, 2, 1]
+        assert inclusion.merkle_mountain_range_sizes(8, 8) == [8]
+        assert inclusion.merkle_mountain_range_sizes(7, 8) == [4, 2, 1]
+
+    def test_next_share_index(self):
+        # blob of 4 shares at threshold 64 -> subtree width 1: no alignment
+        assert inclusion.next_share_index(13, 4, 64) == 13
+        # wide blob: width 4 -> round 13 up to 16
+        assert inclusion.next_share_index(13, 129 * 4, 64) in (16,)
+
+    def test_create_commitment_deterministic(self):
+        b = blob_pkg.new_blob(ns.new_v0(b"\x01"), b"\xab" * 1000, 0)
+        c1 = inclusion.create_commitment(b)
+        c2 = inclusion.create_commitment(b)
+        assert c1 == c2
+        assert len(c1) == 32
