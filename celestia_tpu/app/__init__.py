@@ -1,0 +1,4 @@
+"""The application layer (ABCI boundary)."""
+
+from .app import App, GENESIS_CHAIN_ID  # noqa: F401
+from .context import Context, GasMeter, OutOfGasError  # noqa: F401

@@ -1,0 +1,347 @@
+"""The App: state machine behind the ABCI boundary.
+
+Reference semantics: app/app.go (keeper wiring, Begin/End block),
+app/prepare_proposal.go, app/process_proposal.go, app/check_tx.go,
+app/deliver_tx.go, app/extend_block.go, app/validate_txs.go,
+app/square_size.go.
+
+Block processing is expressed as pure-ish methods over an explicit
+StateStore so everything is unit-testable without consensus (the test
+strategy the reference uses via testnode, SURVEY §4.4). The EDS/DAH hot
+path can run on the host reference path or the fused TPU pipeline
+(use_tpu=True), which are byte-identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from celestia_tpu import appconsts, da
+from celestia_tpu import blob as blob_pkg
+from celestia_tpu import square as square_pkg
+from celestia_tpu.shares import to_bytes
+from celestia_tpu.state import StateStore
+from celestia_tpu.tx import Tx, decode_tx
+from celestia_tpu.x.auth import AccountKeeper
+from celestia_tpu.x.bank import BankKeeper, MsgSend
+from celestia_tpu.x.blob import BlobKeeper, MsgPayForBlobs, validate_blob_tx
+from celestia_tpu.x.blob.types import pfb_blob_sizes
+from celestia_tpu.x.mint import MintKeeper
+from celestia_tpu.x.upgrade import MsgVersionChange, UpgradeKeeper
+
+from .ante import AnteHandler
+from .context import Context, ExecMode, GasMeter
+
+GENESIS_CHAIN_ID = "celestia-tpu-1"
+
+
+@dataclasses.dataclass
+class TxResult:
+    code: int  # 0 = OK
+    log: str = ""
+    gas_wanted: int = 0
+    gas_used: int = 0
+    events: list = dataclasses.field(default_factory=list)
+    priority: int = 0
+
+
+@dataclasses.dataclass
+class ProposalBlockData:
+    txs: list[bytes]
+    square_size: int
+    hash: bytes
+
+
+class App:
+    SUPPORTED_VERSIONS = (1, 2)
+
+    def __init__(self, chain_id: str = GENESIS_CHAIN_ID, app_version: int = 1,
+                 use_tpu: bool = False, upgrade_schedule: dict | None = None):
+        self.chain_id = chain_id
+        self.app_version = app_version
+        self.use_tpu = use_tpu
+        self.store = StateStore()
+        self.accounts = AccountKeeper(self.store)
+        self.bank = BankKeeper(self.store)
+        self.blob = BlobKeeper(self.store)
+        self.mint = MintKeeper(self.store, self.bank)
+        self.upgrade = UpgradeKeeper(upgrade_schedule or {})
+        self.height = 0
+        self.block_time = 0.0
+        self.min_gas_price = 0.0
+        self._deliver_store = None
+        self._deliver_ctx = None
+
+    # ------------------------------------------------------------------ #
+    # genesis
+
+    def init_chain(self, genesis_accounts: dict[str, int] | None = None,
+                   genesis_time: float = 0.0) -> None:
+        """ref: app/app.go InitChainer + default_overrides genesis"""
+        from celestia_tpu.x.blob.keeper import Params
+
+        self.blob.set_params(Params())
+        self.mint.init_genesis(genesis_time)
+        for address, amount in (genesis_accounts or {}).items():
+            self.accounts.get_or_create(address)
+            self.bank.mint(address, amount)
+        self.store.commit()
+        self.height = 0
+
+    # ------------------------------------------------------------------ #
+    # helpers
+
+    def _ante(self) -> AnteHandler:
+        return AnteHandler()
+
+    def _new_ctx(self, store, mode: ExecMode) -> Context:
+        return Context(
+            store=store,
+            chain_id=self.chain_id,
+            block_height=self.height + 1,
+            block_time=self.block_time,
+            app_version=self.app_version,
+            mode=mode,
+            min_gas_price=self.min_gas_price,
+        )
+
+    def gov_square_size_upper_bound(self) -> int:
+        """ref: app/square_size.go:10"""
+        return min(
+            self.blob.get_params().gov_max_square_size,
+            appconsts.square_size_upper_bound(self.app_version),
+        )
+
+    def _extend_and_hash(self, data_square) -> tuple:
+        """The hot path: square -> EDS -> DAH. ref: app/prepare_proposal.go:95"""
+        if self.use_tpu:
+            import numpy as np
+
+            from celestia_tpu.ops import extend_tpu
+
+            k = square_pkg.square_size(len(data_square))
+            arr = np.frombuffer(
+                b"".join(s.data for s in data_square), dtype=np.uint8
+            ).reshape(k, k, appconsts.SHARE_SIZE)
+            eds, rows, cols, dah_hash = extend_tpu.extend_and_root_device(arr)
+            dah = da.DataAvailabilityHeader(
+                [r.tobytes() for r in rows], [c.tobytes() for c in cols]
+            )
+            assert dah.hash() == dah_hash.tobytes()
+            return eds, dah
+        eds = da.extend_shares(to_bytes(data_square))
+        return eds, da.new_data_availability_header(eds)
+
+    # ------------------------------------------------------------------ #
+    # CheckTx (mempool admission). ref: app/check_tx.go:15-51
+
+    def check_tx(self, raw_tx: bytes, recheck: bool = False) -> TxResult:
+        btx, is_blob = blob_pkg.unmarshal_blob_tx(raw_tx)
+        mode = ExecMode.RECHECK if recheck else ExecMode.CHECK
+        try:
+            if not is_blob:
+                tx = decode_tx(raw_tx)
+                for msg in tx.msgs:
+                    if isinstance(msg, MsgPayForBlobs):
+                        return TxResult(code=2, log="PFB without blobs (ErrNoBlobs)")
+                inner_raw = raw_tx
+            else:
+                if not recheck:
+                    validate_blob_tx(btx)
+                tx = Tx.unmarshal(btx.tx)
+                inner_raw = btx.tx
+
+            store = self.store.branch()
+            ctx = self._new_ctx(store, mode)
+            ctx = self._ante()(ctx, tx, len(inner_raw))
+            return TxResult(
+                code=0,
+                gas_wanted=tx.fee.gas_limit,
+                gas_used=ctx.gas_meter.consumed,
+                priority=ctx.priority,
+            )
+        except Exception as e:  # noqa: BLE001 — tx failures become result codes
+            return TxResult(code=1, log=str(e))
+
+    # ------------------------------------------------------------------ #
+    # PrepareProposal. ref: app/prepare_proposal.go:22-134
+
+    def prepare_proposal(self, mempool_txs: list[bytes],
+                         block_data_size: int | None = None) -> ProposalBlockData:
+        if self.height == 0:
+            txs: list[bytes] = []  # first block is empty by design
+        else:
+            store = self.store.branch()
+            ctx = self._new_ctx(store, ExecMode.PREPARE)
+            txs = self.filter_txs(ctx, mempool_txs)
+
+            new_version = self.upgrade.should_propose_upgrade(self.chain_id, self.height + 1)
+            if new_version is not None and new_version > self.app_version:
+                txs = [MsgVersionChange.as_tx_bytes(new_version)] + txs
+            if block_data_size is not None:
+                # prune lowest-priority (trailing) txs over the size budget
+                size = sum(len(t) for t in txs)
+                while size > block_data_size and txs:
+                    size -= len(txs[-1])
+                    txs = txs[:-1]
+
+        data_square, txs = square_pkg.build(
+            txs, self.app_version, self.gov_square_size_upper_bound()
+        )
+        _eds, dah = self._extend_and_hash(data_square)
+        return ProposalBlockData(
+            txs=txs,
+            square_size=square_pkg.square_size(len(data_square)),
+            hash=dah.hash(),
+        )
+
+    def filter_txs(self, ctx: Context, txs: list[bytes]) -> list[bytes]:
+        """Drop ante-failing txs. ref: app/validate_txs.go:30-35"""
+        ante = self._ante()
+        kept_normal: list[bytes] = []
+        kept_blob: list[bytes] = []
+        for raw in txs:
+            btx, is_blob = blob_pkg.unmarshal_blob_tx(raw)
+            inner = btx.tx if is_blob else raw
+            try:
+                tx = decode_tx(inner)
+                ante(ctx, tx, len(inner))
+            except Exception:  # noqa: BLE001
+                continue
+            (kept_blob if is_blob else kept_normal).append(raw)
+        return kept_normal + kept_blob
+
+    # ------------------------------------------------------------------ #
+    # ProcessProposal. ref: app/process_proposal.go:24-166
+
+    def process_proposal(self, block_data: ProposalBlockData) -> bool:
+        try:
+            return self._process_proposal_inner(block_data)
+        except Exception:  # noqa: BLE001 — panics vote REJECT, not crash
+            return False
+
+    def _process_proposal_inner(self, block_data: ProposalBlockData) -> bool:
+        store = self.store.branch()
+        ctx = self._new_ctx(store, ExecMode.PROCESS)
+        ante = self._ante()
+
+        for idx, raw_tx in enumerate(block_data.txs):
+            btx, is_blob = blob_pkg.unmarshal_blob_tx(raw_tx)
+            inner = btx.tx if is_blob else raw_tx
+            try:
+                tx = decode_tx(inner)
+            except Exception:  # noqa: BLE001 — undecodable txs are not a
+                continue  # block validity rule
+
+            if not is_blob:
+                if any(isinstance(m, MsgPayForBlobs) for m in tx.msgs):
+                    return False  # non-blob tx carrying a PFB
+                version = MsgVersionChange.from_msgs(tx.msgs)
+                if version is not None:
+                    if idx != 0:
+                        return False  # upgrade msg must be the first tx
+                    if version not in self.SUPPORTED_VERSIONS:
+                        return False
+                    if version <= self.app_version:
+                        return False
+                    continue
+                ante(ctx, tx, len(inner))
+                continue
+
+            validate_blob_tx(btx)
+            ante(ctx, tx, len(inner))
+
+        data_square = square_pkg.construct(
+            block_data.txs, self.app_version, self.gov_square_size_upper_bound()
+        )
+        if square_pkg.square_size(len(data_square)) != block_data.square_size:
+            return False
+        _eds, dah = self._extend_and_hash(data_square)
+        return dah.hash() == block_data.hash
+
+    # ------------------------------------------------------------------ #
+    # Block execution: BeginBlock -> DeliverTx* -> EndBlock -> Commit
+
+    def begin_block(self, block_time: float | None = None) -> None:
+        self.block_time = block_time if block_time is not None else self.block_time + 15.0
+        self._deliver_store = self.store.branch()
+        self._deliver_ctx = self._new_ctx(self._deliver_store, ExecMode.DELIVER)
+        self.mint.begin_blocker(self._deliver_ctx)
+
+    def deliver_tx(self, raw_tx: bytes) -> TxResult:
+        """ref: app/deliver_tx.go:10-23"""
+        btx, is_blob = blob_pkg.unmarshal_blob_tx(raw_tx)
+        inner = btx.tx if is_blob else raw_tx
+        try:
+            tx = decode_tx(inner)
+        except Exception as e:  # noqa: BLE001
+            return TxResult(code=1, log=f"undecodable tx: {e}")
+
+        version = MsgVersionChange.from_msgs(tx.msgs)
+        if version is not None:
+            if version not in self.SUPPORTED_VERSIONS:
+                raise RuntimeError(
+                    f"network is at version {version} which this node does not support"
+                )
+            self.upgrade.prepare_upgrade_at_end_block(version)
+            return TxResult(code=0, log="version change armed")
+
+        tx_store = self._deliver_store.branch()
+        ctx = dataclasses.replace(self._deliver_ctx, store=tx_store)
+        try:
+            ctx = self._ante()(ctx, tx, len(inner))
+            for msg in tx.msgs:
+                self._route_msg(ctx, msg)
+            tx_store.write()
+            return TxResult(
+                code=0,
+                gas_wanted=tx.fee.gas_limit,
+                gas_used=ctx.gas_meter.consumed,
+                events=ctx.events,
+            )
+        except Exception as e:  # noqa: BLE001
+            return TxResult(code=1, log=str(e))
+
+    def _route_msg(self, ctx: Context, msg) -> None:
+        if isinstance(msg, MsgPayForBlobs):
+            blob_keeper = BlobKeeper(ctx.store)
+            blob_keeper.pay_for_blobs(ctx, msg)
+        elif isinstance(msg, MsgSend):
+            BankKeeper(ctx.store).send(
+                msg.from_address, msg.to_address, msg.amount, msg.denom
+            )
+        else:
+            raise ValueError(f"unroutable message type {type(msg).__name__}")
+
+    def end_block(self) -> dict:
+        """ref: app/app.go:575-587 (EndBlocker upgrade bump)"""
+        result = {}
+        if self.upgrade.should_upgrade():
+            result["app_version"] = self.upgrade.pending_app_version
+        return result
+
+    def commit(self) -> bytes:
+        if self._deliver_store is not None:
+            self._deliver_store.write()
+            self._deliver_store = None
+            self._deliver_ctx = None
+        if self.upgrade.should_upgrade():
+            self.app_version = self.upgrade.pending_app_version
+            self.upgrade.mark_upgrade_complete()
+        self.height += 1
+        return self.store.commit()
+
+    # ------------------------------------------------------------------ #
+    # ExtendBlock (post-consensus EDS recompute). ref: app/extend_block.go:14
+
+    def extend_block(self, txs: list[bytes]):
+        data_square = square_pkg.construct(
+            txs, self.app_version, appconsts.square_size_upper_bound(self.app_version)
+        )
+        eds, _dah = self._extend_and_hash(data_square)
+        return eds
+
+    # ------------------------------------------------------------------ #
+
+    def deconstruct_square(self, data_square) -> list[bytes]:
+        return square_pkg.deconstruct(data_square, pfb_blob_sizes)

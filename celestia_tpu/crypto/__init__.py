@@ -1,0 +1,167 @@
+"""Keys, signatures, addresses.
+
+The reference inherits secp256k1 ECDSA keys and bech32 account addresses
+from the Cosmos SDK (pkg/user/signer.go signs SIGN_MODE_DIRECT with a
+secp256k1 keyring key; addresses are bech32("celestia",
+ripemd160(sha256(compressed_pubkey)))). This module provides the same
+primitives on top of the `cryptography` library with cosmos-compatible
+low-S normalized, 64-byte (r ‖ s) signatures.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+    encode_dss_signature,
+)
+from cryptography.hazmat.primitives import hashes
+from cryptography.exceptions import InvalidSignature
+
+BECH32_HRP = "celestia"
+
+# secp256k1 group order (for low-S normalization, as enforced by cosmos)
+_SECP256K1_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+
+# --- bech32 (BIP-173) ---
+
+_CHARSET = "qpzry9x8gf2tvdw0s3jn54khce6mua7l"
+
+
+def _bech32_polymod(values):
+    gen = [0x3B6A57B2, 0x26508E6D, 0x1EA119FA, 0x3D4233DD, 0x2A1462B3]
+    chk = 1
+    for v in values:
+        top = chk >> 25
+        chk = (chk & 0x1FFFFFF) << 5 ^ v
+        for i in range(5):
+            chk ^= gen[i] if ((top >> i) & 1) else 0
+    return chk
+
+
+def _bech32_hrp_expand(hrp):
+    return [ord(x) >> 5 for x in hrp] + [0] + [ord(x) & 31 for x in hrp]
+
+
+def _bech32_create_checksum(hrp, data):
+    values = _bech32_hrp_expand(hrp) + data
+    polymod = _bech32_polymod(values + [0, 0, 0, 0, 0, 0]) ^ 1
+    return [(polymod >> 5 * (5 - i)) & 31 for i in range(6)]
+
+
+def _convertbits(data, frombits, tobits, pad=True):
+    acc = 0
+    bits = 0
+    ret = []
+    maxv = (1 << tobits) - 1
+    for value in data:
+        acc = (acc << frombits) | value
+        bits += frombits
+        while bits >= tobits:
+            bits -= tobits
+            ret.append((acc >> bits) & maxv)
+    if pad:
+        if bits:
+            ret.append((acc << (tobits - bits)) & maxv)
+    elif bits >= frombits or ((acc << (tobits - bits)) & maxv):
+        raise ValueError("invalid bech32 padding")
+    return ret
+
+
+def bech32_encode(hrp: str, data: bytes) -> str:
+    d = _convertbits(data, 8, 5)
+    checksum = _bech32_create_checksum(hrp, d)
+    return hrp + "1" + "".join(_CHARSET[x] for x in d + checksum)
+
+
+def bech32_decode(addr: str) -> tuple[str, bytes]:
+    if addr.lower() != addr and addr.upper() != addr:
+        raise ValueError("mixed-case bech32")
+    addr = addr.lower()
+    pos = addr.rfind("1")
+    if pos < 1 or pos + 7 > len(addr):
+        raise ValueError("invalid bech32")
+    hrp, rest = addr[:pos], addr[pos + 1 :]
+    data = [_CHARSET.find(c) for c in rest]
+    if -1 in data:
+        raise ValueError("invalid bech32 character")
+    if _bech32_polymod(_bech32_hrp_expand(hrp) + data) != 1:
+        raise ValueError("invalid bech32 checksum")
+    return hrp, bytes(_convertbits(data[:-6], 5, 8, pad=False))
+
+
+# --- secp256k1 keys ---
+
+
+def _sha256(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+def address_from_pubkey(compressed_pubkey: bytes) -> bytes:
+    """20-byte account address = ripemd160(sha256(pubkey))."""
+    ripemd = hashlib.new("ripemd160")
+    ripemd.update(_sha256(compressed_pubkey))
+    return ripemd.digest()
+
+
+def bech32_address(compressed_pubkey: bytes, hrp: str = BECH32_HRP) -> str:
+    return bech32_encode(hrp, address_from_pubkey(compressed_pubkey))
+
+
+@dataclasses.dataclass
+class PrivateKey:
+    _key: ec.EllipticCurvePrivateKey
+
+    @classmethod
+    def generate(cls) -> "PrivateKey":
+        return cls(ec.generate_private_key(ec.SECP256K1()))
+
+    @classmethod
+    def from_secret(cls, secret: bytes) -> "PrivateKey":
+        """Deterministic key from a 32-byte secret (test fixtures)."""
+        value = int.from_bytes(_sha256(secret), "big") % (_SECP256K1_N - 1) + 1
+        return cls(ec.derive_private_key(value, ec.SECP256K1()))
+
+    def public_key(self) -> bytes:
+        """33-byte compressed SEC1 public key."""
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding,
+            PublicFormat,
+        )
+
+        return self._key.public_key().public_bytes(
+            Encoding.X962, PublicFormat.CompressedPoint
+        )
+
+    def address(self) -> bytes:
+        return address_from_pubkey(self.public_key())
+
+    def bech32_address(self) -> str:
+        return bech32_address(self.public_key())
+
+    def sign(self, msg: bytes) -> bytes:
+        """64-byte (r ‖ s) signature over sha256(msg), low-S normalized."""
+        der = self._key.sign(msg, ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        if s > _SECP256K1_N // 2:
+            s = _SECP256K1_N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def verify_signature(compressed_pubkey: bytes, msg: bytes, sig: bytes) -> bool:
+    if len(sig) != 64:
+        return False
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    if s > _SECP256K1_N // 2:  # reject malleable high-S signatures
+        return False
+    try:
+        pub = ec.EllipticCurvePublicKey.from_encoded_point(ec.SECP256K1(), compressed_pubkey)
+        pub.verify(encode_dss_signature(r, s), msg, ec.ECDSA(hashes.SHA256()))
+        return True
+    except (InvalidSignature, ValueError):
+        return False

@@ -1,0 +1,128 @@
+"""Versioned key-value state store with branch/commit semantics.
+
+The reference commits an IAVL multistore per block (SURVEY §5
+checkpoint/resume: baseapp + store keys, app/app.go:268-279). This module
+provides the same capabilities in a self-contained form:
+
+- `StateStore`: committed map + per-block app hash over sorted (key, value)
+  pairs (deterministic, consensus-usable).
+- `CacheStore.branch()`: writable overlay used for proposal handling /
+  CheckTx so speculative execution never touches committed state; `write()`
+  flushes to the parent (DeliverTx -> Commit flow).
+- snapshot/restore for checkpoint-resume (state-sync analogue).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+
+class CacheStore:
+    """Write-ahead overlay over a parent store."""
+
+    def __init__(self, parent):
+        self.parent = parent
+        self._writes: dict[bytes, bytes | None] = {}
+
+    def get(self, key: bytes) -> bytes | None:
+        if key in self._writes:
+            return self._writes[key]
+        return self.parent.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        if not isinstance(key, bytes) or not isinstance(value, bytes):
+            raise TypeError("store keys/values must be bytes")
+        self._writes[key] = value
+
+    def delete(self, key: bytes) -> None:
+        self._writes[key] = None
+
+    def branch(self) -> "CacheStore":
+        return CacheStore(self)
+
+    def write(self) -> None:
+        """Flush this overlay into the parent."""
+        for k, v in self._writes.items():
+            if v is None:
+                self.parent.delete(k)
+            else:
+                self.parent.set(k, v)
+        self._writes.clear()
+
+    def iter_prefix(self, prefix: bytes):
+        seen = set()
+        for k, v in self._writes.items():
+            if k.startswith(prefix):
+                seen.add(k)
+                if v is not None:
+                    yield k, v
+        for k, v in self.parent.iter_prefix(prefix):
+            if k not in seen:
+                yield k, v
+
+
+class StateStore:
+    """Committed state with per-height app hashes."""
+
+    def __init__(self):
+        self._data: dict[bytes, bytes] = {}
+        self.version = 0
+        self.app_hashes: dict[int, bytes] = {}
+
+    def get(self, key: bytes) -> bytes | None:
+        return self._data.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        if not isinstance(key, bytes) or not isinstance(value, bytes):
+            raise TypeError("store keys/values must be bytes")
+        self._data[key] = value
+
+    def delete(self, key: bytes) -> None:
+        self._data.pop(key, None)
+
+    def branch(self) -> CacheStore:
+        return CacheStore(self)
+
+    def iter_prefix(self, prefix: bytes):
+        for k in sorted(self._data):
+            if k.startswith(prefix):
+                yield k, self._data[k]
+
+    def commit(self) -> bytes:
+        """Advance one version and return the deterministic app hash."""
+        h = hashlib.sha256()
+        for k in sorted(self._data):
+            h.update(hashlib.sha256(k).digest())
+            h.update(hashlib.sha256(self._data[k]).digest())
+        self.version += 1
+        app_hash = h.digest()
+        self.app_hashes[self.version] = app_hash
+        return app_hash
+
+    # --- checkpoint / resume ---
+
+    def snapshot(self) -> bytes:
+        payload = {
+            "version": self.version,
+            "data": {k.hex(): v.hex() for k, v in self._data.items()},
+        }
+        return json.dumps(payload, sort_keys=True).encode()
+
+    @classmethod
+    def restore(cls, snapshot: bytes) -> "StateStore":
+        payload = json.loads(snapshot)
+        store = cls()
+        store.version = payload["version"]
+        store._data = {
+            bytes.fromhex(k): bytes.fromhex(v) for k, v in payload["data"].items()
+        }
+        store.commit_hash_refresh()
+        return store
+
+    def commit_hash_refresh(self) -> None:
+        h = hashlib.sha256()
+        for k in sorted(self._data):
+            h.update(hashlib.sha256(k).digest())
+            h.update(hashlib.sha256(self._data[k]).digest())
+        self.app_hashes[self.version] = h.digest()

@@ -1,0 +1,253 @@
+"""Transaction wire format + signing.
+
+The reference uses Cosmos SDK protobuf txs (TxRaw{body, auth_info,
+signatures}) signed in SIGN_MODE_DIRECT over SignDoc{body_bytes,
+auth_info_bytes, chain_id, account_number} (pkg/user/signer.go:287,
+app/encoding/encoding.go). This module implements that scheme with the
+same structure on the in-repo proto codec: deterministic byte encodings,
+a message registry keyed by type URL, and direct-mode sign bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from celestia_tpu.blob import (
+    _field_bytes,
+    _field_uint,
+    _parse_fields,
+    _require_wt,
+)
+
+# --- message registry ---
+
+_MSG_REGISTRY: dict[str, Callable[[bytes], "object"]] = {}
+
+
+def register_msg(type_url: str):
+    """Class decorator: register an unmarshaller under a type URL."""
+
+    def wrap(cls):
+        cls.TYPE_URL = type_url
+        _MSG_REGISTRY[type_url] = cls.unmarshal
+        return cls
+
+    return wrap
+
+
+def decode_any(type_url: str, value: bytes):
+    if type_url not in _MSG_REGISTRY:
+        raise ValueError(f"unknown message type {type_url}")
+    return _MSG_REGISTRY[type_url](value)
+
+
+@dataclasses.dataclass
+class Fee:
+    amount: int = 0
+    gas_limit: int = 0
+    denom: str = "utia"
+    payer: str = ""
+    granter: str = ""
+
+    def marshal(self) -> bytes:
+        return (
+            _field_uint(1, self.amount)
+            + _field_uint(2, self.gas_limit)
+            + _field_bytes(3, self.denom.encode())
+            + _field_bytes(4, self.payer.encode())
+            + _field_bytes(5, self.granter.encode())
+        )
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "Fee":
+        f = cls(denom="")
+        for tag, wt, val in _parse_fields(raw):
+            if tag == 1:
+                _require_wt(wt, 0, tag)
+                f.amount = int(val)
+            elif tag == 2:
+                _require_wt(wt, 0, tag)
+                f.gas_limit = int(val)
+            elif tag == 3:
+                _require_wt(wt, 2, tag)
+                f.denom = bytes(val).decode()
+            elif tag == 4:
+                _require_wt(wt, 2, tag)
+                f.payer = bytes(val).decode()
+            elif tag == 5:
+                _require_wt(wt, 2, tag)
+                f.granter = bytes(val).decode()
+        return f
+
+
+@dataclasses.dataclass
+class SignerInfo:
+    public_key: bytes  # 33-byte compressed secp256k1
+    sequence: int
+
+    def marshal(self) -> bytes:
+        return _field_bytes(1, self.public_key) + _field_uint(2, self.sequence)
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "SignerInfo":
+        s = cls(b"", 0)
+        for tag, wt, val in _parse_fields(raw):
+            if tag == 1:
+                _require_wt(wt, 2, tag)
+                s.public_key = bytes(val)
+            elif tag == 2:
+                _require_wt(wt, 0, tag)
+                s.sequence = int(val)
+        return s
+
+
+def _field_bytes_present(tag: int, payload: bytes) -> bytes:
+    """Length-delimited field emitted even when empty (presence encoding)."""
+    from celestia_tpu.blob import uvarint
+
+    return uvarint(tag << 3 | 2) + uvarint(len(payload)) + payload
+
+
+@dataclasses.dataclass
+class Tx:
+    """A decoded transaction.
+
+    SIGN_MODE_DIRECT signs the body/auth bytes exactly as transmitted, so
+    unmarshalled txs retain their raw encodings (`_raw_body`/`_raw_auth`)
+    and signature verification uses those — a re-serialization would make
+    signed txs byte-malleable through unknown-field stripping.
+    """
+
+    msgs: list  # registered msg objects
+    signer_infos: list[SignerInfo]
+    fee: Fee
+    signatures: list[bytes]
+    memo: str = ""
+    _raw_body: bytes | None = dataclasses.field(default=None, repr=False)
+    _raw_auth: bytes | None = dataclasses.field(default=None, repr=False)
+
+    # --- encoding ---
+
+    def body_bytes(self) -> bytes:
+        if self._raw_body is not None:
+            return self._raw_body
+        out = b""
+        for m in self.msgs:
+            any_bytes = _field_bytes(1, m.TYPE_URL.encode()) + _field_bytes_present(
+                2, m.marshal()
+            )
+            out += _field_bytes(1, any_bytes)
+        out += _field_bytes(2, self.memo.encode())
+        return out
+
+    def auth_info_bytes(self) -> bytes:
+        if self._raw_auth is not None:
+            return self._raw_auth
+        out = b""
+        for si in self.signer_infos:
+            out += _field_bytes(1, si.marshal())
+        out += _field_bytes(2, self.fee.marshal())
+        return out
+
+    def marshal(self) -> bytes:
+        out = _field_bytes(1, self.body_bytes()) + _field_bytes(
+            2, self.auth_info_bytes()
+        )
+        for sig in self.signatures:
+            out += _field_bytes(3, sig)
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "Tx":
+        body = b""
+        auth = b""
+        sigs: list[bytes] = []
+        for tag, wt, val in _parse_fields(raw):
+            if tag == 1:
+                _require_wt(wt, 2, tag)
+                body = bytes(val)
+            elif tag == 2:
+                _require_wt(wt, 2, tag)
+                auth = bytes(val)
+            elif tag == 3:
+                _require_wt(wt, 2, tag)
+                sigs.append(bytes(val))
+
+        msgs = []
+        memo = ""
+        for tag, wt, val in _parse_fields(body):
+            if tag == 1:
+                _require_wt(wt, 2, tag)
+                type_url = ""
+                value = b""
+                for t2, w2, v2 in _parse_fields(bytes(val)):
+                    if t2 == 1:
+                        _require_wt(w2, 2, t2)
+                        type_url = bytes(v2).decode()
+                    elif t2 == 2:
+                        _require_wt(w2, 2, t2)
+                        value = bytes(v2)
+                msgs.append(decode_any(type_url, value))
+            elif tag == 2:
+                _require_wt(wt, 2, tag)
+                memo = bytes(val).decode()
+
+        signer_infos: list[SignerInfo] = []
+        fee = Fee()
+        for tag, wt, val in _parse_fields(auth):
+            if tag == 1:
+                _require_wt(wt, 2, tag)
+                signer_infos.append(SignerInfo.unmarshal(bytes(val)))
+            elif tag == 2:
+                _require_wt(wt, 2, tag)
+                fee = Fee.unmarshal(bytes(val))
+        return cls(msgs=msgs, signer_infos=signer_infos, fee=fee,
+                   signatures=sigs, memo=memo, _raw_body=body, _raw_auth=auth)
+
+
+def sign_doc_bytes(
+    body_bytes: bytes, auth_info_bytes: bytes, chain_id: str, account_number: int
+) -> bytes:
+    """SIGN_MODE_DIRECT sign document."""
+    return (
+        _field_bytes(1, body_bytes)
+        + _field_bytes(2, auth_info_bytes)
+        + _field_bytes(3, chain_id.encode())
+        + _field_uint(4, account_number)
+    )
+
+
+def sign_tx(
+    priv_key,
+    msgs: list,
+    chain_id: str,
+    account_number: int,
+    sequence: int,
+    fee: Fee | None = None,
+    memo: str = "",
+) -> Tx:
+    """Build and sign a single-signer tx in direct mode."""
+    fee = fee or Fee()
+    tx = Tx(
+        msgs=msgs,
+        signer_infos=[SignerInfo(priv_key.public_key(), sequence)],
+        fee=fee,
+        signatures=[],
+        memo=memo,
+    )
+    doc = sign_doc_bytes(tx.body_bytes(), tx.auth_info_bytes(), chain_id, account_number)
+    tx.signatures = [priv_key.sign(doc)]
+    return tx
+
+
+def decode_tx(raw: bytes) -> Tx:
+    """TxDecoder analogue, IndexWrapper-aware
+    (ref: app/encoding/index_wrapper_decoder.go: wrapped txs decode to their
+    inner tx)."""
+    from celestia_tpu import blob as blob_pkg
+
+    wrapper, is_wrapped = blob_pkg.unmarshal_index_wrapper(raw)
+    if is_wrapped:
+        raw = wrapper.tx
+    return Tx.unmarshal(raw)

@@ -1,0 +1,112 @@
+"""x/bank analogue: balances + MsgSend + module accounts.
+
+Reference: stock SDK bank module wired with BondDenom=utia
+(app/default_overrides.go). Supports the send path used by txsim and fee
+deduction from the ante chain.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from celestia_tpu.appconsts import BOND_DENOM
+from celestia_tpu.blob import _field_bytes, _parse_fields, _require_wt, read_uvarint, uvarint
+from celestia_tpu.tx import register_msg
+
+BALANCE_PREFIX = b"bank/balance/"
+SUPPLY_KEY = b"bank/supply/"
+
+FEE_COLLECTOR = "fee_collector"
+MINT_MODULE = "mint"
+BONDED_POOL = "bonded_tokens_pool"
+
+
+def _balance_key(address: str, denom: str) -> bytes:
+    return BALANCE_PREFIX + address.encode() + b"/" + denom.encode()
+
+
+class BankKeeper:
+    def __init__(self, store):
+        self.store = store
+
+    def get_balance(self, address: str, denom: str = BOND_DENOM) -> int:
+        raw = self.store.get(_balance_key(address, denom))
+        return int.from_bytes(raw, "big") if raw else 0
+
+    def set_balance(self, address: str, amount: int, denom: str = BOND_DENOM) -> None:
+        if amount < 0:
+            raise ValueError("negative balance")
+        self.store.set(_balance_key(address, denom), amount.to_bytes(16, "big"))
+
+    def send(self, from_addr: str, to_addr: str, amount: int, denom: str = BOND_DENOM) -> None:
+        if amount < 0:
+            raise ValueError("negative send amount")
+        bal = self.get_balance(from_addr, denom)
+        if bal < amount:
+            raise ValueError(
+                f"insufficient funds: {from_addr} has {bal}{denom}, needs {amount}"
+            )
+        self.set_balance(from_addr, bal - amount, denom)
+        self.set_balance(to_addr, self.get_balance(to_addr, denom) + amount, denom)
+
+    def mint(self, to_addr: str, amount: int, denom: str = BOND_DENOM) -> None:
+        self.set_balance(to_addr, self.get_balance(to_addr, denom) + amount, denom)
+        supply_key = SUPPLY_KEY + denom.encode()
+        raw = self.store.get(supply_key)
+        supply = int.from_bytes(raw, "big") if raw else 0
+        self.store.set(supply_key, (supply + amount).to_bytes(16, "big"))
+
+    def total_supply(self, denom: str = BOND_DENOM) -> int:
+        raw = self.store.get(SUPPLY_KEY + denom.encode())
+        return int.from_bytes(raw, "big") if raw else 0
+
+
+URL_MSG_SEND = "/cosmos.bank.v1beta1.MsgSend"
+
+
+@register_msg(URL_MSG_SEND)
+@dataclasses.dataclass
+class MsgSend:
+    from_address: str
+    to_address: str
+    amount: int
+    denom: str = BOND_DENOM
+
+    def marshal(self) -> bytes:
+        coin = _field_bytes(1, self.denom.encode()) + _field_bytes(
+            2, str(self.amount).encode()
+        )
+        return (
+            _field_bytes(1, self.from_address.encode())
+            + _field_bytes(2, self.to_address.encode())
+            + _field_bytes(3, coin)
+        )
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgSend":
+        m = cls("", "", 0)
+        for tag, wt, val in _parse_fields(raw):
+            if tag == 1:
+                _require_wt(wt, 2, tag)
+                m.from_address = bytes(val).decode()
+            elif tag == 2:
+                _require_wt(wt, 2, tag)
+                m.to_address = bytes(val).decode()
+            elif tag == 3:
+                _require_wt(wt, 2, tag)
+                for t2, w2, v2 in _parse_fields(bytes(val)):
+                    if t2 == 1:
+                        _require_wt(w2, 2, t2)
+                        m.denom = bytes(v2).decode()
+                    elif t2 == 2:
+                        _require_wt(w2, 2, t2)
+                        m.amount = int(bytes(v2).decode())
+        return m
+
+    def validate_basic(self) -> None:
+        from celestia_tpu.crypto import bech32_decode
+
+        bech32_decode(self.from_address)
+        bech32_decode(self.to_address)
+        if self.amount <= 0:
+            raise ValueError("send amount must be positive")

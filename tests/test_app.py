@@ -1,0 +1,308 @@
+"""State-machine tests (reference model: app/test/*_test.go — block
+production, proposal consistency, CheckTx admission, ante failures,
+upgrade coordination)."""
+
+import pytest
+
+from celestia_tpu import blob as blob_pkg
+from celestia_tpu import namespace as ns
+from celestia_tpu.app import App
+from celestia_tpu.app.app import ProposalBlockData
+from celestia_tpu.crypto import PrivateKey
+from celestia_tpu.tx import Fee, sign_tx
+from celestia_tpu.x.bank import MsgSend
+from celestia_tpu.x.blob.types import estimate_gas, new_msg_pay_for_blobs
+from celestia_tpu.x.mint import calculate_inflation_rate, ONE
+from celestia_tpu.x.upgrade import MsgVersionChange, Plan, Schedule
+
+ALICE = PrivateKey.from_secret(b"alice")
+BOB = PrivateKey.from_secret(b"bob")
+
+
+def fresh_app(**kwargs) -> App:
+    app = App(**kwargs)
+    app.init_chain(
+        {ALICE.bech32_address(): 10_000_000_000, BOB.bech32_address(): 5_000_000},
+        genesis_time=0.0,
+    )
+    # commit the (empty) first block so txs are accepted
+    p0 = app.prepare_proposal([])
+    assert app.process_proposal(p0)
+    app.begin_block(15.0)
+    app.end_block()
+    app.commit()
+    return app
+
+
+def make_pfb_tx(app: App, key: PrivateKey, blob_data: bytes, sub_id=b"ns1") -> bytes:
+    b = blob_pkg.new_blob(ns.new_v0(sub_id), blob_data, 0)
+    acc = app.accounts.get_account(key.bech32_address())
+    msg = new_msg_pay_for_blobs(key.bech32_address(), b)
+    gas = estimate_gas([len(blob_data)])
+    tx = sign_tx(
+        key, [msg], app.chain_id, acc.account_number, acc.sequence,
+        Fee(amount=gas, gas_limit=gas),
+    )
+    return blob_pkg.marshal_blob_tx(tx.marshal(), [b])
+
+
+def make_send_tx(app: App, key: PrivateKey, to: str, amount: int, seq_offset=0) -> bytes:
+    acc = app.accounts.get_account(key.bech32_address())
+    tx = sign_tx(
+        key, [MsgSend(key.bech32_address(), to, amount)], app.chain_id,
+        acc.account_number, acc.sequence + seq_offset,
+        Fee(amount=200_000, gas_limit=200_000),
+    )
+    return tx.marshal()
+
+
+def run_block(app: App, txs: list[bytes]) -> ProposalBlockData:
+    block = app.prepare_proposal(txs)
+    assert app.process_proposal(block)
+    app.begin_block(app.block_time + 15.0)
+    for t in block.txs:
+        r = app.deliver_tx(t)
+        assert r.code == 0, r.log
+    app.end_block()
+    app.commit()
+    return block
+
+
+class TestBlockProduction:
+    def test_first_block_empty(self):
+        app = App()
+        app.init_chain({})
+        block = app.prepare_proposal([b"garbage-tx"])
+        assert block.txs == []
+        assert block.square_size == 1
+
+    def test_pfb_block(self):
+        app = fresh_app()
+        block = run_block(app, [make_pfb_tx(app, ALICE, b"\x01" * 5000)])
+        assert len(block.txs) == 1
+        assert app.height == 2
+
+    def test_send_and_pfb_ordering(self):
+        """Blob txs are always laid out after normal txs."""
+        app = fresh_app()
+        pfb = make_pfb_tx(app, ALICE, b"\x02" * 100)
+        send = make_send_tx(app, BOB, ALICE.bech32_address(), 777)
+        block = app.prepare_proposal([pfb, send])
+        assert len(block.txs) == 2
+        _, is_blob_first = blob_pkg.unmarshal_blob_tx(block.txs[0])
+        _, is_blob_second = blob_pkg.unmarshal_blob_tx(block.txs[1])
+        assert not is_blob_first and is_blob_second
+
+    def test_balance_transfer(self):
+        app = fresh_app()
+        before = app.bank.get_balance(ALICE.bech32_address())
+        run_block(app, [make_send_tx(app, BOB, ALICE.bech32_address(), 12345)])
+        assert app.bank.get_balance(ALICE.bech32_address()) == before + 12345
+
+    def test_app_hash_changes_per_block(self):
+        app = fresh_app()
+        h1 = app.store.app_hashes[app.store.version]
+        run_block(app, [make_send_tx(app, BOB, ALICE.bech32_address(), 1)])
+        h2 = app.store.app_hashes[app.store.version]
+        assert h1 != h2
+
+
+class TestCheckTx:
+    def test_valid_pfb(self):
+        app = fresh_app()
+        assert app.check_tx(make_pfb_tx(app, ALICE, b"\x01" * 100)).code == 0
+
+    def test_pfb_without_blobs_rejected(self):
+        app = fresh_app()
+        acc = app.accounts.get_account(ALICE.bech32_address())
+        msg = new_msg_pay_for_blobs(
+            ALICE.bech32_address(), blob_pkg.new_blob(ns.new_v0(b"xxxx"), b"d", 0)
+        )
+        tx = sign_tx(ALICE, [msg], app.chain_id, acc.account_number, acc.sequence,
+                     Fee(amount=100_000, gas_limit=100_000))
+        res = app.check_tx(tx.marshal())  # bare tx, no BlobTx envelope
+        assert res.code != 0
+        assert "ErrNoBlobs" in res.log
+
+    def test_wrong_sequence_rejected(self):
+        app = fresh_app()
+        res = app.check_tx(make_send_tx(app, BOB, ALICE.bech32_address(), 1, seq_offset=3))
+        assert res.code != 0
+        assert "sequence mismatch" in res.log
+
+    def test_bad_signature_rejected(self):
+        app = fresh_app()
+        raw = bytearray(make_send_tx(app, BOB, ALICE.bech32_address(), 1))
+        raw[-5] ^= 0xFF  # corrupt signature bytes
+        res = app.check_tx(bytes(raw))
+        assert res.code != 0
+
+    def test_insufficient_funds_rejected(self):
+        app = fresh_app()
+        res = app.check_tx(make_send_tx(app, BOB, ALICE.bech32_address(), 10**15))
+        assert res.code == 0  # check passes; failure happens on delivery
+        block = app.prepare_proposal([make_send_tx(app, BOB, ALICE.bech32_address(), 10**15)])
+        app.process_proposal(block)
+        app.begin_block(app.block_time + 15)
+        r = app.deliver_tx(block.txs[0])
+        assert r.code != 0
+        assert "insufficient funds" in r.log
+        app.end_block()
+        app.commit()
+
+    def test_commitment_tampering_rejected(self):
+        app = fresh_app()
+        b = blob_pkg.new_blob(ns.new_v0(b"tttt"), b"\x01" * 100, 0)
+        acc = app.accounts.get_account(ALICE.bech32_address())
+        msg = new_msg_pay_for_blobs(ALICE.bech32_address(), b)
+        msg.share_commitments[0] = b"\x00" * 32
+        tx = sign_tx(ALICE, [msg], app.chain_id, acc.account_number, acc.sequence,
+                     Fee(amount=100_000, gas_limit=100_000))
+        res = app.check_tx(blob_pkg.marshal_blob_tx(tx.marshal(), [b]))
+        assert res.code != 0
+        assert "commitment" in res.log
+
+
+class TestTxSecurity:
+    def test_fee_payer_must_be_signer(self):
+        app = fresh_app()
+        acc = app.accounts.get_account(BOB.bech32_address())
+        tx = sign_tx(
+            BOB, [MsgSend(BOB.bech32_address(), ALICE.bech32_address(), 1)],
+            app.chain_id, acc.account_number, acc.sequence,
+            Fee(amount=100_000, gas_limit=100_000, payer=ALICE.bech32_address()),
+        )
+        res = app.check_tx(tx.marshal())
+        assert res.code != 0
+        assert "not a tx signer" in res.log
+
+    def test_signature_covers_raw_body_bytes(self):
+        """Appending an unknown field to the body must invalidate the sig."""
+        from celestia_tpu.tx import Tx, _field_bytes
+
+        app = fresh_app()
+        raw = make_send_tx(app, BOB, ALICE.bech32_address(), 1)
+        tx = Tx.unmarshal(raw)
+        # graft an unknown field onto the transmitted body bytes
+        tampered = Tx.unmarshal(raw)
+        tampered._raw_body = tx.body_bytes() + _field_bytes(15, b"junk")
+        res = app.check_tx(tampered.marshal())
+        assert res.code != 0
+
+    def test_empty_msg_roundtrip(self):
+        """Msgs that marshal to zero bytes must survive the codec."""
+        from celestia_tpu.tx import Tx, decode_tx
+
+        raw = MsgVersionChange.as_tx_bytes(0)
+        tx = decode_tx(raw)
+        assert isinstance(tx.msgs[0], MsgVersionChange)
+        assert tx.msgs[0].version == 0
+
+
+class TestProcessProposal:
+    def test_tampered_dah_rejected(self):
+        app = fresh_app()
+        block = app.prepare_proposal([make_pfb_tx(app, ALICE, b"\x05" * 200)])
+        bad = ProposalBlockData(txs=block.txs, square_size=block.square_size,
+                                hash=b"\x00" * 32)
+        assert not app.process_proposal(bad)
+
+    def test_wrong_square_size_rejected(self):
+        app = fresh_app()
+        block = app.prepare_proposal([make_pfb_tx(app, ALICE, b"\x05" * 200)])
+        bad = ProposalBlockData(txs=block.txs, square_size=block.square_size * 2,
+                                hash=block.hash)
+        assert not app.process_proposal(bad)
+
+    def test_non_blob_tx_with_pfb_rejected(self):
+        app = fresh_app()
+        acc = app.accounts.get_account(ALICE.bech32_address())
+        msg = new_msg_pay_for_blobs(
+            ALICE.bech32_address(), blob_pkg.new_blob(ns.new_v0(b"xxxx"), b"d", 0)
+        )
+        tx = sign_tx(ALICE, [msg], app.chain_id, acc.account_number, acc.sequence,
+                     Fee(amount=100_000, gas_limit=100_000))
+        # bare PFB tx (no blob envelope) inside a proposal
+        from celestia_tpu import square as square_pkg
+
+        data_square, txs = square_pkg.build([tx.marshal()], app.app_version, 64)
+        from celestia_tpu import da
+        from celestia_tpu.shares import to_bytes
+
+        eds = da.extend_shares(to_bytes(data_square))
+        dah = da.new_data_availability_header(eds)
+        bad = ProposalBlockData(txs=txs, square_size=square_pkg.square_size(len(data_square)),
+                                hash=dah.hash())
+        assert not app.process_proposal(bad)
+
+
+class TestUpgrade:
+    def test_scheduled_upgrade(self):
+        schedule = Schedule([Plan(start=3, end=10, version=2)])
+        app = App(upgrade_schedule={"celestia-tpu-1": schedule})
+        app.init_chain({ALICE.bech32_address(): 10_000_000_000})
+        # blocks 1 and 2 (first block empty by design)
+        run_block(app, [])
+        run_block(app, [])
+        assert app.app_version == 1
+        # block 3: height+1 == 3 is inside the window -> proposer injects msg
+        block = app.prepare_proposal([])
+        assert len(block.txs) == 1
+        assert app.process_proposal(block)
+        app.begin_block(app.block_time + 15)
+        r = app.deliver_tx(block.txs[0])
+        assert r.code == 0
+        app.end_block()
+        app.commit()
+        assert app.app_version == 2
+
+    def test_upgrade_msg_not_first_rejected(self):
+        app = fresh_app()
+        upgrade_tx = MsgVersionChange.as_tx_bytes(2)
+        send = make_send_tx(app, BOB, ALICE.bech32_address(), 1)
+        from celestia_tpu import da
+        from celestia_tpu import square as square_pkg
+        from celestia_tpu.shares import to_bytes
+
+        txs = [send, upgrade_tx]  # upgrade NOT first
+        data_square, txs2 = square_pkg.build(txs, app.app_version, 64)
+        eds = da.extend_shares(to_bytes(data_square))
+        dah = da.new_data_availability_header(eds)
+        bad = ProposalBlockData(
+            txs=txs2, square_size=square_pkg.square_size(len(data_square)), hash=dah.hash()
+        )
+        assert not app.process_proposal(bad)
+
+
+class TestMint:
+    def test_inflation_schedule(self):
+        assert calculate_inflation_rate(0) == 80 * 10**15
+        assert calculate_inflation_rate(1) == 72 * 10**15
+        # floor at 1.5%
+        assert calculate_inflation_rate(100) == 15 * 10**15
+
+    def test_block_provision_minted(self):
+        app = fresh_app()
+        from celestia_tpu.x.bank import FEE_COLLECTOR
+
+        before = app.bank.get_balance(FEE_COLLECTOR)
+        run_block(app, [])
+        after = app.bank.get_balance(FEE_COLLECTOR)
+        minted = after - before
+        # 15s of 8% on ~10B supply ~= 10e9*0.08*15/31556952 ~= 380
+        assert 300 < minted < 500, minted
+
+
+class TestStateStore:
+    def test_snapshot_restore(self):
+        from celestia_tpu.state import StateStore
+
+        app = fresh_app()
+        run_block(app, [make_send_tx(app, BOB, ALICE.bech32_address(), 99)])
+        snap = app.store.snapshot()
+        restored = StateStore.restore(snap)
+        assert restored.version == app.store.version
+        assert (
+            restored.app_hashes[restored.version]
+            == app.store.app_hashes[app.store.version]
+        )
