@@ -71,6 +71,10 @@ class App:
         self.min_gas_price = 0.0
         self._deliver_store = None
         self._deliver_ctx = None
+        # Persistent CheckTx state branch (baseapp checkState): successive
+        # mempool checks see each other's sequence increments; reset at
+        # Commit so it re-branches from the new committed state.
+        self._check_store = None
 
     # ------------------------------------------------------------------ #
     # genesis
@@ -113,22 +117,32 @@ class App:
         )
 
     def _extend_and_hash(self, data_square) -> tuple:
-        """The hot path: square -> EDS -> DAH. ref: app/prepare_proposal.go:95"""
-        if self.use_tpu:
-            import numpy as np
+        """The hot path: square -> EDS -> DAH. ref: app/prepare_proposal.go:95
 
-            from celestia_tpu.ops import extend_tpu
+        Backend order: TPU (use_tpu=True) > native C++ runtime > numpy
+        reference path — all byte-identical.
+        """
+        from celestia_tpu import native
+
+        if self.use_tpu or native.available():
+            import numpy as np
 
             k = square_pkg.square_size(len(data_square))
             arr = np.frombuffer(
                 b"".join(s.data for s in data_square), dtype=np.uint8
             ).reshape(k, k, appconsts.SHARE_SIZE)
-            eds, rows, cols, dah_hash = extend_tpu.extend_and_root_device(arr)
-            dah = da.DataAvailabilityHeader(
-                [r.tobytes() for r in rows], [c.tobytes() for c in cols]
-            )
-            assert dah.hash() == dah_hash.tobytes()
-            return eds, dah
+            if self.use_tpu:
+                from celestia_tpu.ops import extend_tpu
+
+                eds_arr, rows, cols, dah_hash = extend_tpu.extend_and_root_device(arr)
+                dah = da.DataAvailabilityHeader(
+                    [r.tobytes() for r in rows], [c.tobytes() for c in cols]
+                )
+                assert dah.hash() == dah_hash.tobytes()
+            else:
+                eds_arr, rows, cols, native_dah = native.extend_and_root_native(arr)
+                dah = da.DataAvailabilityHeader(rows, cols, _hash=native_dah)
+            return da.ExtendedDataSquare(eds_arr, k), dah
         eds = da.extend_shares(to_bytes(data_square))
         return eds, da.new_data_availability_header(eds)
 
@@ -151,9 +165,12 @@ class App:
                 tx = Tx.unmarshal(btx.tx)
                 inner_raw = btx.tx
 
-            store = self.store.branch()
-            ctx = self._new_ctx(store, mode)
+            if self._check_store is None:
+                self._check_store = self.store.branch()
+            tx_branch = self._check_store.branch()
+            ctx = self._new_ctx(tx_branch, mode)
             ctx = self._ante()(ctx, tx, len(inner_raw))
+            tx_branch.write()  # persist into check state (not committed state)
             return TxResult(
                 code=0,
                 gas_wanted=tx.fee.gas_limit,
@@ -266,7 +283,11 @@ class App:
         self.block_time = block_time if block_time is not None else self.block_time + 15.0
         self._deliver_store = self.store.branch()
         self._deliver_ctx = self._new_ctx(self._deliver_store, ExecMode.DELIVER)
-        self.mint.begin_blocker(self._deliver_ctx)
+        # BeginBlock state effects go through the deliver branch — they must
+        # only reach committed state at Commit (crash-replay determinism).
+        MintKeeper(
+            self._deliver_store, BankKeeper(self._deliver_store)
+        ).begin_blocker(self._deliver_ctx)
 
     def deliver_tx(self, raw_tx: bytes) -> TxResult:
         """ref: app/deliver_tx.go:10-23"""
@@ -286,21 +307,37 @@ class App:
             self.upgrade.prepare_upgrade_at_end_block(version)
             return TxResult(code=0, log="version change armed")
 
-        tx_store = self._deliver_store.branch()
-        ctx = dataclasses.replace(self._deliver_ctx, store=tx_store)
+        # Ante effects (fee deduction, sequence increment) persist even when
+        # message execution fails — baseapp writes the ante cache before
+        # running msgs; otherwise failed txs are free and replayable.
+        ante_store = self._deliver_store.branch()
+        ctx = dataclasses.replace(self._deliver_ctx, store=ante_store, events=[])
         try:
             ctx = self._ante()(ctx, tx, len(inner))
+        except Exception as e:  # noqa: BLE001
+            return TxResult(
+                code=1, log=str(e),
+                gas_wanted=tx.fee.gas_limit, gas_used=ctx.gas_meter.consumed,
+            )
+        ante_store.write()
+
+        msg_store = self._deliver_store.branch()
+        msg_ctx = dataclasses.replace(ctx, store=msg_store)
+        try:
             for msg in tx.msgs:
-                self._route_msg(ctx, msg)
-            tx_store.write()
+                self._route_msg(msg_ctx, msg)
+            msg_store.write()
             return TxResult(
                 code=0,
                 gas_wanted=tx.fee.gas_limit,
-                gas_used=ctx.gas_meter.consumed,
-                events=ctx.events,
+                gas_used=msg_ctx.gas_meter.consumed,
+                events=msg_ctx.events,
             )
-        except Exception as e:  # noqa: BLE001
-            return TxResult(code=1, log=str(e))
+        except Exception as e:  # noqa: BLE001 — msg effects roll back,
+            return TxResult(  # ante effects (fees, gas) stay
+                code=1, log=str(e),
+                gas_wanted=tx.fee.gas_limit, gas_used=msg_ctx.gas_meter.consumed,
+            )
 
     def _route_msg(self, ctx: Context, msg) -> None:
         if isinstance(msg, MsgPayForBlobs):
@@ -310,6 +347,8 @@ class App:
             BankKeeper(ctx.store).send(
                 msg.from_address, msg.to_address, msg.amount, msg.denom
             )
+            # receiving funds creates the account (SDK bank/auth behavior)
+            AccountKeeper(ctx.store).get_or_create(msg.to_address)
         else:
             raise ValueError(f"unroutable message type {type(msg).__name__}")
 
@@ -329,6 +368,7 @@ class App:
             self.app_version = self.upgrade.pending_app_version
             self.upgrade.mark_upgrade_complete()
         self.height += 1
+        self._check_store = None  # re-branch check state from committed state
         return self.store.commit()
 
     # ------------------------------------------------------------------ #

@@ -1,0 +1,202 @@
+"""celestia-tpu CLI — the celestia-appd analogue.
+
+Reference semantics: cmd/celestia-appd/cmd/root.go:121-151 (init / start /
+keys / tx / query command tree, env prefix CELESTIA, default home
+~/.celestia-app). Run as `python -m celestia_tpu.cli <command>`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import time
+
+DEFAULT_HOME = os.environ.get(
+    "CELESTIA_HOME", str(pathlib.Path.home() / ".celestia-tpu")
+)
+
+
+def _home(args) -> pathlib.Path:
+    home = pathlib.Path(args.home)
+    home.mkdir(parents=True, exist_ok=True)
+    return home
+
+
+def _load_keys(home: pathlib.Path) -> dict:
+    path = home / "keys.json"
+    return json.loads(path.read_text()) if path.exists() else {}
+
+
+def _save_keys(home: pathlib.Path, keys: dict) -> None:
+    (home / "keys.json").write_text(json.dumps(keys, indent=2))
+
+
+def cmd_init(args):
+    from celestia_tpu.crypto import PrivateKey
+
+    home = _home(args)
+    keys = _load_keys(home)
+    if "validator" not in keys:
+        secret = os.urandom(32)
+        keys["validator"] = secret.hex()
+        _save_keys(home, keys)
+    key = PrivateKey.from_secret(bytes.fromhex(keys["validator"]))
+    genesis = {
+        "chain_id": args.chain_id,
+        "genesis_time": time.time(),
+        "accounts": {key.bech32_address(): 1_000_000_000_000},
+    }
+    (home / "genesis.json").write_text(json.dumps(genesis, indent=2))
+    print(f"initialized chain {args.chain_id} at {home}")
+    print(f"validator address: {key.bech32_address()}")
+
+
+def _build_node(home: pathlib.Path):
+    from celestia_tpu.app import App
+    from celestia_tpu.node import Node
+
+    genesis = json.loads((home / "genesis.json").read_text())
+    if (home / "meta.json").exists():
+        return Node.load(str(home))
+    app = App(chain_id=genesis["chain_id"])
+    app.init_chain(genesis["accounts"], genesis_time=genesis["genesis_time"])
+    return Node(app, home=str(home))
+
+
+def cmd_start(args):
+    from celestia_tpu.node.rpc import RpcServer
+
+    home = _home(args)
+    node = _build_node(home)
+    server = RpcServer(node, port=args.port)
+    server.start()
+    print(f"node started: chain {node.app.chain_id} height {node.latest_height()} "
+          f"rpc http://127.0.0.1:{server.port}")
+    try:
+        while True:
+            time.sleep(args.block_time)
+            block = node.produce_block()
+            node.save_snapshot()
+            print(f"height {block.height} txs {len(block.txs)} "
+                  f"square {block.square_size} data {block.data_hash.hex()[:16]}")
+    except KeyboardInterrupt:
+        server.stop()
+        node.save_snapshot()
+        print("node stopped")
+
+
+def cmd_keys(args):
+    from celestia_tpu.crypto import PrivateKey
+
+    home = _home(args)
+    keys = _load_keys(home)
+    if args.keys_cmd == "add":
+        if args.name in keys:
+            print(f"key {args.name} already exists", file=sys.stderr)
+            sys.exit(1)
+        keys[args.name] = os.urandom(32).hex()
+        _save_keys(home, keys)
+    if args.keys_cmd in ("add", "show"):
+        key = PrivateKey.from_secret(bytes.fromhex(keys[args.name]))
+        print(f"{args.name}: {key.bech32_address()}")
+    elif args.keys_cmd == "list":
+        for name, secret in keys.items():
+            key = PrivateKey.from_secret(bytes.fromhex(secret))
+            print(f"{name}: {key.bech32_address()}")
+
+
+def _rpc(args, method, path, body=None):
+    import urllib.request
+
+    url = f"http://127.0.0.1:{args.port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def cmd_tx(args):
+    from celestia_tpu import blob as blob_pkg
+    from celestia_tpu import namespace as ns
+    from celestia_tpu.crypto import PrivateKey
+    from celestia_tpu.tx import Fee, sign_tx
+    from celestia_tpu.x.bank import MsgSend
+    from celestia_tpu.x.blob.types import estimate_gas, new_msg_pay_for_blobs
+
+    home = _home(args)
+    keys = _load_keys(home)
+    key = PrivateKey.from_secret(bytes.fromhex(keys[args.from_key]))
+    account = _rpc(args, "GET", f"/account/{key.bech32_address()}")
+    if "error" in account:
+        print(account["error"], file=sys.stderr)
+        sys.exit(1)
+
+    if args.tx_cmd == "pfb":
+        data = pathlib.Path(args.file).read_bytes() if args.file else os.urandom(args.size)
+        b = blob_pkg.new_blob(ns.new_v0(bytes.fromhex(args.namespace)), data, 0)
+        msg = new_msg_pay_for_blobs(key.bech32_address(), b)
+        gas = estimate_gas([len(data)])
+        tx = sign_tx(key, [msg], args.chain_id, account["account_number"],
+                     account["sequence"], Fee(amount=gas, gas_limit=gas))
+        raw = blob_pkg.marshal_blob_tx(tx.marshal(), [b])
+    elif args.tx_cmd == "send":
+        msg = MsgSend(key.bech32_address(), args.to, args.amount)
+        tx = sign_tx(key, [msg], args.chain_id, account["account_number"],
+                     account["sequence"], Fee(amount=200_000, gas_limit=200_000))
+        raw = tx.marshal()
+    result = _rpc(args, "POST", "/broadcast_tx", {"tx": raw.hex()})
+    print(json.dumps(result))
+
+
+def cmd_query(args):
+    print(json.dumps(_rpc(args, "GET", args.path)))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="celestia-tpu")
+    parser.add_argument("--home", default=DEFAULT_HOME)
+    parser.add_argument("--port", type=int, default=26657)
+    parser.add_argument("--chain-id", default="celestia-tpu-1")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("init")
+    p_start = sub.add_parser("start")
+    p_start.add_argument("--block-time", type=float, default=15.0)
+
+    p_keys = sub.add_parser("keys")
+    p_keys.add_argument("keys_cmd", choices=["add", "list", "show"])
+    p_keys.add_argument("name", nargs="?", default="validator")
+
+    p_tx = sub.add_parser("tx")
+    tx_sub = p_tx.add_subparsers(dest="tx_cmd", required=True)
+    p_pfb = tx_sub.add_parser("pfb")
+    p_pfb.add_argument("--from", dest="from_key", default="validator")
+    # default: ascii "testing123" — all-zero-prefixed ids fall in the
+    # primary-reserved range and are rejected for blobs
+    p_pfb.add_argument("--namespace", default="74657374696e67313233",
+                       help="up to 10 user bytes, hex")
+    p_pfb.add_argument("--size", type=int, default=1000)
+    p_pfb.add_argument("--file", default=None)
+    p_send = tx_sub.add_parser("send")
+    p_send.add_argument("--from", dest="from_key", default="validator")
+    p_send.add_argument("to")
+    p_send.add_argument("amount", type=int)
+
+    p_query = sub.add_parser("query")
+    p_query.add_argument("path")
+
+    args = parser.parse_args(argv)
+    {
+        "init": cmd_init,
+        "start": cmd_start,
+        "keys": cmd_keys,
+        "tx": cmd_tx,
+        "query": cmd_query,
+    }[args.cmd](args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""EDS repair (erasure decoding) — the rsmt2d.Repair capability
+(BASELINE config 4: 256x256 EDS with 25% of shares erased).
+
+Design: the Leopard code is linear (parity = M @ data over GF(256), M =
+ops.gf256.encode_matrix), so repairing one axis with >= k of its 2k cells
+present is a k x k linear solve: select k available positions, stack unit
+rows (data cells) / M rows (parity cells) into A, then
+data = A^-1 @ available, parity = M @ data. Erasures can leave an axis
+under-determined until the crossing axis supplies cells, so rows and
+columns are repaired iteratively to a fixed point — the same strategy
+rsmt2d uses (invoked from pkg/da/data_availability_header.go:74 context).
+
+The per-axis solves are data-dependent (each axis has its own erasure
+pattern), so pattern analysis, matrix inversion, and the byte-wide
+recovery (vectorized table-lookup GF matmuls) run on the host (SURVEY §7
+hard-part (4)). A device path was evaluated and rejected for now: each
+axis needs its own (8k x 8k) decode bit-matrix, and shipping ~270 MB of
+per-pattern matrices per sweep costs far more than the host matmul; an
+on-device GF Gauss-Jordan would remove the transfer and is future work.
+
+Repaired squares are verified against the DAH row/col roots when provided.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from celestia_tpu.appconsts import SHARE_SIZE
+from celestia_tpu.ops import gf256
+
+
+class UnrepairableError(Exception):
+    """Too many erasures: no axis with >= k available cells made progress."""
+
+
+def _axis_decode_matrix(avail_idx: np.ndarray, k: int) -> np.ndarray:
+    """(k,) available positions (in 0..2k-1, sorted, first k used) ->
+    (k, k) matrix A with A @ original_data = available_cells."""
+    m = gf256.encode_matrix(k)
+    a = np.zeros((k, k), dtype=np.uint8)
+    for row, pos in enumerate(avail_idx):
+        if pos < k:
+            a[row, pos] = 1
+        else:
+            a[row] = m[pos - k]
+    return a
+
+
+def _solve_axis(cells: np.ndarray, present: np.ndarray, k: int) -> np.ndarray:
+    """cells (2k, B) with `present` mask -> fully repaired (2k, B)."""
+    avail = np.flatnonzero(present)[:k]
+    a = _axis_decode_matrix(avail, k)
+    data = gf256.gf_matmul(gf256.gf_inverse(a), cells[avail])
+    parity = gf256.leopard_encode(data)
+    return np.concatenate([data, parity], axis=0)
+
+
+def repair(
+    shares: np.ndarray,
+    present: np.ndarray,
+    row_roots: list[bytes] | None = None,
+    col_roots: list[bytes] | None = None,
+) -> np.ndarray:
+    """Repair a (2k, 2k, 512) EDS with boolean presence mask (2k, 2k).
+
+    Erased cells' contents are ignored. Returns the full EDS; raises
+    UnrepairableError when the erasure pattern is not decodable and
+    ValueError when recomputed roots mismatch the provided DAH roots.
+    """
+    width = shares.shape[0]
+    k = width // 2
+    eds = np.array(shares, dtype=np.uint8, copy=True)
+    eds[~present] = 0
+    present = present.copy()
+
+    solver = _solve_sweep_host
+    while not present.all():
+        progress = False
+        # rows, then columns
+        for transpose in (False, True):
+            view = eds.transpose(1, 0, 2) if transpose else eds
+            mask = present.T if transpose else present
+            todo = [
+                i
+                for i in range(width)
+                if not mask[i].all() and mask[i].sum() >= k
+            ]
+            if todo:
+                solver(view, mask, todo, k)
+                progress = True
+        if not progress:
+            raise UnrepairableError(
+                f"impossible to recover: {int((~present).sum())} cells still missing"
+            )
+
+    if row_roots is not None or col_roots is not None:
+        _verify_roots(eds, k, row_roots, col_roots)
+    return eds
+
+
+def _solve_sweep_host(view: np.ndarray, mask: np.ndarray, todo: list[int], k: int) -> None:
+    for i in todo:
+        view[i] = _solve_axis(view[i], mask[i], k)
+        mask[i] = True
+
+
+def _verify_roots(eds: np.ndarray, k: int, row_roots, col_roots) -> None:
+    from celestia_tpu import da
+
+    square = da.ExtendedDataSquare(eds, k)
+    if row_roots is not None:
+        got = square.row_roots()
+        if got != list(row_roots):
+            raise ValueError("repaired row roots do not match DAH")
+    if col_roots is not None:
+        got = square.col_roots()
+        if got != list(col_roots):
+            raise ValueError("repaired column roots do not match DAH")

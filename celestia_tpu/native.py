@@ -1,0 +1,126 @@
+"""ctypes bindings for the native (C++) host runtime in native/.
+
+The library is compiled on first use with g++ -O3 (no pip/pkg deps; the
+toolchain is part of the base image) and cached under native/build/.
+Falls back cleanly — callers check `available()` and use the numpy host
+path (celestia_tpu.da) when the toolchain is missing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+
+import numpy as np
+
+from celestia_tpu.appconsts import SHARE_SIZE
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
+_BUILD_DIR = _NATIVE_DIR / "build"
+_LIB_PATH = _BUILD_DIR / "libcelestia_native.so"
+
+_lib = None
+_load_error: str | None = None
+
+NMT_NODE_SIZE = 90
+
+
+def _build() -> None:
+    _BUILD_DIR.mkdir(exist_ok=True)
+    sources = [str(_NATIVE_DIR / "leopard.cc"), str(_NATIVE_DIR / "nmt.cc")]
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC",
+        "-o", str(_LIB_PATH), *sources,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _load():
+    global _lib, _load_error
+    if _lib is not None or _load_error is not None:
+        return _lib
+    try:
+        sources_mtime = max(
+            p.stat().st_mtime for p in (_NATIVE_DIR / "leopard.cc", _NATIVE_DIR / "nmt.cc")
+        )
+        if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < sources_mtime:
+            _build()
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib.leo_encode.argtypes = [
+            ctypes.c_int, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.eds_extend.argtypes = [
+            ctypes.c_int, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.eds_nmt_roots.argtypes = [
+            ctypes.c_int, ctypes.c_size_t, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.merkle_root.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_size_t, ctypes.c_char_p,
+        ]
+        _lib = lib
+    except Exception as e:  # noqa: BLE001 — toolchain may be absent
+        _load_error = str(e)
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def leo_encode(data: np.ndarray) -> np.ndarray:
+    """(k, shard_size) uint8 -> (k, shard_size) parity."""
+    lib = _load()
+    k, size = data.shape
+    if k & (k - 1):
+        raise ValueError("k must be a power of two")
+    out = ctypes.create_string_buffer(k * size)
+    lib.leo_encode(k, size, np.ascontiguousarray(data).tobytes(), out)
+    return np.frombuffer(out.raw, dtype=np.uint8).reshape(k, size).copy()
+
+
+def eds_extend(q0: np.ndarray) -> np.ndarray:
+    """(k, k, 512) uint8 -> (2k, 2k, 512) EDS."""
+    lib = _load()
+    k = q0.shape[0]
+    w = 2 * k
+    out = ctypes.create_string_buffer(w * w * SHARE_SIZE)
+    lib.eds_extend(k, SHARE_SIZE, np.ascontiguousarray(q0).tobytes(), out)
+    return np.frombuffer(out.raw, dtype=np.uint8).reshape(w, w, SHARE_SIZE).copy()
+
+
+def eds_nmt_roots(eds: np.ndarray) -> tuple[list[bytes], list[bytes]]:
+    """(2k, 2k, 512) EDS -> (row_roots, col_roots), 90-byte NMT roots."""
+    lib = _load()
+    w = eds.shape[0]
+    k = w // 2
+    rows = ctypes.create_string_buffer(w * NMT_NODE_SIZE)
+    cols = ctypes.create_string_buffer(w * NMT_NODE_SIZE)
+    lib.eds_nmt_roots(k, SHARE_SIZE, np.ascontiguousarray(eds).tobytes(), rows, cols)
+    row_roots = [rows.raw[i * NMT_NODE_SIZE : (i + 1) * NMT_NODE_SIZE] for i in range(w)]
+    col_roots = [cols.raw[i * NMT_NODE_SIZE : (i + 1) * NMT_NODE_SIZE] for i in range(w)]
+    return row_roots, col_roots
+
+
+def merkle_root(items: list[bytes]) -> bytes:
+    lib = _load()
+    if items:
+        sizes = {len(i) for i in items}
+        if len(sizes) != 1:
+            raise ValueError("merkle_root requires equal-size items")
+        item_size = sizes.pop()
+    else:
+        item_size = 0
+    out = ctypes.create_string_buffer(32)
+    lib.merkle_root(b"".join(items), len(items), item_size, out)
+    return out.raw
+
+
+def extend_and_root_native(shares: np.ndarray):
+    """Full native ExtendBlock: (k,k,512) -> (eds, row_roots, col_roots, dah)."""
+    eds = eds_extend(shares)
+    rows, cols = eds_nmt_roots(eds)
+    dah = merkle_root(rows + cols)
+    return eds, rows, cols, dah

@@ -1,0 +1,12 @@
+"""Single-process node shell: mempool, block production, block store.
+
+The reference's node is celestia-core (consensus+p2p) driving the app over
+ABCI (SURVEY §1 L0/L3). This package provides the single-validator
+equivalent used by the reference's own test strategy (testnode,
+test/util/testnode/full_node.go:70 boots one in-process validator with a
+local ABCI client): a Node that runs the full
+CheckTx -> PrepareProposal -> ProcessProposal -> Deliver -> Commit flow
+against a celestia_tpu.app.App, plus a block store with DAH per block.
+"""
+
+from .node import Block, Mempool, Node  # noqa: F401

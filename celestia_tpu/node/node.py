@@ -1,0 +1,226 @@
+"""Node: mempool + block production + block store."""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import pathlib
+import time
+
+from celestia_tpu.app import App
+from celestia_tpu.app.app import ProposalBlockData, TxResult
+
+MEMPOOL_TTL_BLOCKS = 5  # ref: app/default_overrides.go:237-245 (v1 mempool TTL)
+DEFAULT_MAX_TX_BYTES = 7_897_088  # max-square bytes, DefaultConsensusConfig
+
+
+def tx_hash(raw: bytes) -> bytes:
+    return hashlib.sha256(raw).digest()
+
+
+@dataclasses.dataclass
+class MempoolTx:
+    raw: bytes
+    priority: int
+    height_added: int
+
+
+class Mempool:
+    """Priority-ordered mempool with block-TTL eviction (the capability
+    surface of celestia-core's v1 prioritized mempool / CAT pool specs,
+    specs/src/specs/cat_pool.md)."""
+
+    def __init__(self, ttl_blocks: int = MEMPOOL_TTL_BLOCKS,
+                 max_tx_bytes: int = DEFAULT_MAX_TX_BYTES):
+        self.txs: dict[bytes, MempoolTx] = {}
+        self.ttl_blocks = ttl_blocks
+        self.max_tx_bytes = max_tx_bytes
+
+    def add(self, raw: bytes, priority: int, height: int) -> bytes:
+        if len(raw) > self.max_tx_bytes:
+            raise ValueError(f"tx exceeds max size {self.max_tx_bytes}")
+        key = tx_hash(raw)
+        if key not in self.txs:
+            self.txs[key] = MempoolTx(raw=raw, priority=priority, height_added=height)
+        return key
+
+    def remove(self, key: bytes) -> None:
+        self.txs.pop(key, None)
+
+    def reap(self, max_bytes: int | None = None) -> list[bytes]:
+        """Highest-priority txs first (stable within equal priority)."""
+        ordered = sorted(
+            self.txs.values(), key=lambda t: (-t.priority, t.height_added)
+        )
+        out: list[bytes] = []
+        total = 0
+        for t in ordered:
+            if max_bytes is not None and total + len(t.raw) > max_bytes:
+                continue
+            out.append(t.raw)
+            total += len(t.raw)
+        return out
+
+    def evict_expired(self, height: int) -> int:
+        expired = [
+            k for k, t in self.txs.items()
+            if height - t.height_added >= self.ttl_blocks
+        ]
+        for k in expired:
+            del self.txs[k]
+        return len(expired)
+
+    def __len__(self) -> int:
+        return len(self.txs)
+
+
+@dataclasses.dataclass
+class Block:
+    height: int
+    time: float
+    txs: list[bytes]
+    square_size: int
+    data_hash: bytes
+    app_hash: bytes
+    tx_results: list[TxResult] = dataclasses.field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "height": self.height,
+            "time": self.time,
+            "txs": [t.hex() for t in self.txs],
+            "square_size": self.square_size,
+            "data_hash": self.data_hash.hex(),
+            "app_hash": self.app_hash.hex(),
+            "tx_results": [
+                {"code": r.code, "log": r.log, "gas_used": r.gas_used}
+                for r in self.tx_results
+            ],
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Block":
+        return cls(
+            height=d["height"],
+            time=d["time"],
+            txs=[bytes.fromhex(t) for t in d["txs"]],
+            square_size=d["square_size"],
+            data_hash=bytes.fromhex(d["data_hash"]),
+            app_hash=bytes.fromhex(d["app_hash"]),
+            tx_results=[
+                TxResult(code=r["code"], log=r["log"], gas_used=r["gas_used"])
+                for r in d.get("tx_results", [])
+            ],
+        )
+
+
+class Node:
+    """One-validator chain driver over an App."""
+
+    def __init__(self, app: App, home: str | None = None):
+        self.app = app
+        self.mempool = Mempool()
+        self.blocks: dict[int, Block] = {}
+        self.tx_index: dict[bytes, tuple[int, int]] = {}  # hash -> (height, idx)
+        self.home = pathlib.Path(home) if home else None
+        if self.home:
+            (self.home / "blocks").mkdir(parents=True, exist_ok=True)
+
+    # --- mempool admission ---
+
+    def broadcast_tx(self, raw: bytes) -> TxResult:
+        res = self.app.check_tx(raw)
+        if res.code == 0:
+            self.mempool.add(raw, res.priority, self.app.height)
+        return res
+
+    # --- block production (the proposer+validator round) ---
+
+    def produce_block(self, block_time: float | None = None) -> Block:
+        block_time = block_time if block_time is not None else time.time()
+        proposal = self.app.prepare_proposal(self.mempool.reap())
+        if not self.app.process_proposal(proposal):
+            raise RuntimeError("node produced a proposal it cannot accept")
+
+        self.app.begin_block(block_time)
+        results = [self.app.deliver_tx(t) for t in proposal.txs]
+        self.app.end_block()
+        app_hash = self.app.commit()
+
+        block = Block(
+            height=self.app.height,
+            time=block_time,
+            txs=proposal.txs,
+            square_size=proposal.square_size,
+            data_hash=proposal.hash,
+            app_hash=app_hash,
+            tx_results=results,
+        )
+        self._store_block(block)
+
+        for i, raw in enumerate(proposal.txs):
+            key = tx_hash(raw)
+            self.mempool.remove(key)
+            self.tx_index[key] = (block.height, i)
+        self.mempool.evict_expired(self.app.height)
+        return block
+
+    def _store_block(self, block: Block) -> None:
+        self.blocks[block.height] = block
+        if self.home:
+            path = self.home / "blocks" / f"{block.height}.json"
+            path.write_text(json.dumps(block.to_json()))
+
+    # --- queries ---
+
+    def get_block(self, height: int) -> Block | None:
+        return self.blocks.get(height)
+
+    def get_tx(self, key: bytes):
+        """Returns (block, tx_index) or None."""
+        loc = self.tx_index.get(key)
+        if loc is None:
+            return None
+        return self.blocks[loc[0]], loc[1]
+
+    def latest_height(self) -> int:
+        return self.app.height
+
+    # --- checkpoint / resume ---
+
+    def save_snapshot(self) -> None:
+        if not self.home:
+            raise ValueError("node has no home directory")
+        (self.home / "state.json").write_bytes(self.app.store.snapshot())
+        meta = {
+            "height": self.app.height,
+            "block_time": self.app.block_time,
+            "app_version": self.app.app_version,
+            "chain_id": self.app.chain_id,
+        }
+        (self.home / "meta.json").write_text(json.dumps(meta))
+
+    @classmethod
+    def load(cls, home: str, **app_kwargs) -> "Node":
+        from celestia_tpu.state import StateStore
+
+        home_path = pathlib.Path(home)
+        meta = json.loads((home_path / "meta.json").read_text())
+        app = App(chain_id=meta["chain_id"], app_version=meta["app_version"],
+                  **app_kwargs)
+        app.store = StateStore.restore((home_path / "state.json").read_bytes())
+        app.accounts.store = app.store
+        app.bank.store = app.store
+        app.blob.store = app.store
+        app.mint.store = app.store
+        app.height = meta["height"]
+        app.block_time = meta["block_time"]
+        node = cls(app, home=home)
+        for path in sorted((home_path / "blocks").glob("*.json"),
+                           key=lambda p: int(p.stem)):
+            block = Block.from_json(json.loads(path.read_text()))
+            node.blocks[block.height] = block
+            for i, raw in enumerate(block.txs):
+                node.tx_index[tx_hash(raw)] = (block.height, i)
+        return node

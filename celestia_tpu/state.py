@@ -51,15 +51,17 @@ class CacheStore:
         self._writes.clear()
 
     def iter_prefix(self, prefix: bytes):
-        seen = set()
+        # Sorted merged view so branch and committed iteration agree —
+        # order-sensitive consumers must not diverge across commit.
+        merged: dict[bytes, bytes] = dict(self.parent.iter_prefix(prefix))
         for k, v in self._writes.items():
             if k.startswith(prefix):
-                seen.add(k)
-                if v is not None:
-                    yield k, v
-        for k, v in self.parent.iter_prefix(prefix):
-            if k not in seen:
-                yield k, v
+                if v is None:
+                    merged.pop(k, None)
+                else:
+                    merged[k] = v
+        for k in sorted(merged):
+            yield k, merged[k]
 
 
 class StateStore:
@@ -91,14 +93,9 @@ class StateStore:
 
     def commit(self) -> bytes:
         """Advance one version and return the deterministic app hash."""
-        h = hashlib.sha256()
-        for k in sorted(self._data):
-            h.update(hashlib.sha256(k).digest())
-            h.update(hashlib.sha256(self._data[k]).digest())
         self.version += 1
-        app_hash = h.digest()
-        self.app_hashes[self.version] = app_hash
-        return app_hash
+        self.commit_hash_refresh()
+        return self.app_hashes[self.version]
 
     # --- checkpoint / resume ---
 

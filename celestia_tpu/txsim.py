@@ -1,0 +1,111 @@
+"""txsim — composable transaction load generator.
+
+Reference semantics: test/txsim (run.go:31, blob.go, send.go): an account
+manager plus pluggable Sequences that emit txs each round against a live
+chain. Drives a local Node (or any transport with broadcast_tx).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from celestia_tpu import blob as blob_pkg
+from celestia_tpu import namespace as ns
+from celestia_tpu.crypto import PrivateKey
+from celestia_tpu.tx import Fee
+from celestia_tpu.user import Signer
+from celestia_tpu.x.bank import MsgSend
+
+
+class Sequence:
+    """One stream of related transactions."""
+
+    def init(self, signer: Signer, rng: np.random.Generator) -> None:
+        self.signer = signer
+        self.rng = rng
+
+    def next_tx(self):  # -> TxResult | None
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class BlobSequence(Sequence):
+    """PFB storm: random blobs in a size/count range. ref: test/txsim/blob.go"""
+
+    size_min: int = 100
+    size_max: int = 10_000
+    blobs_per_pfb: int = 1
+
+    def next_tx(self):
+        blobs = []
+        for _ in range(self.blobs_per_pfb):
+            size = int(self.rng.integers(self.size_min, self.size_max + 1))
+            sub_id = self.rng.integers(0, 256, size=10, dtype=np.uint8).tobytes()
+            data = self.rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+            blobs.append(blob_pkg.new_blob(ns.new_v0(sub_id), data, 0))
+        return self.signer.submit_pay_for_blob(blobs)
+
+
+@dataclasses.dataclass
+class SendSequence(Sequence):
+    """Bank transfer stream. ref: test/txsim/send.go"""
+
+    to_address: str = ""
+    amount: int = 100
+
+    def next_tx(self):
+        to = self.to_address or self.signer.address()
+        return self.signer.submit_tx(
+            [MsgSend(self.signer.address(), to, self.amount)],
+            Fee(amount=200_000, gas_limit=200_000),
+        )
+
+
+def run(
+    node,
+    master_key: PrivateKey,
+    sequences: list[Sequence],
+    rounds: int,
+    seed: int = 0,
+    blocks_per_round: int = 1,
+    funding_per_sequence: int = 10_000_000_000,
+) -> dict:
+    """Run the sequences for N rounds, producing blocks in between.
+
+    Each sequence gets its own funded account (ref: test/txsim/run.go's
+    AccountManager) — the square orders blob txs after normal txs, so one
+    account cannot mix both kinds in a single block.
+    """
+    rng = np.random.default_rng(seed)
+    master = Signer.setup_single(master_key, node)
+    seq_keys = [
+        PrivateKey.from_secret(f"txsim-seq-{seed}-{i}".encode())
+        for i in range(len(sequences))
+    ]
+    for key in seq_keys:
+        res = master.submit_tx(
+            [MsgSend(master.address(), key.bech32_address(), funding_per_sequence)],
+            Fee(amount=200_000, gas_limit=200_000),
+        )
+        if res.code != 0:
+            raise RuntimeError(f"funding failed: {res.log}")
+    node.produce_block()
+
+    for seq, key in zip(sequences, seq_keys):
+        seq.init(Signer.setup_single(key, node), rng)
+
+    stats = {"submitted": 0, "accepted": 0, "rejected": 0, "blocks": 0}
+    for _ in range(rounds):
+        for seq in sequences:
+            res = seq.next_tx()
+            stats["submitted"] += 1
+            if res is not None and res.code == 0:
+                stats["accepted"] += 1
+            else:
+                stats["rejected"] += 1
+        for _ in range(blocks_per_round):
+            node.produce_block()
+            stats["blocks"] += 1
+    return stats

@@ -1,0 +1,72 @@
+"""Client-side Signer — build/sign/submit txs and PFBs, then confirm.
+
+Reference semantics: pkg/user/signer.go — SIGN_MODE_DIRECT signing,
+sequence tracking with local increment, SubmitPayForBlob wrapping the
+signed tx + blobs into a BlobTx envelope, and poll-confirm. The transport
+is pluggable: a local Node object or an RPC client (celestia_tpu.node.rpc)
+exposing broadcast_tx/get_tx.
+"""
+
+from __future__ import annotations
+
+from celestia_tpu import blob as blob_pkg
+from celestia_tpu.crypto import PrivateKey
+from celestia_tpu.tx import Fee, sign_tx
+from celestia_tpu.x.blob.types import estimate_gas, new_msg_pay_for_blobs
+
+
+class Signer:
+    def __init__(self, key: PrivateKey, transport, chain_id: str,
+                 account_number: int, sequence: int = 0):
+        self.key = key
+        self.transport = transport  # needs .broadcast_tx(raw) and .get_tx(hash)
+        self.chain_id = chain_id
+        self.account_number = account_number
+        self.sequence = sequence
+
+    @classmethod
+    def setup_single(cls, key: PrivateKey, node) -> "Signer":
+        """ref: pkg/user/signer.go SetupSingleSigner — query account state."""
+        acc = node.app.accounts.get_account(key.bech32_address())
+        if acc is None:
+            raise ValueError("account does not exist on chain")
+        return cls(key, node, node.app.chain_id, acc.account_number, acc.sequence)
+
+    def address(self) -> str:
+        return self.key.bech32_address()
+
+    def _sign(self, msgs: list, fee: Fee):
+        tx = sign_tx(
+            self.key, msgs, self.chain_id, self.account_number, self.sequence, fee
+        )
+        return tx
+
+    def submit_tx(self, msgs: list, fee: Fee | None = None):
+        """Sign, broadcast, and (on success) bump the local sequence."""
+        fee = fee or Fee(amount=200_000, gas_limit=200_000)
+        tx = self._sign(msgs, fee)
+        res = self.transport.broadcast_tx(tx.marshal())
+        if res.code == 0:
+            self.sequence += 1
+        return res
+
+    def submit_pay_for_blob(self, blobs: list[blob_pkg.Blob], fee: Fee | None = None):
+        """ref: pkg/user/signer.go:145 SubmitPayForBlob"""
+        msg = new_msg_pay_for_blobs(self.address(), *blobs)
+        if fee is None:
+            gas = estimate_gas([len(b.data) for b in blobs])
+            fee = Fee(amount=gas, gas_limit=gas)
+        tx = self._sign([msg], fee)
+        raw = blob_pkg.marshal_blob_tx(tx.marshal(), blobs)
+        res = self.transport.broadcast_tx(raw)
+        if res.code == 0:
+            self.sequence += 1
+        return res
+
+    def confirm_tx(self, raw: bytes):
+        """Poll the transport until the tx is committed.
+        ref: pkg/user/signer.go:212 ConfirmTx"""
+        import hashlib
+
+        key = hashlib.sha256(raw).digest()
+        return self.transport.get_tx(key)

@@ -1,0 +1,185 @@
+// Native host runtime: Leopard-compatible GF(2^8) Reed-Solomon + SHA-256
+// NMT roots for the DA hot path.
+//
+// This is the framework's CPU execution backend — the role the
+// SIMD-accelerated Go Leopard codec plays for the reference
+// (rsmt2d.NewLeoRSCodec selected at pkg/appconsts/global_consts.go:92).
+// The TPU path (celestia_tpu/ops) is the accelerator; this library serves
+// hosts without a TPU, provides the measured CPU baseline for bench.py,
+// and keeps the whole ExtendBlock chain runnable natively.
+//
+// The code implemented here is the same code as celestia_tpu/ops/gf256.py
+// (LCH additive-FFT over the Cantor basis, polynomial 0x11D) and is
+// byte-identical to it; Python bindings are in celestia_tpu/native.py
+// (ctypes).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int kBits = 8;
+constexpr int kOrder = 256;
+constexpr int kModulus = 255;
+constexpr int kPolynomial = 0x11D;
+constexpr uint8_t kCantorBasis[kBits] = {1, 214, 152, 146, 86, 200, 88, 230};
+
+uint16_t g_log[kOrder];
+uint8_t g_exp[kOrder];
+uint8_t g_mul[kOrder][kOrder];
+uint16_t g_skew[kOrder];
+bool g_initialized = false;
+
+inline int add_mod(int a, int b) {
+  int s = a + b;
+  return (s + (s >> kBits)) & 0xFF;
+}
+
+int mul_log(int a, int log_b) {
+  if (a == 0) return 0;
+  return g_exp[add_mod(g_log[a], log_b)];
+}
+
+void init_tables() {
+  if (g_initialized) return;
+  // LFSR discrete log w.r.t. generator x.
+  uint16_t expt[kOrder], logt[kOrder];
+  int state = 1;
+  for (int i = 0; i < kModulus; ++i) {
+    expt[state] = i;
+    state <<= 1;
+    if (state >= kOrder) state ^= kPolynomial;
+  }
+  expt[0] = kModulus;
+
+  // Cantor-basis change.
+  logt[0] = 0;
+  for (int i = 0; i < kBits; ++i) {
+    int width = 1 << i;
+    for (int j = 0; j < width; ++j) logt[j + width] = logt[j] ^ kCantorBasis[i];
+  }
+  for (int i = 0; i < kOrder; ++i) logt[i] = expt[logt[i]];
+  for (int i = 0; i < kOrder; ++i) g_log[i] = logt[i];
+  for (int i = 0; i < kOrder; ++i) g_exp[g_log[i]] = i;
+  g_exp[kModulus] = g_exp[0];
+
+  // Multiplication table.
+  for (int a = 0; a < kOrder; ++a)
+    for (int b = 0; b < kOrder; ++b)
+      g_mul[a][b] = (a == 0 || b == 0) ? 0 : g_exp[add_mod(g_log[a], g_log[b])];
+
+  // FFT skew schedule (LCH subspace polynomial recursion).
+  uint8_t skew_elem[kOrder] = {0};
+  int temp[kBits - 1];
+  for (int i = 1; i < kBits; ++i) temp[i - 1] = 1 << i;
+  for (int m = 0; m < kBits - 1; ++m) {
+    int step = 1 << (m + 1);
+    skew_elem[(1 << m) - 1] = 0;
+    for (int i = m; i < kBits - 1; ++i) {
+      int s = 1 << (i + 1);
+      for (int j = (1 << m) - 1; j < s; j += step)
+        skew_elem[j + s] = skew_elem[j] ^ temp[i];
+    }
+    int temp_m = kModulus - g_log[g_mul[temp[m]][temp[m] ^ 1]];
+    for (int i = m + 1; i < kBits - 1; ++i) {
+      int s = add_mod(g_log[temp[i] ^ 1], temp_m);
+      temp[i] = mul_log(temp[i], s);
+    }
+    temp[m] = temp_m;
+  }
+  for (int i = 0; i < kOrder; ++i) g_skew[i] = g_log[skew_elem[i]];
+  g_initialized = true;
+}
+
+// y_block ^= exp(log_m) * x_block over `size` bytes; then x ^= ... pattern
+// handled by callers. Uses the mul row for the constant.
+inline void muladd(uint8_t* dst, const uint8_t* src, int log_m, size_t size) {
+  const uint8_t* row = g_mul[g_exp[log_m]];
+  for (size_t i = 0; i < size; ++i) dst[i] ^= row[src[i]];
+}
+
+inline void xor_block(uint8_t* dst, const uint8_t* src, size_t size) {
+  for (size_t i = 0; i < size; ++i) dst[i] ^= src[i];
+}
+
+}  // namespace
+
+extern "C" {
+
+// Leopard RS encode: k data shards of shard_size bytes -> k parity shards.
+// Matches reedsolomon.New(k, k, WithLeopardGF(true)).Encode: work =
+// IFFT_skew(data) at offset m, parity = FFT_skew(work) at offset 0.
+void leo_encode(int k, size_t shard_size, const uint8_t* data, uint8_t* parity) {
+  init_tables();
+  if (k <= 0 || (k & (k - 1))) return;  // power-of-two only (callers validate)
+  if (k == 1) {  // both transforms degenerate to identity
+    std::memcpy(parity, data, shard_size);
+    return;
+  }
+  std::memcpy(parity, data, (size_t)k * shard_size);
+  uint8_t* work = parity;
+
+  // IFFT (decimation in time), skew offset m-1.
+  for (int dist = 1; dist < k; dist <<= 1) {
+    for (int r = 0; r < k; r += dist * 2) {
+      int log_m = g_skew[k - 1 + r + dist];
+      for (int i = 0; i < dist; ++i) {
+        uint8_t* x = work + (size_t)(r + i) * shard_size;
+        uint8_t* y = work + (size_t)(r + dist + i) * shard_size;
+        xor_block(y, x, shard_size);
+        if (log_m != kModulus) muladd(x, y, log_m, shard_size);
+      }
+    }
+  }
+  // FFT, skew offset 0.
+  for (int dist = k >> 1; dist >= 1; dist >>= 1) {
+    for (int r = 0; r < k; r += dist * 2) {
+      int log_m = g_skew[r + dist - 1];
+      for (int i = 0; i < dist; ++i) {
+        uint8_t* x = work + (size_t)(r + i) * shard_size;
+        uint8_t* y = work + (size_t)(r + dist + i) * shard_size;
+        if (log_m != kModulus) muladd(x, y, log_m, shard_size);
+        xor_block(y, x, shard_size);
+      }
+    }
+  }
+}
+
+// Extend a k x k share square (row-major, shard_size bytes per cell) into a
+// 2k x 2k EDS (Q1 = row-extend Q0, Q2 = col-extend Q0, Q3 = row-extend Q2).
+void eds_extend(int k, size_t shard_size, const uint8_t* q0, uint8_t* eds) {
+  init_tables();
+  const int w = 2 * k;
+  std::vector<uint8_t> shards((size_t)k * shard_size);
+  std::vector<uint8_t> parity((size_t)k * shard_size);
+
+  // Q0
+  for (int i = 0; i < k; ++i)
+    std::memcpy(eds + ((size_t)i * w) * shard_size, q0 + (size_t)i * k * shard_size,
+                (size_t)k * shard_size);
+  // Q1: extend rows.
+  for (int i = 0; i < k; ++i) {
+    leo_encode(k, shard_size, eds + ((size_t)i * w) * shard_size, parity.data());
+    std::memcpy(eds + ((size_t)i * w + k) * shard_size, parity.data(),
+                (size_t)k * shard_size);
+  }
+  // Q2: extend columns.
+  for (int j = 0; j < k; ++j) {
+    for (int i = 0; i < k; ++i)
+      std::memcpy(shards.data() + (size_t)i * shard_size,
+                  eds + ((size_t)i * w + j) * shard_size, shard_size);
+    leo_encode(k, shard_size, shards.data(), parity.data());
+    for (int i = 0; i < k; ++i)
+      std::memcpy(eds + ((size_t)(k + i) * w + j) * shard_size,
+                  parity.data() + (size_t)i * shard_size, shard_size);
+  }
+  // Q3: extend the Q2 rows.
+  for (int i = k; i < w; ++i) {
+    leo_encode(k, shard_size, eds + ((size_t)i * w) * shard_size, parity.data());
+    std::memcpy(eds + ((size_t)i * w + k) * shard_size, parity.data(),
+                (size_t)k * shard_size);
+  }
+}
+
+}  // extern "C"

@@ -144,11 +144,16 @@ class TestCheckTx:
         block = app.prepare_proposal([make_send_tx(app, BOB, ALICE.bech32_address(), 10**15)])
         app.process_proposal(block)
         app.begin_block(app.block_time + 15)
+        bal_before = app.bank.get_balance(BOB.bech32_address())
+        seq_before = app.accounts.get_account(BOB.bech32_address()).sequence
         r = app.deliver_tx(block.txs[0])
         assert r.code != 0
         assert "insufficient funds" in r.log
         app.end_block()
         app.commit()
+        # ante effects persist on failed delivery: fee paid, sequence bumped
+        assert app.bank.get_balance(BOB.bech32_address()) == bal_before - 200_000
+        assert app.accounts.get_account(BOB.bech32_address()).sequence == seq_before + 1
 
     def test_commitment_tampering_rejected(self):
         app = fresh_app()
@@ -293,7 +298,53 @@ class TestMint:
         assert 300 < minted < 500, minted
 
 
+class TestBeginBlockIsolation:
+    def test_begin_block_effects_not_committed_before_commit(self):
+        """Crash between BeginBlock and Commit must leave committed state
+        untouched (replay determinism)."""
+        app = fresh_app()
+        hash_before = app.store.app_hashes[app.store.version]
+        app.begin_block(app.block_time + 15.0)  # mints provision on a branch
+        # simulate crash: discard the block
+        app._deliver_store = None
+        app._deliver_ctx = None
+        app.store.commit_hash_refresh()
+        assert app.store.app_hashes[app.store.version] == hash_before
+
+    def test_failed_tx_reports_gas(self):
+        app = fresh_app()
+        block = app.prepare_proposal(
+            [make_send_tx(app, BOB, ALICE.bech32_address(), 10**15)]
+        )
+        app.process_proposal(block)
+        app.begin_block(app.block_time + 15)
+        r = app.deliver_tx(block.txs[0])
+        assert r.code != 0
+        assert r.gas_wanted == 200_000
+        assert r.gas_used > 0
+        app.end_block()
+        app.commit()
+
+
 class TestStateStore:
+    def test_cache_iter_prefix_sorted_and_deletes(self):
+        from celestia_tpu.state import StateStore
+
+        store = StateStore()
+        store.set(b"p/b", b"2")
+        store.set(b"p/d", b"4")
+        store.set(b"q/x", b"9")
+        branch = store.branch()
+        branch.set(b"p/c", b"3")
+        branch.set(b"p/a", b"1")
+        branch.delete(b"p/d")
+        branch.delete(b"p/zz-missing")  # delete marker for absent key
+        got = list(branch.iter_prefix(b"p/"))
+        assert got == [(b"p/a", b"1"), (b"p/b", b"2"), (b"p/c", b"3")]
+        # committed store iteration agrees after write-back
+        branch.write()
+        assert list(store.iter_prefix(b"p/")) == got
+
     def test_snapshot_restore(self):
         from celestia_tpu.state import StateStore
 

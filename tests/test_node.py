@@ -1,0 +1,173 @@
+"""Node shell tests: mempool, block production, RPC, signer, txsim,
+checkpoint/resume (reference model: test/util/testnode usage in
+app/test/*_test.go)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from celestia_tpu import blob as blob_pkg
+from celestia_tpu import namespace as ns
+from celestia_tpu.app import App
+from celestia_tpu.crypto import PrivateKey
+from celestia_tpu.node import Node
+from celestia_tpu.node.node import tx_hash
+from celestia_tpu.node.rpc import RpcServer
+from celestia_tpu.txsim import BlobSequence, SendSequence, run as txsim_run
+from celestia_tpu.user import Signer
+
+VALIDATOR = PrivateKey.from_secret(b"validator")
+ALICE = PrivateKey.from_secret(b"alice")
+
+
+def new_node(tmp_path=None, **app_kwargs) -> Node:
+    app = App(**app_kwargs)
+    app.init_chain(
+        {
+            VALIDATOR.bech32_address(): 1_000_000_000_000,
+            ALICE.bech32_address(): 50_000_000_000,
+        },
+        genesis_time=0.0,
+    )
+    node = Node(app, home=str(tmp_path) if tmp_path else None)
+    node.produce_block(15.0)  # empty first block
+    return node
+
+
+class TestNode:
+    def test_blob_lifecycle(self):
+        node = new_node()
+        signer = Signer.setup_single(ALICE, node)
+        b = blob_pkg.new_blob(ns.new_v0(b"node-test"), b"\x11" * 2000, 0)
+        res = signer.submit_pay_for_blob([b])
+        assert res.code == 0, res.log
+        assert len(node.mempool) == 1
+
+        block = node.produce_block()
+        assert len(block.txs) == 1
+        assert len(node.mempool) == 0
+        assert block.tx_results[0].code == 0
+
+        # confirm + deconstruct round-trip
+        found = node.get_tx(tx_hash(block.txs[0]))
+        assert found is not None
+
+        square = node.app.extend_block(block.txs)
+        assert square.width >= 2
+
+    def test_mempool_priority_order(self):
+        node = new_node()
+        s_val = Signer.setup_single(VALIDATOR, node)
+        s_alice = Signer.setup_single(ALICE, node)
+        from celestia_tpu.tx import Fee
+        from celestia_tpu.x.bank import MsgSend
+
+        # alice pays a higher gas price -> higher priority
+        r1 = s_val.submit_tx(
+            [MsgSend(s_val.address(), s_alice.address(), 1)],
+            Fee(amount=100_000, gas_limit=200_000),
+        )
+        r2 = s_alice.submit_tx(
+            [MsgSend(s_alice.address(), s_val.address(), 1)],
+            Fee(amount=400_000, gas_limit=200_000),
+        )
+        assert r1.code == 0 and r2.code == 0
+        reaped = node.mempool.reap()
+        assert len(reaped) == 2
+        from celestia_tpu.tx import Tx
+
+        first = Tx.unmarshal(reaped[0])
+        assert first.fee.amount == 400_000  # higher priority first
+
+    def test_mempool_ttl_eviction(self):
+        node = new_node()
+        node.mempool.add(b"some-unprocessable-tx", priority=0, height=node.app.height)
+        # mempool txs that never make it into a block expire after TTL blocks
+        for _ in range(5):
+            node.produce_block()
+        assert len(node.mempool) == 0
+
+    def test_txsim(self):
+        node = new_node()
+        stats = txsim_run(
+            node,
+            VALIDATOR,
+            [BlobSequence(size_min=100, size_max=2000), SendSequence(amount=5)],
+            rounds=3,
+        )
+        assert stats["accepted"] == 6
+        assert stats["rejected"] == 0
+        assert node.latest_height() >= 4
+
+    def test_checkpoint_resume(self, tmp_path):
+        node = new_node(tmp_path)
+        signer = Signer.setup_single(ALICE, node)
+        b = blob_pkg.new_blob(ns.new_v0(b"persist"), b"\x22" * 500, 0)
+        assert signer.submit_pay_for_blob([b]).code == 0
+        block = node.produce_block()
+        node.save_snapshot()
+
+        resumed = Node.load(str(tmp_path))
+        assert resumed.latest_height() == node.latest_height()
+        assert (
+            resumed.app.store.app_hashes[resumed.app.store.version]
+            == node.app.store.app_hashes[node.app.store.version]
+        )
+        assert resumed.get_block(block.height).data_hash == block.data_hash
+        # the resumed chain keeps producing blocks
+        resumed.produce_block()
+        assert resumed.latest_height() == node.latest_height() + 1
+
+
+class TestRpc:
+    def test_http_api(self):
+        node = new_node()
+        server = RpcServer(node, port=0)
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            status = json.loads(urllib.request.urlopen(f"{base}/status").read())
+            assert status["height"] == 1
+
+            acc = json.loads(
+                urllib.request.urlopen(f"{base}/account/{ALICE.bech32_address()}").read()
+            )
+            assert acc["balance"] == 50_000_000_000
+
+            # broadcast a pfb over HTTP
+            signer = Signer.setup_single(ALICE, node)
+            b = blob_pkg.new_blob(ns.new_v0(b"rpc-test"), b"\x33" * 100, 0)
+            from celestia_tpu.x.blob.types import estimate_gas, new_msg_pay_for_blobs
+            from celestia_tpu.tx import Fee, sign_tx
+
+            msg = new_msg_pay_for_blobs(signer.address(), b)
+            gas = estimate_gas([100])
+            tx = sign_tx(ALICE, [msg], node.app.chain_id, signer.account_number,
+                         signer.sequence, Fee(amount=gas, gas_limit=gas))
+            raw = blob_pkg.marshal_blob_tx(tx.marshal(), [b])
+            req = urllib.request.Request(
+                f"{base}/broadcast_tx",
+                data=json.dumps({"tx": raw.hex()}).encode(),
+                method="POST",
+            )
+            res = json.loads(urllib.request.urlopen(req).read())
+            assert res["code"] == 0, res
+
+            req = urllib.request.Request(f"{base}/produce_block", data=b"{}",
+                                         method="POST")
+            block = json.loads(urllib.request.urlopen(req).read())
+            assert len(block["txs"]) == 1
+        finally:
+            server.stop()
+
+
+class TestCli:
+    def test_init_and_keys(self, tmp_path):
+        from celestia_tpu.cli import main
+
+        main(["--home", str(tmp_path), "init"])
+        assert (tmp_path / "genesis.json").exists()
+        main(["--home", str(tmp_path), "keys", "add", "test-key"])
+        keys = json.loads((tmp_path / "keys.json").read_text())
+        assert "validator" in keys and "test-key" in keys
