@@ -26,7 +26,9 @@ from celestia_tpu.x.auth import AccountKeeper
 from celestia_tpu.x.bank import BankKeeper, MsgSend
 from celestia_tpu.x.blob import BlobKeeper, MsgPayForBlobs, validate_blob_tx
 from celestia_tpu.x.blob.types import pfb_blob_sizes
+from celestia_tpu.x.blobstream import BlobstreamKeeper, MsgRegisterEVMAddress
 from celestia_tpu.x.mint import MintKeeper
+from celestia_tpu.x.staking import MsgDelegate, MsgUndelegate, StakingKeeper
 from celestia_tpu.x.upgrade import MsgVersionChange, UpgradeKeeper
 
 from .ante import AnteHandler
@@ -65,6 +67,9 @@ class App:
         self.bank = BankKeeper(self.store)
         self.blob = BlobKeeper(self.store)
         self.mint = MintKeeper(self.store, self.bank)
+        self.staking = StakingKeeper(self.store, self.bank)
+        self.blobstream = BlobstreamKeeper(self.store, self.staking)
+        self.staking.hooks.append(self.blobstream)  # ref: app/app.go:349-354
         self.upgrade = UpgradeKeeper(upgrade_schedule or {})
         self.height = 0
         self.block_time = 0.0
@@ -185,6 +190,19 @@ class App:
 
     def prepare_proposal(self, mempool_txs: list[bytes],
                          block_data_size: int | None = None) -> ProposalBlockData:
+        import time as _time
+
+        from celestia_tpu.telemetry import metrics
+
+        _start = _time.perf_counter()
+        try:
+            return self._prepare_proposal_inner(mempool_txs, block_data_size)
+        finally:
+            # ref: app/prepare_proposal.go:23 telemetry.MeasureSince
+            metrics.measure_since("prepare_proposal", _start)
+
+    def _prepare_proposal_inner(self, mempool_txs: list[bytes],
+                                block_data_size: int | None = None) -> ProposalBlockData:
         if self.height == 0:
             txs: list[bytes] = []  # first block is empty by design
         else:
@@ -232,10 +250,19 @@ class App:
     # ProcessProposal. ref: app/process_proposal.go:24-166
 
     def process_proposal(self, block_data: ProposalBlockData) -> bool:
+        import time as _time
+
+        from celestia_tpu.telemetry import metrics
+
+        _start = _time.perf_counter()
         try:
             return self._process_proposal_inner(block_data)
         except Exception:  # noqa: BLE001 — panics vote REJECT, not crash
+            metrics.incr_counter("process_proposal_panics")
             return False
+        finally:
+            # ref: app/process_proposal.go:25 telemetry.MeasureSince
+            metrics.measure_since("process_proposal", _start)
 
     def _process_proposal_inner(self, block_data: ProposalBlockData) -> bool:
         store = self.store.branch()
@@ -349,11 +376,28 @@ class App:
             )
             # receiving funds creates the account (SDK bank/auth behavior)
             AccountKeeper(ctx.store).get_or_create(msg.to_address)
+        elif isinstance(msg, MsgDelegate):
+            StakingKeeper(ctx.store, BankKeeper(ctx.store)).delegate(
+                ctx, msg.delegator, msg.validator, msg.amount
+            )
+        elif isinstance(msg, MsgUndelegate):
+            keeper = StakingKeeper(ctx.store, BankKeeper(ctx.store))
+            keeper.hooks.append(BlobstreamKeeper(ctx.store, keeper))
+            keeper.undelegate(ctx, msg.delegator, msg.validator, msg.amount)
+        elif isinstance(msg, MsgRegisterEVMAddress):
+            staking = StakingKeeper(ctx.store, BankKeeper(ctx.store))
+            BlobstreamKeeper(ctx.store, staking).register_evm_address(
+                msg.validator_address, msg.evm_address
+            )
         else:
             raise ValueError(f"unroutable message type {type(msg).__name__}")
 
     def end_block(self) -> dict:
-        """ref: app/app.go:575-587 (EndBlocker upgrade bump)"""
+        """ref: app/app.go:575-587 (EndBlocker upgrade bump) + blobstream
+        EndBlocker (x/blobstream/abci.go:28)"""
+        if self._deliver_store is not None and self._deliver_ctx is not None:
+            staking = StakingKeeper(self._deliver_store, BankKeeper(self._deliver_store))
+            BlobstreamKeeper(self._deliver_store, staking).end_blocker(self._deliver_ctx)
         result = {}
         if self.upgrade.should_upgrade():
             result["app_version"] = self.upgrade.pending_app_version

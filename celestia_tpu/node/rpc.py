@@ -15,6 +15,35 @@ import threading
 from celestia_tpu.node.node import Node
 
 
+def _share_proof_json(proof) -> dict:
+    return {
+        "namespace": proof.namespace.bytes.hex(),
+        "data": [s.hex() for s in proof.data],
+        "share_proofs": [
+            {
+                "start": p.start,
+                "end": p.end,
+                "nodes": [n.hex() for n in p.nodes],
+            }
+            for p in proof.share_proofs
+        ],
+        "row_proof": {
+            "start_row": proof.row_proof.start_row,
+            "end_row": proof.row_proof.end_row,
+            "row_roots": [r.hex() for r in proof.row_proof.row_roots],
+            "proofs": [
+                {
+                    "total": m.total,
+                    "index": m.index,
+                    "leaf_hash": m.leaf_hash.hex(),
+                    "aunts": [a.hex() for a in m.aunts],
+                }
+                for m in proof.row_proof.proofs
+            ],
+        },
+    }
+
+
 def _handler_for(node: Node):
     class Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -33,7 +62,16 @@ def _handler_for(node: Node):
         def do_GET(self):
             parts = [p for p in self.path.split("/") if p]
             try:
-                if parts == ["status"]:
+                if parts == ["metrics"]:
+                    from celestia_tpu.telemetry import metrics
+
+                    body = metrics.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif parts == ["status"]:
                     self._reply(
                         {
                             "chain_id": node.app.chain_id,
@@ -78,6 +116,45 @@ def _handler_for(node: Node):
                     self._reply(
                         {"balance": node.app.bank.get_balance(parts[1], parts[2])}
                     )
+                elif len(parts) == 3 and parts[0] == "proof" and parts[1] == "tx":
+                    # /proof/tx/<height>:<tx_index> — tx inclusion proof
+                    # (ref: pkg/proof/querier.go txInclusionProof route)
+                    height, idx = parts[2].split(":")
+                    block = node.get_block(int(height))
+                    if block is None:
+                        self._reply({"error": "block not found"}, 404)
+                        return
+                    from celestia_tpu.proof import new_tx_inclusion_proof
+
+                    proof = new_tx_inclusion_proof(
+                        block.txs, int(idx), node.app.app_version
+                    )
+                    proof.validate(block.data_hash)
+                    self._reply(_share_proof_json(proof))
+                elif len(parts) == 3 and parts[0] == "proof" and parts[1] == "share":
+                    # /proof/share/<height>:<start>:<end> — share inclusion
+                    # (ref: pkg/proof/querier.go shareInclusionProof route)
+                    height, start, end = parts[2].split(":")
+                    block = node.get_block(int(height))
+                    if block is None:
+                        self._reply({"error": "block not found"}, 404)
+                        return
+                    from celestia_tpu import appconsts, square as square_pkg
+                    from celestia_tpu.proof import new_share_inclusion_proof
+                    from celestia_tpu.shares.splitters import Range
+
+                    sq = square_pkg.construct(
+                        block.txs, node.app.app_version,
+                        appconsts.square_size_upper_bound(node.app.app_version),
+                    )
+                    ns_bytes = sq[int(start)].data[:29]
+                    import celestia_tpu.namespace as ns_mod
+
+                    proof = new_share_inclusion_proof(
+                        sq, ns_mod.from_bytes(ns_bytes), Range(int(start), int(end))
+                    )
+                    proof.validate(block.data_hash)
+                    self._reply(_share_proof_json(proof))
                 else:
                     self._reply({"error": "unknown route"}, 404)
             except Exception as e:  # noqa: BLE001

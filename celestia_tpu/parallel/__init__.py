@@ -1,0 +1,171 @@
+"""Multi-chip parallelism: sharded ExtendBlock over a device mesh.
+
+The reference scales per-axis work across goroutines (SURVEY §2.5:
+rsmt2d encodes rows/columns in parallel; NMTs per axis). The TPU-native
+scaling axes are:
+
+- dp (data parallel): independent squares (blocks) across devices — block
+  replay, proposal bursts, catching-up nodes.
+- sp (sequence parallel analogue): rows of one square across devices
+  (SURVEY §5: "square size is the sequence axis"); row extension and row
+  NMTs are local, column extension is a contraction over the sharded row
+  axis and becomes a psum over ICI, and column NMT reduction all-gathers
+  the (small) leaf-digest tensor.
+
+Two implementations:
+- `sharded_extend_and_root` — jit + NamedSharding annotations; XLA chooses
+  the collectives (the recommended default).
+- `extend_and_root_rowsharded` — shard_map with *explicit* collectives
+  (psum for the GF(2) column contraction, all_gather for the column
+  trees), the hand-written spelling of the same program for when the
+  schedule must be pinned.
+
+GF(2) note: partial products of the bit-matmul are integer counts;
+summing counts across devices then reducing mod 2 is exactly the XOR of
+the per-device partial parities, so the cross-device combine is a plain
+psum in int32 followed by `& 1`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from celestia_tpu.ops import rs_tpu
+from celestia_tpu.ops.extend_tpu import (
+    extend_and_root,
+    extend_and_root_batched,
+)
+
+
+def make_mesh(dp: int, sp: int, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if len(devices) < dp * sp:
+        raise ValueError(f"need {dp * sp} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[: dp * sp]).reshape(dp, sp), ("dp", "sp"))
+
+
+def sharded_extend_and_root(mesh: Mesh, k: int):
+    """Compiled batched extend+root with (dp, sp) input sharding; XLA
+    inserts the collectives implied by the shardings."""
+    m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
+    in_sharding = NamedSharding(mesh, P("dp", "sp", None, None))
+    return jax.jit(
+        lambda s: extend_and_root_batched(s, m2), in_shardings=in_sharding
+    )
+
+
+# ---------------------------------------------------------------------- #
+# Explicit-collective spelling (shard_map)
+
+
+def extend_and_root_rowsharded(mesh: Mesh, k: int):
+    """One square, rows sharded over the 'sp' mesh axis; explicit psum /
+    all_gather collectives. Returns a jitted fn of (k, k, 512) uint8."""
+    try:
+        shard_map = jax.shard_map  # jax >= 0.6
+    except AttributeError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
+    sp = mesh.shape["sp"]
+    if k % sp:
+        raise ValueError(f"square size {k} not divisible by sp={sp}")
+
+    def local_fn(shares_block):  # (k/sp, k, 512) local rows
+        # Q1: row extension is local to the row shard.
+        q1 = rs_tpu.rs_encode_rows(shares_block, m2)
+
+        # Q2: contraction over the *sharded* row axis -> per-device partial
+        # integer counts, psum over sp, reduce mod 2.
+        cols_local = jnp.swapaxes(shares_block, 0, 1)  # (k, k/sp rows, 512)
+        bits = rs_tpu.unpack_bits(cols_local)  # (k, 8*k/sp, B)
+        idx = jax.lax.axis_index("sp")
+        rows_per = k // sp
+        # rows of m2 block-select: contraction index q = 8*row + bit, where
+        # row is the GLOBAL row index of this device's block
+        m2_block = jax.lax.dynamic_slice_in_dim(
+            m2, idx * 8 * rows_per, 8 * rows_per, axis=1
+        ).astype(jnp.int8)
+        partial = jax.lax.dot_general(
+            m2_block, bits,
+            dimension_numbers=(((1,), (bits.ndim - 2,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # (8k, k_cols, B)
+        total = jax.lax.psum(partial, "sp")
+        q2_full = rs_tpu.pack_bits(jnp.moveaxis(total & 1, 0, -2))  # (k, k, B) cols-major
+        q2 = jnp.swapaxes(q2_full, 0, 1)  # (k rows, k cols, 512), replicated
+
+        # Q3: row-extend the local slice of Q2's rows.
+        q2_local = jax.lax.dynamic_slice_in_dim(q2, idx * rows_per, rows_per, axis=0)
+        q3_local = rs_tpu.rs_encode_rows(q2_local, m2)
+
+        # Assemble this device's row blocks of the EDS:
+        top_local = jnp.concatenate([shares_block, q1], axis=1)  # rows of Q0|Q1
+        bottom_local = jnp.concatenate([q2_local, q3_local], axis=1)  # rows of Q2|Q3
+
+        # NMT: leaf digests for the local top and bottom row blocks.
+        from celestia_tpu.appconsts import NAMESPACE_SIZE
+        from celestia_tpu.ops.extend_tpu import (
+            _PARITY_NS,
+            merkle_root_pow2,
+            nmt_leaf_nodes,
+            nmt_reduce_axis,
+        )
+
+        parity = jnp.broadcast_to(jnp.asarray(_PARITY_NS),
+                                  (rows_per, k, NAMESPACE_SIZE))
+        top_ns = jnp.concatenate(
+            [shares_block[..., :NAMESPACE_SIZE], parity], axis=1
+        )
+        bottom_ns = jnp.broadcast_to(jnp.asarray(_PARITY_NS),
+                                     (rows_per, 2 * k, NAMESPACE_SIZE))
+        top_leaves = nmt_leaf_nodes(top_ns, top_local)  # (rows_per, 2k, 90)
+        bottom_leaves = nmt_leaf_nodes(bottom_ns, bottom_local)
+
+        # Row roots: local reduction over each row's leaves.
+        row_roots_local = jnp.concatenate(
+            [nmt_reduce_axis(top_leaves), nmt_reduce_axis(bottom_leaves)], axis=0
+        )  # (2*rows_per, 90) — this device's rows of Q0|Q1 and Q2|Q3
+
+        # Column roots: need all rows' leaf digests -> all_gather the
+        # (small) leaf node tensor, then reduce columns locally.
+        top_all = jax.lax.all_gather(top_leaves, "sp", axis=0, tiled=True)
+        bottom_all = jax.lax.all_gather(bottom_leaves, "sp", axis=0, tiled=True)
+        all_leaves = jnp.concatenate([top_all, bottom_all], axis=0)  # (2k, 2k, 90)
+        col_roots = nmt_reduce_axis(jnp.swapaxes(all_leaves, 0, 1))  # (2k, 90)
+
+        # Gather row roots (each device holds interleaved top/bottom rows).
+        top_roots_all = jax.lax.all_gather(
+            row_roots_local[:rows_per], "sp", axis=0, tiled=True
+        )
+        bottom_roots_all = jax.lax.all_gather(
+            row_roots_local[rows_per:], "sp", axis=0, tiled=True
+        )
+        row_roots = jnp.concatenate([top_roots_all, bottom_roots_all], axis=0)
+
+        dah = merkle_root_pow2(jnp.concatenate([row_roots, col_roots], axis=0))
+        eds_rows_local = jnp.concatenate([top_local, bottom_local], axis=0)
+        return eds_rows_local, row_roots, col_roots, dah
+
+    sharded = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=P("sp", None, None),
+        out_specs=(P("sp", None, None), P(), P(), P()),
+        check_rep=False,
+    )
+
+    def reassemble(shares):
+        eds_interleaved, row_roots, col_roots, dah = sharded(shares)
+        # out rows are [dev0 top | dev0 bottom | dev1 top | ...]: restore
+        # global order [all top rows, all bottom rows].
+        rows_per = k // sp
+        blocks = eds_interleaved.reshape(sp, 2 * rows_per, 2 * k, 512)
+        top = blocks[:, :rows_per].reshape(k, 2 * k, 512)
+        bottom = blocks[:, rows_per:].reshape(k, 2 * k, 512)
+        return jnp.concatenate([top, bottom], axis=0), row_roots, col_roots, dah
+
+    return jax.jit(reassemble)

@@ -1,0 +1,300 @@
+"""Share / tx inclusion proofs against the data root.
+
+Reference semantics: pkg/proof/proof.go (NewTxInclusionProof:23,
+NewShareInclusionProof:58), tendermint crypto/merkle proofs (RFC 6962),
+and nmt v0.20 range proofs. A ShareProof carries the raw shares, one NMT
+range proof per touched row, the touched row roots, and binary merkle
+proofs of those row roots to the data root (merkle over rowRoots‖colRoots,
+pkg/da/data_availability_header.go:92-108).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from celestia_tpu import da
+from celestia_tpu import namespace as ns_pkg
+from celestia_tpu.appconsts import NAMESPACE_SIZE
+from celestia_tpu.namespace import Namespace
+from celestia_tpu.ops.nmt_host import (
+    hash_leaf,
+    hash_node,
+    merkle_inner_hash,
+    merkle_leaf_hash,
+    nmt_root,
+)
+from celestia_tpu.shares import Share, to_bytes
+from celestia_tpu.shares.splitters import Range
+
+# ---------------------------------------------------------------------- #
+# Binary merkle proofs (tendermint crypto/merkle, RFC 6962)
+
+
+@dataclasses.dataclass
+class MerkleProof:
+    total: int
+    index: int
+    leaf_hash: bytes
+    aunts: list[bytes]
+
+    def verify(self, root: bytes, leaf: bytes) -> None:
+        if merkle_leaf_hash(leaf) != self.leaf_hash:
+            raise ValueError("leaf hash mismatch")
+        computed = _hash_from_aunts(self.index, self.total, self.leaf_hash, self.aunts)
+        if computed != root:
+            raise ValueError("merkle proof verification failed")
+
+
+def _hash_from_aunts(index: int, total: int, leaf_hash: bytes, aunts: list[bytes]) -> bytes:
+    if index >= total or index < 0 or total <= 0:
+        raise ValueError("invalid index/total")
+    if total == 1:
+        if aunts:
+            raise ValueError("unexpected aunts")
+        return leaf_hash
+    if not aunts:
+        raise ValueError("missing aunts")
+    split = _split_point(total)
+    if index < split:
+        left = _hash_from_aunts(index, split, leaf_hash, aunts[:-1])
+        return merkle_inner_hash(left, aunts[-1])
+    right = _hash_from_aunts(index - split, total - split, leaf_hash, aunts[:-1])
+    return merkle_inner_hash(aunts[-1], right)
+
+
+def _split_point(n: int) -> int:
+    k = 1
+    while k * 2 < n:
+        k *= 2
+    return k
+
+
+def merkle_proofs(items: list[bytes]) -> tuple[bytes, list[MerkleProof]]:
+    """Root + a proof per item (merkle.ProofsFromByteSlices)."""
+    n = len(items)
+    leaf_hashes = [merkle_leaf_hash(i) for i in items]
+
+    proofs = [MerkleProof(total=n, index=i, leaf_hash=leaf_hashes[i], aunts=[])
+              for i in range(n)]
+
+    def rec(lo: int, hi: int) -> bytes:
+        if hi - lo == 1:
+            return leaf_hashes[lo]
+        split = _split_point(hi - lo)
+        left = rec(lo, lo + split)
+        right = rec(lo + split, hi)
+        for i in range(lo, lo + split):
+            proofs[i].aunts.append(right)
+        for i in range(lo + split, hi):
+            proofs[i].aunts.append(left)
+        return merkle_inner_hash(left, right)
+
+    if n == 0:
+        import hashlib
+
+        return hashlib.sha256(b"").digest(), []
+    root = rec(0, n)
+    # recursion descends before appending, so aunts are already ordered
+    # deepest-first — the order _hash_from_aunts consumes (top aunt last)
+    return root, proofs
+
+
+# ---------------------------------------------------------------------- #
+# NMT range proofs (nmt v0.20 Proof for leaf ranges)
+
+
+@dataclasses.dataclass
+class NmtRangeProof:
+    start: int
+    end: int
+    nodes: list[bytes]  # 90-byte subtree roots, traversal order
+    tree_size: int | None = None
+
+    def verify_inclusion(
+        self, root: bytes, leaf_namespaces: list[bytes], leaf_data: list[bytes]
+    ) -> None:
+        """Recompute the root from the in-range leaves + sibling nodes.
+
+        leaf_namespaces[i] ‖ leaf_data[i] are the raw leaves of positions
+        start+i; total tree size is inferred from the node count only for
+        power-of-two trees, so the caller passes leaves for [start, end).
+        """
+        if self.end <= self.start or len(leaf_data) != self.end - self.start:
+            raise ValueError("leaf count does not match proof range")
+        computed = self._compute_root(leaf_namespaces, leaf_data)
+        if computed != root:
+            raise ValueError("nmt range proof verification failed")
+
+    def _compute_root(self, leaf_namespaces, leaf_data) -> bytes:
+        nodes_iter = iter(self.nodes)
+        total = self.tree_size
+        if total is None:
+            raise ValueError("tree_size must be set before verification")
+
+        def rec(lo: int, hi: int) -> bytes:
+            if hi <= self.start or lo >= self.end:
+                return next(nodes_iter)
+            if hi - lo == 1:
+                i = lo - self.start
+                return hash_leaf(leaf_namespaces[i] + leaf_data[i])
+            split = _split_point(hi - lo)
+            return hash_node(rec(lo, lo + split), rec(lo + split, hi))
+
+        root = rec(0, total)
+        leftover = next(nodes_iter, None)
+        if leftover is not None:
+            raise ValueError("unconsumed proof nodes")
+        return root
+
+
+def nmt_prove_range(
+    leaves: list[bytes], start: int, end: int
+) -> NmtRangeProof:
+    """Range proof over namespaced leaves (each = 29-byte ns ‖ data)."""
+    n = len(leaves)
+    if not (0 <= start < end <= n):
+        raise ValueError(f"invalid range [{start}, {end}) of {n}")
+    nodes: list[bytes] = []
+
+    # record the maximal fully-outside subtree roots, in traversal order
+    def collect(lo: int, hi: int) -> None:
+        if hi <= start or lo >= end:
+            nodes.append(_subtree_root(leaves, lo, hi))
+            return
+        if hi - lo == 1:
+            return
+        split = _split_point(hi - lo)
+        collect(lo, lo + split)
+        collect(lo + split, hi)
+
+    collect(0, n)
+    proof = NmtRangeProof(start=start, end=end, nodes=nodes)
+    proof.tree_size = n
+    return proof
+
+
+def _subtree_root(leaves: list[bytes], lo: int, hi: int) -> bytes:
+    if hi - lo == 1:
+        return hash_leaf(leaves[lo])
+    split = _split_point(hi - lo)
+    return hash_node(
+        _subtree_root(leaves, lo, lo + split), _subtree_root(leaves, lo + split, hi)
+    )
+
+
+# ---------------------------------------------------------------------- #
+# Share / tx inclusion proofs
+
+
+@dataclasses.dataclass
+class RowProof:
+    row_roots: list[bytes]  # 90-byte NMT roots of the touched rows
+    proofs: list[MerkleProof]  # each row root -> data root
+    start_row: int
+    end_row: int
+
+    def verify(self, data_root: bytes) -> None:
+        if len(self.row_roots) != len(self.proofs):
+            raise ValueError("row root / proof count mismatch")
+        for root, proof in zip(self.row_roots, self.proofs):
+            proof.verify(data_root, root)
+
+
+@dataclasses.dataclass
+class ShareProof:
+    data: list[bytes]  # the raw shares being proven
+    share_proofs: list[NmtRangeProof]  # one per touched row
+    namespace: Namespace
+    row_proof: RowProof
+
+    def validate(self, data_root: bytes) -> None:
+        """Full verification against the data root.
+        ref: celestia-core types.ShareProof.Validate semantics"""
+        if len(self.share_proofs) != len(self.row_proof.row_roots):
+            raise ValueError("share proof / row root count mismatch")
+        self.row_proof.verify(data_root)
+
+        cursor = 0
+        for proof, row_root in zip(self.share_proofs, self.row_proof.row_roots):
+            count = proof.end - proof.start
+            row_shares = self.data[cursor : cursor + count]
+            if len(row_shares) != count:
+                raise ValueError("share count does not match proof range")
+            # Q0 leaves carry their own namespace (shares proven here are
+            # always in the original square; parity cells use the parity
+            # namespace and are never individually proven by the app).
+            leaf_ns = [s[:NAMESPACE_SIZE] for s in row_shares]
+            proof.verify_inclusion(row_root, leaf_ns, row_shares)
+            cursor += count
+        if cursor != len(self.data):
+            raise ValueError("extra shares beyond proof ranges")
+
+
+def new_share_inclusion_proof(
+    data_square: list[Share], namespace: Namespace, share_range: Range
+) -> ShareProof:
+    """ref: pkg/proof/proof.go:58-165"""
+    from celestia_tpu import square as square_pkg
+
+    square_size = square_pkg.square_size(len(data_square))
+    start_row = share_range.start // square_size
+    end_row = (share_range.end - 1) // square_size
+    start_leaf = share_range.start % square_size
+    end_leaf = (share_range.end - 1) % square_size
+
+    eds = da.extend_shares(to_bytes(data_square))
+    row_roots_all = eds.row_roots()
+    col_roots_all = eds.col_roots()
+
+    _data_root, all_proofs = merkle_proofs(row_roots_all + col_roots_all)
+
+    parity_ns = ns_pkg.PARITY_SHARES_NAMESPACE.bytes
+    share_proofs: list[NmtRangeProof] = []
+    raw_shares: list[bytes] = []
+    row_roots: list[bytes] = []
+    row_merkle_proofs: list[MerkleProof] = []
+    for i, row_idx in enumerate(range(start_row, end_row + 1)):
+        row_cells = eds.row(row_idx)
+        leaves = [
+            (cell[:NAMESPACE_SIZE] if pos < square_size else parity_ns) + cell
+            for pos, cell in enumerate(row_cells)
+        ]
+        if nmt_root(leaves) != row_roots_all[row_idx]:
+            raise ValueError("eds row root is different than tree root")
+
+        s = start_leaf if i == 0 else 0
+        e = end_leaf if row_idx == end_row else square_size - 1
+        raw_shares.extend(row_cells[s : e + 1])
+        share_proofs.append(nmt_prove_range(leaves, s, e + 1))
+        row_roots.append(row_roots_all[row_idx])
+        row_merkle_proofs.append(all_proofs[row_idx])
+
+    return ShareProof(
+        data=raw_shares,
+        share_proofs=share_proofs,
+        namespace=namespace,
+        row_proof=RowProof(
+            row_roots=row_roots,
+            proofs=row_merkle_proofs,
+            start_row=start_row,
+            end_row=end_row,
+        ),
+    )
+
+
+def new_tx_inclusion_proof(txs: list[bytes], tx_index: int, app_version: int) -> ShareProof:
+    """ref: pkg/proof/proof.go:23-45"""
+    from celestia_tpu import appconsts, blob as blob_pkg
+    from celestia_tpu import square as square_pkg
+
+    if tx_index >= len(txs):
+        raise ValueError(f"txIndex {tx_index} out of bounds")
+    builder = square_pkg.Builder.from_txs(
+        appconsts.square_size_upper_bound(app_version), app_version, txs
+    )
+    data_square = builder.export()
+    share_range = builder.find_tx_share_range(tx_index)
+
+    _, is_blob_tx = blob_pkg.unmarshal_blob_tx(txs[tx_index])
+    namespace = ns_pkg.PAY_FOR_BLOB_NAMESPACE if is_blob_tx else ns_pkg.TX_NAMESPACE
+    return new_share_inclusion_proof(data_square, namespace, share_range)

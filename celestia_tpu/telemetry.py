@@ -1,0 +1,84 @@
+"""Telemetry: counters, gauges, and timers with a Prometheus text export.
+
+Reference semantics: Cosmos SDK telemetry timers/counters on the proposal
+paths (app/prepare_proposal.go:23, app/process_proposal.go:25,31,
+app/validate_txs.go:60,89) and CometBFT's Prometheus metrics endpoint
+(node.DefaultMetricsProvider, test/util/testnode/full_node.go:56).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: dict[str, float] = collections.defaultdict(float)
+        self.gauges: dict[str, float] = {}
+        self.timings: dict[str, list[float]] = collections.defaultdict(list)
+
+    def incr_counter(self, name: str, value: float = 1.0, **labels) -> None:
+        with self._lock:
+            self.counters[_key(name, labels)] += value
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        with self._lock:
+            self.gauges[_key(name, labels)] = value
+
+    def measure_since(self, name: str, start: float, **labels) -> None:
+        with self._lock:
+            self.timings[_key(name, labels)].append(time.perf_counter() - start)
+
+    def measure(self, name: str, **labels):
+        """Context manager timing a block."""
+        return _Timer(self, name, labels)
+
+    def prometheus_text(self) -> str:
+        """Render in the Prometheus exposition format."""
+        lines = []
+        with self._lock:
+            for key, value in sorted(self.counters.items()):
+                lines.append(f"{key} {value}")
+            for key, value in sorted(self.gauges.items()):
+                lines.append(f"{key} {value}")
+            for key, samples in sorted(self.timings.items()):
+                base = key.split("{")[0]
+                labels = key[len(base):]
+                lines.append(f"{base}_seconds_count{labels} {len(samples)}")
+                lines.append(f"{base}_seconds_sum{labels} {sum(samples)}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counters.clear()
+            self.gauges.clear()
+            self.timings.clear()
+
+
+class _Timer:
+    def __init__(self, registry: Registry, name: str, labels: dict):
+        self.registry = registry
+        self.name = name
+        self.labels = labels
+
+    def __enter__(self):
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.registry.measure_since(self.name, self.start, **self.labels)
+        return False
+
+
+def _key(name: str, labels: dict) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+# process-global registry (the SDK telemetry singleton analogue)
+metrics = Registry()

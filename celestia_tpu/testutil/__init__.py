@@ -1,0 +1,25 @@
+"""Test harnesses: single-process devnet, malicious apps, multi-validator
+network simulation (reference: test/util/testnode, test/util/malicious,
+test/e2e)."""
+
+from celestia_tpu.app import App
+from celestia_tpu.crypto import PrivateKey
+from celestia_tpu.node import Node
+
+
+def testnode(accounts: dict[str, int] | None = None, home: str | None = None,
+             **app_kwargs) -> Node:
+    """Boot a single-validator in-process chain with the first (empty)
+    block committed — the testnode.NewNetwork analogue
+    (test/util/testnode/full_node.go:70)."""
+    app = App(**app_kwargs)
+    app.init_chain(accounts or {}, genesis_time=0.0)
+    node = Node(app, home=home)
+    node.produce_block(15.0)
+    return node
+
+
+def funded_keys(n: int, amount: int = 10_000_000_000):
+    """n deterministic keys + the genesis account map funding them."""
+    keys = [PrivateKey.from_secret(f"testnode-{i}".encode()) for i in range(n)]
+    return keys, {k.bech32_address(): amount for k in keys}

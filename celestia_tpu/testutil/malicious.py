@@ -1,0 +1,79 @@
+"""Malicious-proposer fixtures — fault injection for consensus tests.
+
+Reference semantics: test/util/malicious (app.go:15-60 BehaviorConfig,
+out_of_order_builder.go, tree.go BlindTree): a proposer that builds
+squares violating the deterministic layout rules but computes a
+*consistent* DAH over its malformed square, so the only line of defense is
+the honest validators' exact square reconstruction in ProcessProposal.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from celestia_tpu import appconsts, blob as blob_pkg, da
+from celestia_tpu import square as square_pkg
+from celestia_tpu.app import App
+from celestia_tpu.app.app import ProposalBlockData
+from celestia_tpu.shares import to_bytes
+from celestia_tpu.shares.splitters import SparseShareSplitter, split_txs
+
+
+@dataclasses.dataclass
+class BehaviorConfig:
+    """Which layout rule to break. ref: malicious/app.go BehaviorConfig"""
+
+    out_of_order_blobs: bool = False  # don't sort blobs by namespace
+    ignore_padding: bool = False  # drop the commitment-rule padding
+
+
+class MaliciousApp(App):
+    """An App whose PrepareProposal builds rule-breaking squares."""
+
+    def __init__(self, *args, behavior: BehaviorConfig | None = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.behavior = behavior or BehaviorConfig()
+
+    def prepare_proposal(self, mempool_txs, block_data_size=None):
+        if self.height == 0 or not (
+            self.behavior.out_of_order_blobs or self.behavior.ignore_padding
+        ):
+            return super().prepare_proposal(mempool_txs, block_data_size)
+
+        store = self.store.branch()
+        from celestia_tpu.app.context import ExecMode
+
+        ctx = self._new_ctx(store, ExecMode.PREPARE)
+        txs = self.filter_txs(ctx, mempool_txs)
+        square = self._build_malicious_square(txs)
+        eds = da.extend_shares(to_bytes(square))
+        dah = da.new_data_availability_header(eds)
+        return ProposalBlockData(
+            txs=txs,
+            square_size=square_pkg.square_size(len(square)),
+            hash=dah.hash(),
+        )
+
+    def _build_malicious_square(self, txs):
+        """Lay blobs in arrival order and/or without alignment padding
+        (ref: malicious/out_of_order_builder.go)."""
+        normal, blobs = [], []
+        for tx in txs:
+            btx, is_blob = blob_pkg.unmarshal_blob_tx(tx)
+            if is_blob:
+                blobs.extend(btx.blobs)
+                normal.append(
+                    blob_pkg.marshal_index_wrapper(btx.tx, [0] * len(btx.blobs))
+                )
+            else:
+                normal.append(tx)
+
+        tx_shares, pfb_shares, _ = split_txs(normal)
+        writer = SparseShareSplitter()
+        for b in blobs:  # arrival order — NOT namespace-sorted
+            writer.write(b)
+        shares = tx_shares + pfb_shares + writer.export()
+        total = square_pkg.square_size(len(shares)) ** 2
+        from celestia_tpu.shares import tail_padding_shares
+
+        return shares + tail_padding_shares(total - len(shares))

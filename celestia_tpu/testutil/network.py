@@ -1,0 +1,84 @@
+"""Multi-validator network simulation — the in-process e2e harness.
+
+Reference semantics: test/e2e (knuu testnet: N validators, genesis
+ceremony, txsim, per-block app-version assertions). Real networking is
+celestia-core's job (SURVEY §1 L0); what the app layer must guarantee —
+and what this harness exercises — is N replicas staying in perfect
+agreement: round-robin proposers, every validator voting via
+ProcessProposal, 2/3+ acceptance to commit, and identical app/data hashes
+afterward.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from celestia_tpu.app import App
+from celestia_tpu.app.app import ProposalBlockData
+
+
+class ConsensusFailure(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class CommittedBlock:
+    height: int
+    proposer: int
+    block: ProposalBlockData
+    app_hash: bytes
+    accept_votes: int
+
+
+class Network:
+    """N validator replicas of the state machine."""
+
+    def __init__(self, n_validators: int, genesis_accounts: dict[str, int],
+                 make_app=None, genesis_time: float = 0.0):
+        make_app = make_app or (lambda i: App())
+        self.apps: list[App] = []
+        for i in range(n_validators):
+            app = make_app(i)
+            app.init_chain(dict(genesis_accounts), genesis_time=genesis_time)
+            self.apps.append(app)
+        self.committed: list[CommittedBlock] = []
+
+    @property
+    def height(self) -> int:
+        return self.apps[0].height
+
+    def produce_block(self, mempool_txs: list[bytes] | None = None,
+                      proposer: int | None = None) -> CommittedBlock:
+        """One consensus round: propose -> vote -> (2/3+) -> commit."""
+        n = len(self.apps)
+        proposer = proposer if proposer is not None else self.height % n
+        proposal = self.apps[proposer].prepare_proposal(mempool_txs or [])
+
+        votes = sum(
+            1 for i, app in enumerate(self.apps) if app.process_proposal(proposal)
+        )
+        if votes * 3 < n * 2:
+            raise ConsensusFailure(
+                f"proposal at height {self.height + 1} got {votes}/{n} votes"
+            )
+
+        app_hashes = set()
+        data_time = self.apps[0].block_time + 15.0
+        for app in self.apps:
+            app.begin_block(data_time)
+            for tx in proposal.txs:
+                app.deliver_tx(tx)
+            app.end_block()
+            app_hashes.add(app.commit())
+        if len(app_hashes) != 1:
+            raise ConsensusFailure(f"state divergence: {len(app_hashes)} app hashes")
+
+        block = CommittedBlock(
+            height=self.height,
+            proposer=proposer,
+            block=proposal,
+            app_hash=app_hashes.pop(),
+            accept_votes=votes,
+        )
+        self.committed.append(block)
+        return block

@@ -1,0 +1,159 @@
+"""x/staking analogue: bonded validator set with voting power.
+
+The reference wires the stock SDK staking module (app/app.go:209-239,
+BondDenom=utia). The capabilities the DA chain itself exercises are the
+bonded validator set (consensus power, blobstream valsets hook into it)
+and delegate/undelegate flows; this module provides those over the
+framework's store + msg registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from celestia_tpu.blob import _field_bytes, _parse_fields, _require_wt
+from celestia_tpu.tx import register_msg
+from celestia_tpu.x.bank import BONDED_POOL
+
+VALIDATOR_PREFIX = b"staking/validator/"
+LAST_UNBONDING_HEIGHT_KEY = b"staking/lastUnbondingHeight"
+POWER_REDUCTION = 1_000_000  # utia per unit of consensus power
+
+
+@dataclasses.dataclass
+class Validator:
+    operator: str  # bech32 account address of the operator
+    tokens: int  # bonded utia
+    moniker: str = ""
+
+    @property
+    def power(self) -> int:
+        return self.tokens // POWER_REDUCTION
+
+    def marshal(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True).encode()
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "Validator":
+        return cls(**json.loads(raw))
+
+
+class StakingKeeper:
+    def __init__(self, store, bank):
+        self.store = store
+        self.bank = bank
+        self.hooks: list = []  # e.g. blobstream (app/app.go:349-354)
+
+    def get_validator(self, operator: str) -> Validator | None:
+        raw = self.store.get(VALIDATOR_PREFIX + operator.encode())
+        return Validator.unmarshal(raw) if raw else None
+
+    def set_validator(self, v: Validator) -> None:
+        self.store.set(VALIDATOR_PREFIX + v.operator.encode(), v.marshal())
+
+    def bonded_validators(self) -> list[Validator]:
+        vals = [
+            Validator.unmarshal(raw)
+            for _k, raw in self.store.iter_prefix(VALIDATOR_PREFIX)
+        ]
+        vals = [v for v in vals if v.power > 0]
+        # deterministic order: descending power, then operator
+        vals.sort(key=lambda v: (-v.power, v.operator))
+        return vals
+
+    def total_power(self) -> int:
+        return sum(v.power for v in self.bonded_validators())
+
+    def delegate(self, ctx, delegator: str, validator_operator: str, amount: int) -> None:
+        self.bank.send(delegator, BONDED_POOL, amount)
+        v = self.get_validator(validator_operator) or Validator(validator_operator, 0)
+        v.tokens += amount
+        self.set_validator(v)
+
+    def undelegate(self, ctx, delegator: str, validator_operator: str, amount: int) -> None:
+        v = self.get_validator(validator_operator)
+        if v is None or v.tokens < amount:
+            raise ValueError("insufficient bonded tokens")
+        v.tokens -= amount
+        self.set_validator(v)
+        self.bank.send(BONDED_POOL, delegator, amount)
+        self.store.set(
+            LAST_UNBONDING_HEIGHT_KEY, ctx.block_height.to_bytes(8, "big")
+        )
+        for hook in self.hooks:
+            hook.after_validator_bond_change(ctx)
+
+    def last_unbonding_height(self) -> int:
+        raw = self.store.get(LAST_UNBONDING_HEIGHT_KEY)
+        return int.from_bytes(raw, "big") if raw else 0
+
+
+URL_MSG_DELEGATE = "/cosmos.staking.v1beta1.MsgDelegate"
+URL_MSG_UNDELEGATE = "/cosmos.staking.v1beta1.MsgUndelegate"
+
+
+def _staking_msg_fields(m) -> bytes:
+    coin = _field_bytes(1, m.denom.encode()) + _field_bytes(2, str(m.amount).encode())
+    return (
+        _field_bytes(1, m.delegator.encode())
+        + _field_bytes(2, m.validator.encode())
+        + _field_bytes(3, coin)
+    )
+
+
+def _parse_staking_msg(cls, raw: bytes):
+    m = cls("", "", 0)
+    for tag, wt, val in _parse_fields(raw):
+        if tag == 1:
+            _require_wt(wt, 2, tag)
+            m.delegator = bytes(val).decode()
+        elif tag == 2:
+            _require_wt(wt, 2, tag)
+            m.validator = bytes(val).decode()
+        elif tag == 3:
+            _require_wt(wt, 2, tag)
+            for t2, w2, v2 in _parse_fields(bytes(val)):
+                if t2 == 1:
+                    m.denom = bytes(v2).decode()
+                elif t2 == 2:
+                    m.amount = int(bytes(v2).decode())
+    return m
+
+
+@register_msg(URL_MSG_DELEGATE)
+@dataclasses.dataclass
+class MsgDelegate:
+    delegator: str
+    validator: str
+    amount: int
+    denom: str = "utia"
+
+    marshal = _staking_msg_fields
+
+    @classmethod
+    def unmarshal(cls, raw):
+        return _parse_staking_msg(cls, raw)
+
+    def validate_basic(self):
+        if self.amount <= 0:
+            raise ValueError("delegation amount must be positive")
+
+
+@register_msg(URL_MSG_UNDELEGATE)
+@dataclasses.dataclass
+class MsgUndelegate:
+    delegator: str
+    validator: str
+    amount: int
+    denom: str = "utia"
+
+    marshal = _staking_msg_fields
+
+    @classmethod
+    def unmarshal(cls, raw):
+        return _parse_staking_msg(cls, raw)
+
+    def validate_basic(self):
+        if self.amount <= 0:
+            raise ValueError("undelegation amount must be positive")

@@ -1,0 +1,58 @@
+"""x/tokenfilter — IBC middleware rejecting inbound non-native tokens.
+
+Reference semantics: x/tokenfilter/ibc_middleware.go:22-50 — on a received
+ICS-20 transfer packet, only the native token returning home is accepted:
+a denom is "returning" when its trace starts with this chain's (port,
+channel) prefix, meaning the token originated here. Anything else is
+rejected with an error acknowledgement, not a panic, so the relayer gets a
+refund on the counterparty.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class FungibleTokenPacket:
+    denom: str  # full trace, e.g. "transfer/channel-0/utia"
+    amount: int
+    sender: str
+    receiver: str
+
+
+@dataclasses.dataclass
+class Acknowledgement:
+    success: bool
+    error: str = ""
+
+
+def receiver_chain_is_source(source_port: str, source_channel: str, denom: str) -> bool:
+    """True when the denom is a voucher minted for a token that originated
+    on the receiving chain (the trace is prefixed by the packet's source
+    port/channel). ref: ibc-go transfer types.ReceiverChainIsSource"""
+    voucher_prefix = f"{source_port}/{source_channel}/"
+    return denom.startswith(voucher_prefix)
+
+
+class TokenFilterMiddleware:
+    """Wraps a transfer app's OnRecvPacket. ref: ibc_middleware.go:22-50"""
+
+    def __init__(self, transfer_app=None):
+        self.transfer_app = transfer_app
+
+    def on_recv_packet(
+        self, source_port: str, source_channel: str, packet: FungibleTokenPacket
+    ) -> Acknowledgement:
+        if receiver_chain_is_source(source_port, source_channel, packet.denom):
+            # native token returning home: pass through to the transfer app
+            if self.transfer_app is not None:
+                return self.transfer_app.on_recv_packet(
+                    source_port, source_channel, packet
+                )
+            return Acknowledgement(success=True)
+        return Acknowledgement(
+            success=False,
+            error=f"denom {packet.denom} not allowed: only the native token "
+            "may be transferred to this chain",
+        )

@@ -7,13 +7,18 @@ exercised without TPU hardware. This must happen before jax is imported.
 import os
 
 # Hard override: the environment's sitecustomize pins JAX_PLATFORMS to the
-# axon TPU tunnel; tests must run on the virtual 8-device CPU mesh.
+# axon TPU tunnel and wins over env vars; only jax.config wins over it.
+# Tests must run on the virtual 8-device CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

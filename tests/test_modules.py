@@ -1,0 +1,181 @@
+"""Peripheral module tests: staking, blobstream attestations, paramfilter,
+tokenfilter (reference model: x/blobstream/abci_test.go,
+x/paramfilter/gov_handler_test.go, x/tokenfilter tests)."""
+
+import pytest
+
+from celestia_tpu.app import App
+from celestia_tpu.app.context import Context, ExecMode
+from celestia_tpu.crypto import PrivateKey
+from celestia_tpu.tx import Fee, sign_tx
+from celestia_tpu.x.blobstream import (
+    DEFAULT_DATA_COMMITMENT_WINDOW,
+    BlobstreamKeeper,
+    MsgRegisterEVMAddress,
+)
+from celestia_tpu.x.paramfilter import (
+    ForbiddenParamError,
+    ParamChange,
+    ParamFilter,
+    apply_param_changes,
+)
+from celestia_tpu.x.staking import MsgDelegate, MsgUndelegate, StakingKeeper
+from celestia_tpu.x.tokenfilter import (
+    Acknowledgement,
+    FungibleTokenPacket,
+    TokenFilterMiddleware,
+)
+
+VALIDATOR = PrivateKey.from_secret(b"validator")
+ALICE = PrivateKey.from_secret(b"alice")
+
+
+def fresh_app():
+    app = App()
+    app.init_chain(
+        {
+            VALIDATOR.bech32_address(): 1_000_000_000_000,
+            ALICE.bech32_address(): 500_000_000_000,
+        },
+        genesis_time=0.0,
+    )
+    p0 = app.prepare_proposal([])
+    assert app.process_proposal(p0)
+    app.begin_block(15.0)
+    app.end_block()
+    app.commit()
+    return app
+
+
+def run_block(app, txs):
+    block = app.prepare_proposal(txs)
+    assert app.process_proposal(block), "proposal rejected"
+    app.begin_block(app.block_time + 15.0)
+    results = [app.deliver_tx(t) for t in block.txs]
+    for r in results:
+        assert r.code == 0, r.log
+    app.end_block()
+    app.commit()
+    return block
+
+
+def make_tx(app, key, msgs):
+    acc = app.accounts.get_account(key.bech32_address())
+    return sign_tx(
+        key, msgs, app.chain_id, acc.account_number, acc.sequence,
+        Fee(amount=300_000, gas_limit=300_000),
+    ).marshal()
+
+
+class TestStaking:
+    def test_delegate_undelegate(self):
+        app = fresh_app()
+        val_addr = VALIDATOR.bech32_address()
+        run_block(app, [make_tx(app, VALIDATOR,
+                                [MsgDelegate(val_addr, val_addr, 500_000_000)])])
+        v = app.staking.get_validator(val_addr)
+        assert v.tokens == 500_000_000
+        assert v.power == 500
+        assert app.staking.total_power() == 500
+
+        run_block(app, [make_tx(app, VALIDATOR,
+                                [MsgUndelegate(val_addr, val_addr, 100_000_000)])])
+        assert app.staking.get_validator(val_addr).power == 400
+        assert app.staking.last_unbonding_height() > 0
+
+
+class TestBlobstream:
+    def _bonded_app(self):
+        app = fresh_app()
+        val_addr = VALIDATOR.bech32_address()
+        run_block(app, [make_tx(app, VALIDATOR,
+                                [MsgDelegate(val_addr, val_addr, 500_000_000)])])
+        return app, val_addr
+
+    def test_first_valset_created(self):
+        app, _ = self._bonded_app()
+        run_block(app, [])
+        valset = app.blobstream.latest_valset()
+        assert valset is not None
+        assert len(valset["members"]) == 1
+
+    def test_valset_on_significant_power_change(self):
+        app, val_addr = self._bonded_app()
+        run_block(app, [])  # first valset
+        nonce_before = app.blobstream.latest_nonce()
+        # alice delegates a second validator with comparable power (>5% diff)
+        alice_addr = ALICE.bech32_address()
+        run_block(app, [make_tx(app, ALICE,
+                                [MsgDelegate(alice_addr, alice_addr, 500_000_000)])])
+        assert app.blobstream.latest_nonce() > nonce_before
+        valset = app.blobstream.latest_valset()
+        assert len(valset["members"]) == 2
+
+    def test_evm_address_registration(self):
+        app, val_addr = self._bonded_app()
+        evm = "0x" + "ab" * 20
+        run_block(app, [make_tx(app, VALIDATOR,
+                                [MsgRegisterEVMAddress(val_addr, evm)])])
+        assert app.blobstream.evm_address(val_addr) == evm
+
+    def test_data_commitments_over_windows(self):
+        app, _ = self._bonded_app()
+        app.blobstream.data_commitment_window = 5
+        app.store.commit_hash_refresh()
+        for _ in range(12):
+            run_block(app, [])
+        dc = app.blobstream.latest_data_commitment()
+        assert dc is not None
+        assert dc["begin_block"] >= 1
+        assert dc["end_block"] - dc["begin_block"] == 4
+        # catch-up created multiple commitments
+        nonces = [
+            app.blobstream.get_attestation(n)
+            for n in range(1, app.blobstream.latest_nonce() + 1)
+        ]
+        dcs = [a for a in nonces if a and a["type"] == "data_commitment"]
+        assert len(dcs) >= 2
+
+
+class TestParamFilter:
+    def test_forbidden_param_blocked(self):
+        with pytest.raises(ForbiddenParamError):
+            ParamFilter().check([ParamChange("staking", "BondDenom", "ufoo")])
+
+    def test_allowed_param_applied(self):
+        app = fresh_app()
+        apply_param_changes(app, [ParamChange("blob", "GovMaxSquareSize", "32")])
+        assert app.blob.get_params().gov_max_square_size == 32
+        apply_param_changes(app, [ParamChange("blobstream", "DataCommitmentWindow", "100")])
+        assert app.blobstream.data_commitment_window == 100
+
+    def test_mixed_proposal_fully_rejected(self):
+        app = fresh_app()
+        before = app.blob.get_params().gov_max_square_size
+        with pytest.raises(ForbiddenParamError):
+            apply_param_changes(app, [
+                ParamChange("blob", "GovMaxSquareSize", "32"),
+                ParamChange("staking", "UnbondingTime", "1"),
+            ])
+        assert app.blob.get_params().gov_max_square_size == before
+
+
+class TestTokenFilter:
+    def test_native_token_returning_accepted(self):
+        mw = TokenFilterMiddleware()
+        packet = FungibleTokenPacket("transfer/channel-0/utia", 100, "a", "b")
+        ack = mw.on_recv_packet("transfer", "channel-0", packet)
+        assert ack.success
+
+    def test_foreign_token_rejected(self):
+        mw = TokenFilterMiddleware()
+        packet = FungibleTokenPacket("uatom", 100, "a", "b")
+        ack = mw.on_recv_packet("transfer", "channel-0", packet)
+        assert not ack.success
+        assert "not allowed" in ack.error
+
+    def test_other_channel_voucher_rejected(self):
+        mw = TokenFilterMiddleware()
+        packet = FungibleTokenPacket("transfer/channel-9/utia", 100, "a", "b")
+        ack = mw.on_recv_packet("transfer", "channel-0", packet)
+        assert not ack.success

@@ -1,0 +1,74 @@
+"""Multi-validator agreement + malicious-proposer rejection tests
+(reference model: test/util/malicious/app_test.go, test/e2e/simple_test.go)."""
+
+import numpy as np
+import pytest
+
+import celestia_tpu.namespace as ns
+from celestia_tpu import blob as blob_pkg
+from celestia_tpu.testutil import funded_keys
+from celestia_tpu.testutil.malicious import BehaviorConfig, MaliciousApp
+from celestia_tpu.testutil.network import ConsensusFailure, Network
+from celestia_tpu.tx import Fee, sign_tx
+from celestia_tpu.x.blob.types import estimate_gas, new_msg_pay_for_blobs
+
+RNG = np.random.default_rng(21)
+KEYS, GENESIS = funded_keys(3)
+
+
+def pfb_tx(app, key, size, sub_id=b"net-test"):
+    b = blob_pkg.new_blob(ns.new_v0(sub_id), RNG.integers(0, 256, size, np.uint8).tobytes(), 0)
+    acc = app.accounts.get_account(key.bech32_address())
+    msg = new_msg_pay_for_blobs(key.bech32_address(), b)
+    gas = estimate_gas([size])
+    tx = sign_tx(key, [msg], app.chain_id, acc.account_number, acc.sequence,
+                 Fee(amount=gas, gas_limit=gas))
+    return blob_pkg.marshal_blob_tx(tx.marshal(), [b])
+
+
+class TestMultiValidator:
+    def test_replicas_agree(self):
+        net = Network(4, GENESIS)
+        net.produce_block()  # empty first block
+        for i in range(3):
+            txs = [pfb_tx(net.apps[0], KEYS[0], 1000 + 500 * i)]
+            block = net.produce_block(txs)
+            assert block.accept_votes == 4
+        assert net.height == 4
+        # all replicas identical
+        hashes = {app.store.app_hashes[app.store.version] for app in net.apps}
+        assert len(hashes) == 1
+
+    def test_round_robin_proposers(self):
+        net = Network(3, GENESIS)
+        for _ in range(4):
+            net.produce_block()
+        assert [b.proposer for b in net.committed] == [0, 1, 2, 0]
+
+
+class TestMaliciousProposer:
+    def _net_with_malicious(self, behavior):
+        def make_app(i):
+            if i == 0:
+                return MaliciousApp(behavior=behavior)
+            from celestia_tpu.app import App
+
+            return App()
+
+        return Network(4, GENESIS, make_app=make_app)
+
+    def test_out_of_order_square_rejected(self):
+        net = self._net_with_malicious(BehaviorConfig(out_of_order_blobs=True))
+        net.produce_block(proposer=1)  # empty first block from honest node
+        # two blobs with descending namespaces force an ordering violation
+        app = net.apps[0]
+        tx1 = pfb_tx(app, KEYS[0], 600, sub_id=b"zzzz")
+        tx2 = pfb_tx(app, KEYS[1], 600, sub_id=b"aaaa")
+        with pytest.raises(ConsensusFailure, match="votes"):
+            net.produce_block([tx1, tx2], proposer=0)
+
+    def test_honest_blocks_still_accepted(self):
+        net = self._net_with_malicious(BehaviorConfig())  # behavior disabled
+        net.produce_block(proposer=1)
+        block = net.produce_block([pfb_tx(net.apps[0], KEYS[0], 500)], proposer=0)
+        assert block.accept_votes == 4

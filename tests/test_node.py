@@ -158,6 +158,18 @@ class TestRpc:
                                          method="POST")
             block = json.loads(urllib.request.urlopen(req).read())
             assert len(block["txs"]) == 1
+
+            # telemetry exported in prometheus format
+            metrics_text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert "prepare_proposal_seconds_count" in metrics_text
+            assert "process_proposal_seconds_count" in metrics_text
+
+            # tx inclusion proof over RPC (validated server-side)
+            proof = json.loads(
+                urllib.request.urlopen(f"{base}/proof/tx/{block['height']}:0").read()
+            )
+            assert proof["row_proof"]["row_roots"]
+            assert proof["share_proofs"]
         finally:
             server.stop()
 

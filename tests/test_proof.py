@@ -1,0 +1,138 @@
+"""Proof tests: merkle proofs, NMT range proofs, share/tx inclusion
+proofs, commitment-from-square (reference model: pkg/proof/proof_test.go,
+pkg/inclusion tests)."""
+
+import numpy as np
+import pytest
+
+import celestia_tpu.namespace as ns
+from celestia_tpu import appconsts, blob as blob_pkg, da, inclusion, square
+from celestia_tpu.inclusion.cache import EDSSubtreeRootCacher, get_commitment
+from celestia_tpu.ops.nmt_host import merkle_root, nmt_root
+from celestia_tpu.proof import (
+    merkle_proofs,
+    new_share_inclusion_proof,
+    new_tx_inclusion_proof,
+    nmt_prove_range,
+)
+from celestia_tpu.shares import to_bytes
+from celestia_tpu.shares.splitters import Range, sparse_shares_needed
+
+RNG = np.random.default_rng(11)
+
+
+def rand_bytes(n):
+    return RNG.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+def make_blob_tx(sizes, sub_ids=None):
+    blobs = [
+        blob_pkg.new_blob(ns.new_v0(sub_ids[i] if sub_ids else rand_bytes(5)), rand_bytes(s), 0)
+        for i, s in enumerate(sizes)
+    ]
+    return blob_pkg.marshal_blob_tx(rand_bytes(64), blobs)
+
+
+class TestMerkleProofs:
+    @pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 13])
+    def test_roundtrip(self, n):
+        items = [rand_bytes(32) for _ in range(n)]
+        root, proofs = merkle_proofs(items)
+        assert root == merkle_root(items)
+        for i, proof in enumerate(proofs):
+            proof.verify(root, items[i])
+
+    def test_wrong_leaf_fails(self):
+        items = [rand_bytes(32) for _ in range(4)]
+        root, proofs = merkle_proofs(items)
+        with pytest.raises(ValueError):
+            proofs[1].verify(root, items[2])
+
+
+class TestNmtRangeProofs:
+    @pytest.mark.parametrize("n,start,end", [(8, 0, 8), (8, 2, 5), (8, 7, 8), (4, 0, 1), (16, 3, 12)])
+    def test_roundtrip(self, n, start, end):
+        namespaces = sorted(
+            ns.new_v0(bytes([i // 2 + 1] * 5)).bytes for i in range(n)
+        )
+        datas = [rand_bytes(64) for _ in range(n)]
+        leaves = [namespaces[i] + datas[i] for i in range(n)]
+        root = nmt_root(leaves)
+        proof = nmt_prove_range(leaves, start, end)
+        proof.verify_inclusion(root, namespaces[start:end], datas[start:end])
+
+    def test_tampered_leaf_fails(self):
+        n = 8
+        namespaces = [ns.new_v0(bytes([1] * 5)).bytes] * n
+        datas = [rand_bytes(64) for _ in range(n)]
+        leaves = [namespaces[i] + datas[i] for i in range(n)]
+        root = nmt_root(leaves)
+        proof = nmt_prove_range(leaves, 2, 5)
+        bad = [bytearray(d) for d in datas[2:5]]
+        bad[0][0] ^= 1
+        with pytest.raises(ValueError):
+            proof.verify_inclusion(root, namespaces[2:5], [bytes(b) for b in bad])
+
+
+class TestShareInclusion:
+    def _square_and_root(self, txs):
+        sq = square.construct(txs, 1, appconsts.DEFAULT_GOV_MAX_SQUARE_SIZE)
+        eds = da.extend_shares(to_bytes(sq))
+        dah = da.new_data_availability_header(eds)
+        return sq, dah
+
+    def test_tx_inclusion_proof(self):
+        txs = [rand_bytes(300), rand_bytes(500), make_blob_tx([2000])]
+        for tx_index in range(3):
+            proof = new_tx_inclusion_proof(txs, tx_index, 1)
+            _sq, dah = self._square_and_root(txs)
+            proof.validate(dah.hash())
+
+    def test_multirow_share_proof(self):
+        # a blob spanning multiple rows of a small square
+        txs = [make_blob_tx([30_000])]
+        sq, dah = self._square_and_root(txs)
+        blob_range = square.blob_share_range(txs, 0, 0, 1)
+        k = square.square_size(len(sq))
+        # clip to the built square (blob_share_range builds at max size)
+        proof = new_share_inclusion_proof(
+            sq, ns.from_bytes(sq[blob_range.start].data[:29]), blob_range
+        )
+        assert proof.row_proof.end_row > proof.row_proof.start_row
+        proof.validate(dah.hash())
+
+    def test_tampered_data_root_fails(self):
+        txs = [rand_bytes(100)]
+        proof = new_tx_inclusion_proof(txs, 0, 1)
+        with pytest.raises(ValueError):
+            proof.validate(b"\x00" * 32)
+
+    def test_tampered_share_fails(self):
+        txs = [rand_bytes(100), rand_bytes(200)]
+        _sq, dah = self._square_and_root(txs)
+        proof = new_tx_inclusion_proof(txs, 1, 1)
+        proof.data[0] = b"\x00" * 512
+        with pytest.raises(ValueError):
+            proof.validate(dah.hash())
+
+
+class TestCommitmentFromSquare:
+    def test_matches_create_commitment(self):
+        """GetCommitment over the EDS row trees == CreateCommitment."""
+        blobs = [
+            blob_pkg.new_blob(ns.new_v0(b"\x01\x02\x03"), rand_bytes(5000), 0),
+            blob_pkg.new_blob(ns.new_v0(b"\x04\x05\x06"), rand_bytes(40_000), 0),
+        ]
+        btx = blob_pkg.marshal_blob_tx(rand_bytes(64), blobs)
+        txs = [btx]
+        builder = square.Builder.from_txs(appconsts.DEFAULT_GOV_MAX_SQUARE_SIZE, 1, txs)
+        sq = builder.export()
+        eds = da.extend_shares(to_bytes(sq))
+        cacher = EDSSubtreeRootCacher(eds)
+        threshold = appconsts.subtree_root_threshold(1)
+
+        for blob_index, b in enumerate(blobs):
+            start = builder.find_blob_starting_index(0, blob_index)
+            blob_len = sparse_shares_needed(len(b.data))
+            commitment = get_commitment(cacher, start, blob_len, threshold)
+            assert commitment == inclusion.create_commitment(b, threshold)
