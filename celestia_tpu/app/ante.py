@@ -58,7 +58,10 @@ class AnteHandler:
         self.blob = BlobKeeper(ctx.store)
         # 1. HandlePanicDecorator: python exceptions propagate; callers wrap.
         # 2. SetUpContextDecorator: per-tx gas meter from the fee gas limit.
-        ctx = ctx.with_gas_meter(tx.fee.gas_limit)
+        #    Attached in place so the caller's ctx reports real gas_used even
+        #    when a later decorator raises (baseapp reports consumed gas for
+        #    failed txs too).
+        ctx.gas_meter = GasMeter(tx.fee.gas_limit)
         # 3. ExtensionOptionsDecorator: format has no extension options (no-op).
         # 4. ValidateBasicDecorator
         self._validate_basic(tx)
@@ -128,12 +131,30 @@ class AnteHandler:
     def _verify_signatures(self, ctx: Context, tx: Tx, simulate: bool) -> None:
         if len(tx.signer_infos) > MAX_SIGNATURES:
             raise ValueError("too many signatures")
+        from celestia_tpu.crypto import bech32_address
+
+        # SigVerificationDecorator semantics: every address a message names
+        # as a required signer (sdk GetSigners) must be among the tx's
+        # verified signers — otherwise any account could act on behalf of
+        # another (MsgSend{from: victim} etc).
+        required: set[str] = set()
+        for msg in tx.msgs:
+            getter = getattr(msg, "get_signers", None)
+            if getter is None:
+                raise ValueError(
+                    f"message {type(msg).__name__} declares no signers"
+                )
+            required.update(getter())
+        provided = {bech32_address(si.public_key) for si in tx.signer_infos}
+        missing = required - provided
+        if missing:
+            raise ValueError(
+                f"missing required signatures from: {sorted(missing)}"
+            )
         for si, sig in zip(tx.signer_infos, tx.signatures):
             ctx.gas_meter.consume(SIG_VERIFY_COST_SECP256K1, "ante verify: secp256k1")
             if simulate:
                 continue
-            from celestia_tpu.crypto import bech32_address
-
             addr = bech32_address(si.public_key)
             acc = self.accounts.get_account(addr)
             if acc is None:

@@ -174,7 +174,16 @@ class App:
                 self._check_store = self.store.branch()
             tx_branch = self._check_store.branch()
             ctx = self._new_ctx(tx_branch, mode)
-            ctx = self._ante()(ctx, tx, len(inner_raw))
+            try:
+                ctx = self._ante()(ctx, tx, len(inner_raw))
+            except Exception as e:  # noqa: BLE001
+                # the ante attaches the per-tx gas meter to ctx in place, so
+                # real consumption is reportable even on failure
+                return TxResult(
+                    code=1, log=str(e),
+                    gas_wanted=tx.fee.gas_limit,
+                    gas_used=ctx.gas_meter.consumed,
+                )
             tx_branch.write()  # persist into check state (not committed state)
             return TxResult(
                 code=0,

@@ -61,13 +61,27 @@ def sharded_extend_and_root(mesh: Mesh, k: int):
 # Explicit-collective spelling (shard_map)
 
 
+def _shard_map(f, mesh, in_specs, out_specs):
+    """Version-portable shard_map: the replication-check kwarg was renamed
+    check_rep -> check_vma across JAX releases; pass whichever exists."""
+    import inspect
+
+    try:
+        sm = jax.shard_map  # jax >= 0.6
+    except AttributeError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map as sm
+    params = inspect.signature(sm).parameters
+    kw = {}
+    if "check_vma" in params:
+        kw["check_vma"] = False
+    elif "check_rep" in params:  # pragma: no cover
+        kw["check_rep"] = False
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
 def extend_and_root_rowsharded(mesh: Mesh, k: int):
     """One square, rows sharded over the 'sp' mesh axis; explicit psum /
     all_gather collectives. Returns a jitted fn of (k, k, 512) uint8."""
-    try:
-        shard_map = jax.shard_map  # jax >= 0.6
-    except AttributeError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
 
     m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
     sp = mesh.shape["sp"]
@@ -150,12 +164,11 @@ def extend_and_root_rowsharded(mesh: Mesh, k: int):
         eds_rows_local = jnp.concatenate([top_local, bottom_local], axis=0)
         return eds_rows_local, row_roots, col_roots, dah
 
-    sharded = shard_map(
+    sharded = _shard_map(
         local_fn,
         mesh=mesh,
         in_specs=P("sp", None, None),
         out_specs=(P("sp", None, None), P(), P(), P()),
-        check_rep=False,
     )
 
     def reassemble(shares):

@@ -72,6 +72,10 @@ class MsgSend:
     amount: int
     denom: str = BOND_DENOM
 
+    def get_signers(self) -> list[str]:
+        """ref: bank MsgSend.GetSigners — the sender must sign."""
+        return [self.from_address]
+
     def marshal(self) -> bytes:
         coin = _field_bytes(1, self.denom.encode()) + _field_bytes(
             2, str(self.amount).encode()

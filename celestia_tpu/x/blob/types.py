@@ -33,6 +33,10 @@ class MsgPayForBlobs:
     share_commitments: list[bytes]
     share_versions: list[int]
 
+    def get_signers(self) -> list[str]:
+        """ref: x/blob/types/payforblob.go GetSigners."""
+        return [self.signer]
+
     def marshal(self) -> bytes:
         out = _field_bytes(1, self.signer.encode())
         for ns in self.namespaces:

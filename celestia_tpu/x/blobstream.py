@@ -76,6 +76,11 @@ def _register_msg_types():
         validator_address: str
         evm_address: str
 
+        def get_signers(self) -> list[str]:
+            """ref: x/blobstream MsgRegisterEVMAddress.GetSigners — only the
+            validator operator may register its own EVM address."""
+            return [self.validator_address]
+
         def marshal(self) -> bytes:
             return _field_bytes(1, self.validator_address.encode()) + _field_bytes(
                 2, self.evm_address.encode()

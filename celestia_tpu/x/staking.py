@@ -17,8 +17,13 @@ from celestia_tpu.tx import register_msg
 from celestia_tpu.x.bank import BONDED_POOL
 
 VALIDATOR_PREFIX = b"staking/validator/"
+DELEGATION_PREFIX = b"staking/delegation/"
 LAST_UNBONDING_HEIGHT_KEY = b"staking/lastUnbondingHeight"
 POWER_REDUCTION = 1_000_000  # utia per unit of consensus power
+
+
+def _delegation_key(delegator: str, validator: str) -> bytes:
+    return DELEGATION_PREFIX + delegator.encode() + b"/" + validator.encode()
 
 
 @dataclasses.dataclass
@@ -65,16 +70,40 @@ class StakingKeeper:
     def total_power(self) -> int:
         return sum(v.power for v in self.bonded_validators())
 
+    def get_delegation(self, delegator: str, validator_operator: str) -> int:
+        raw = self.store.get(_delegation_key(delegator, validator_operator))
+        return int.from_bytes(raw, "big") if raw else 0
+
+    def _set_delegation(self, delegator: str, validator_operator: str, tokens: int) -> None:
+        key = _delegation_key(delegator, validator_operator)
+        if tokens > 0:
+            self.store.set(key, tokens.to_bytes(16, "big"))
+        else:
+            self.store.delete(key)
+
     def delegate(self, ctx, delegator: str, validator_operator: str, amount: int) -> None:
         self.bank.send(delegator, BONDED_POOL, amount)
         v = self.get_validator(validator_operator) or Validator(validator_operator, 0)
         v.tokens += amount
         self.set_validator(v)
+        self._set_delegation(
+            delegator, validator_operator,
+            self.get_delegation(delegator, validator_operator) + amount,
+        )
 
     def undelegate(self, ctx, delegator: str, validator_operator: str, amount: int) -> None:
+        # Per-delegator accounting (SDK Delegation records): a delegator can
+        # only withdraw its own bonded stake, never other delegators'.
+        held = self.get_delegation(delegator, validator_operator)
+        if held < amount:
+            raise ValueError(
+                f"insufficient delegation: {delegator} has {held} bonded to "
+                f"{validator_operator}, requested {amount}"
+            )
         v = self.get_validator(validator_operator)
         if v is None or v.tokens < amount:
             raise ValueError("insufficient bonded tokens")
+        self._set_delegation(delegator, validator_operator, held - amount)
         v.tokens -= amount
         self.set_validator(v)
         self.bank.send(BONDED_POOL, delegator, amount)
@@ -129,6 +158,10 @@ class MsgDelegate:
     amount: int
     denom: str = "utia"
 
+    def get_signers(self) -> list[str]:
+        """ref: staking MsgDelegate.GetSigners — the delegator signs."""
+        return [self.delegator]
+
     marshal = _staking_msg_fields
 
     @classmethod
@@ -147,6 +180,10 @@ class MsgUndelegate:
     validator: str
     amount: int
     denom: str = "utia"
+
+    def get_signers(self) -> list[str]:
+        """ref: staking MsgUndelegate.GetSigners — the delegator signs."""
+        return [self.delegator]
 
     marshal = _staking_msg_fields
 

@@ -37,6 +37,9 @@ class MsgVersionChange:
     def validate_basic(self) -> None:
         pass  # ref: x/upgrade/types.go ValidateBasic returns nil
 
+    def get_signers(self) -> list[str]:
+        return []  # proposer-injected; carries no signers (x/upgrade/types.go)
+
     @staticmethod
     def from_msgs(msgs: list):
         """ref: x/upgrade/types.go IsUpgradeMsg (single-msg txs only)."""

@@ -181,6 +181,84 @@ class TestTxSecurity:
         assert res.code != 0
         assert "not a tx signer" in res.log
 
+    def test_msg_required_signers_enforced(self):
+        """A tx signed only by Bob naming Alice as MsgSend.from must be
+        rejected everywhere (ref: SigVerificationDecorator over
+        tx.GetSigners) — the round-1 advisor PoC."""
+        app = fresh_app()
+        acc = app.accounts.get_account(BOB.bech32_address())
+        theft = sign_tx(
+            BOB,
+            [MsgSend(ALICE.bech32_address(), BOB.bech32_address(), 9_000_000_000)],
+            app.chain_id, acc.account_number, acc.sequence,
+            Fee(amount=100_000, gas_limit=200_000),
+        )
+        res = app.check_tx(theft.marshal())
+        assert res.code != 0
+        assert "missing required signatures" in res.log
+        # FilterTxs drops it from proposals
+        block = app.prepare_proposal([theft.marshal()])
+        assert theft.marshal() not in block.txs
+        # and even a proposer forcing it into a block can't execute it
+        alice_before = app.bank.get_balance(ALICE.bech32_address())
+        assert app.process_proposal(block)
+        app.begin_block(app.block_time + 15.0)
+        r = app.deliver_tx(theft.marshal())
+        assert r.code != 0
+        app.end_block()
+        app.commit()
+        assert app.bank.get_balance(ALICE.bech32_address()) == alice_before
+
+    def test_undelegate_requires_own_delegation(self):
+        """Bob cannot withdraw Alice's bonded stake (per-delegator
+        delegation records, SDK staking semantics)."""
+        from celestia_tpu.x.staking import MsgDelegate, MsgUndelegate
+
+        app = fresh_app()
+        val = "celestiavaloper1test"
+        a = app.accounts.get_account(ALICE.bech32_address())
+        bond = sign_tx(
+            ALICE, [MsgDelegate(ALICE.bech32_address(), val, 2_000_000)],
+            app.chain_id, a.account_number, a.sequence,
+            Fee(amount=100_000, gas_limit=200_000),
+        )
+        run_block(app, [bond.marshal()])
+        from celestia_tpu.x.bank import BankKeeper
+        from celestia_tpu.x.staking import StakingKeeper
+
+        staking = StakingKeeper(app.store, BankKeeper(app.store))
+        assert staking.get_delegation(ALICE.bech32_address(), val) == 2_000_000
+
+        b = app.accounts.get_account(BOB.bech32_address())
+        steal = sign_tx(
+            BOB, [MsgUndelegate(BOB.bech32_address(), val, 2_000_000)],
+            app.chain_id, b.account_number, b.sequence,
+            Fee(amount=100_000, gas_limit=200_000),
+        )
+        bob_before = app.bank.get_balance(BOB.bech32_address())
+        block = app.prepare_proposal([steal.marshal()])
+        assert app.process_proposal(block)
+        app.begin_block(app.block_time + 15.0)
+        results = [app.deliver_tx(t) for t in block.txs]
+        app.end_block()
+        app.commit()
+        assert any(
+            r.code != 0 and "insufficient delegation" in r.log for r in results
+        )
+        # Bob paid the fee and got nothing back from the bonded pool
+        assert app.bank.get_balance(BOB.bech32_address()) < bob_before
+
+        # Alice CAN undelegate her own stake
+        a = app.accounts.get_account(ALICE.bech32_address())
+        unbond = sign_tx(
+            ALICE, [MsgUndelegate(ALICE.bech32_address(), val, 2_000_000)],
+            app.chain_id, a.account_number, a.sequence,
+            Fee(amount=100_000, gas_limit=200_000),
+        )
+        run_block(app, [unbond.marshal()])
+        staking = StakingKeeper(app.store, BankKeeper(app.store))
+        assert staking.get_delegation(ALICE.bech32_address(), val) == 0
+
     def test_signature_covers_raw_body_bytes(self):
         """Appending an unknown field to the body must invalidate the sig."""
         from celestia_tpu.tx import Tx, _field_bytes
@@ -324,6 +402,22 @@ class TestBeginBlockIsolation:
         assert r.gas_used > 0
         app.end_block()
         app.commit()
+
+    def test_ante_failure_reports_real_gas(self):
+        """A tx that runs out of gas mid-ante must report the gas actually
+        consumed, not 0 (baseapp reports consumed gas for failed txs)."""
+        app = fresh_app()
+        acc = app.accounts.get_account(BOB.bech32_address())
+        tx = sign_tx(
+            BOB, [MsgSend(BOB.bech32_address(), ALICE.bech32_address(), 1)],
+            app.chain_id, acc.account_number, acc.sequence,
+            Fee(amount=10, gas_limit=10),  # far below the tx-size gas cost
+        )
+        app.begin_block(app.block_time + 15)
+        r = app.deliver_tx(tx.marshal())
+        assert r.code != 0
+        assert "out of gas" in r.log
+        assert r.gas_used > 0
 
 
 class TestStateStore:
