@@ -1,20 +1,22 @@
 #!/usr/bin/env python
-"""Headline benchmark: ExtendBlock at the mainnet-max square (BASELINE
-config 3) — 128x128 original square (8 MB) -> 256x256 EDS + NMT row/col
-roots + DAH hash.
+"""BASELINE benchmark suite: the five configs of BASELINE.md over the TPU
+pipeline (celestia_tpu.ops.extend_tpu) vs the host CPU path (the native
+C++ runtime when built — this repo's stand-in for the reference's
+rsmt2d/Leopard SIMD path — else numpy/hashlib).
 
-Compares the fused TPU pipeline (celestia_tpu.ops.extend_tpu) against the
-host CPU path (celestia_tpu.da: numpy Leopard encode + hashlib NMTs), this
-repo's measured stand-in for the reference's rsmt2d/Leopard CPU path (the
-reference publishes no numbers — BASELINE.md). Byte-parity of the DAH is
-asserted before timing counts.
+Headline (BASELINE config 3): ExtendBlock at the mainnet-max 128x128
+square (8 MB) -> 256x256 EDS + NMT row/col roots, DAH byte-parity
+asserted against the CPU path before timing counts.
 
-The dev environment reaches the TPU through a network tunnel whose
-per-call round-trip (~100 ms) and 8 MB upload (~450 ms) dwarf on-chip
-compute, so the headline `value` is the *throughput* per-square time from
-a batched run (tunnel overhead amortized across the batch — the deployment
-shape for proposal bursts / replay); single-call latency and e2e including
-the host->device copy are reported alongside.
+Measurement note: the dev environment reaches the TPU through a tunnel
+whose completion signalling is unreliable for single dispatches
+(block_until_ready can return early or charge a ~60-100 ms sync tax that
+is not device time). Device times here therefore use a SLOPE fit: run N1
+and N2 back-to-back dispatches, fetch results to force completion, and
+report (t2-t1)/(N2-N1) — the true serialized per-call device time with
+the constant tunnel overhead cancelled. The raw single-dispatch number
+(with result fetch, tunnel round-trip included) is reported alongside as
+`tpu_single_dispatch_with_fetch_ms`, with the measured fetch floor.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 vs_baseline = CPU_ms / value (speedup; target >= 10).
@@ -27,8 +29,8 @@ import time
 import numpy as np
 
 
-def build_square(k: int) -> np.ndarray:
-    rng = np.random.default_rng(42)
+def build_square(k: int, seed: int = 42) -> np.ndarray:
+    rng = np.random.default_rng(seed)
     import celestia_tpu.namespace as ns
 
     flat = rng.integers(0, 256, size=(k * k, 512), dtype=np.uint8)
@@ -38,10 +40,8 @@ def build_square(k: int) -> np.ndarray:
     return flat.reshape(k, k, 512)
 
 
-def time_host(sq: np.ndarray, repeats: int):
-    """CPU baseline: the native C++ runtime when the toolchain is present
-    (the closest stand-in for the reference's SIMD Leopard+NMT path),
-    otherwise the numpy/hashlib reference implementation."""
+def time_host_extend(sq: np.ndarray, repeats: int):
+    """CPU baseline for extend+roots; native C++ when available."""
     from celestia_tpu import da, native
 
     use_native = native.available()
@@ -58,61 +58,211 @@ def time_host(sq: np.ndarray, repeats: int):
     return best * 1e3, dah, ("native-cc" if use_native else "host-numpy")
 
 
-def time_tpu(sq: np.ndarray, repeats: int, batch: int):
+def _slope(dispatch, fetch, n1=8, n2=48, tries=3):
+    """True serialized per-call device time via two-point fit.
+
+    `dispatch(i)` is called with a rotating index so callers can cycle
+    distinct input buffers — back-to-back identical dispatches measure
+    faster than real traffic (result caching / HBM locality)."""
+    fetch(dispatch(0))  # warm
+    slopes = []
+    for _ in range(tries):
+        t0 = time.perf_counter()
+        r = None
+        for i in range(n1):
+            r = dispatch(i)
+        fetch(r)
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i in range(n2):
+            r = dispatch(i)
+        fetch(r)
+        t2 = time.perf_counter() - t0
+        slopes.append((t2 - t1) / (n2 - n1))
+    # median, not min: one jitter-induced negative slope must not win and
+    # then get clamped into a fabricated speedup
+    slopes.sort()
+    return slopes[len(slopes) // 2] * 1e3
+
+
+def _single_with_fetch(dispatch, fetch, repeats=5):
+    fetch(dispatch())
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fetch(dispatch())
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e3
+
+
+def bench_extend_config(k: int):
+    """Configs 1-3: full extend+roots at square size k."""
     import jax
     import jax.numpy as jnp
 
+    from celestia_tpu import da
     from celestia_tpu.ops import extend_tpu, rs_tpu
 
-    k = sq.shape[0]
+    sq = build_square(k)
+    cpu_ms, dah_cpu, cpu_backend = time_host_extend(sq, repeats=3)
+
     m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
-    fn = jax.jit(lambda s: extend_tpu.extend_and_root(s, m2))
-    fn_b = jax.jit(lambda s: extend_tpu.extend_and_root_batched(s, m2))
+    fn = jax.jit(lambda s: extend_tpu.extend_and_roots_only(s, m2))
+    devs = [jax.device_put(build_square(k, seed=42 + i)) for i in range(4)]
+    dev = devs[0]
 
-    dev = jax.device_put(sq)
-    out = fn(dev)
-    jax.block_until_ready(out)  # compile + warm
-    dah = np.asarray(out[3]).tobytes()
+    def fetch_roots(r):
+        return np.asarray(r[1]), np.asarray(r[2])
 
-    dev_b = jax.device_put(np.broadcast_to(sq, (batch, *sq.shape)).copy())
-    jax.block_until_ready(fn_b(dev_b))  # compile batched
+    rows, cols = fetch_roots(fn(dev))
+    dah_tpu = da.DataAvailabilityHeader(
+        [r.tobytes() for r in rows], [c.tobytes() for c in cols]
+    ).hash()
+    parity = dah_tpu == dah_cpu
 
-    def best_of(f):
-        best = float("inf")
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            jax.block_until_ready(f())
-            best = min(best, time.perf_counter() - t0)
-        return best * 1e3
+    # scale repeat counts so small squares aren't drowned by tunnel noise
+    if k <= 4:
+        n1, n2 = (64, 768)
+    elif k <= 32:
+        n1, n2 = (32, 192)
+    else:
+        n1, n2 = (8, 48)
+    tpu_ms = _slope(lambda i: fn(devs[i % 4]), fetch_roots, n1=n1, n2=n2)
+    noise_limited = tpu_ms <= 0  # device time below tunnel measurement noise
+    single_ms = _single_with_fetch(lambda: fn(dev), fetch_roots)
+    return {
+        "cpu_ms": round(cpu_ms, 3),
+        "cpu_backend": cpu_backend,
+        "tpu_ms": None if noise_limited else round(tpu_ms, 3),
+        "tpu_single_dispatch_with_fetch_ms": round(single_ms, 3),
+        "speedup": None if noise_limited else round(cpu_ms / tpu_ms, 2),
+        "parity": bool(parity),
+        "dah": dah_tpu.hex(),
+    }
 
-    latency_ms = best_of(lambda: fn(dev))
-    batched_ms = best_of(lambda: fn_b(dev_b))
-    throughput_ms = batched_ms / batch
-    e2e_ms = best_of(lambda: fn(jax.device_put(sq)))
-    return throughput_ms, latency_ms, e2e_ms, dah
+
+def bench_nmt_only(k: int):
+    """Config 5: NMT row/col roots over an existing 2k x 2k EDS."""
+    import jax
+    import jax.numpy as jnp
+
+    from celestia_tpu import da, native
+    from celestia_tpu.appconsts import NAMESPACE_SIZE
+    from celestia_tpu.ops import extend_tpu, rs_tpu
+
+    sq = build_square(k)
+    eds_np = da.extend_shares(sq).data
+
+    use_native = native.available()
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        if use_native:
+            native.eds_nmt_roots(eds_np)
+        else:
+            e = da.ExtendedDataSquare(eds_np, k)
+            e.row_roots(), e.col_roots()
+        best = min(best, time.perf_counter() - t0)
+    cpu_ms = best * 1e3
+
+    leaf_ns = extend_tpu._leaf_namespaces(
+        jnp.asarray(sq)[..., :NAMESPACE_SIZE], k
+    )
+
+    @jax.jit
+    def roots(eds):
+        return extend_tpu.nmt_roots_of_eds(eds, leaf_ns)
+
+    dev = jax.device_put(eds_np)
+
+    def fetch(r):
+        return np.asarray(r[0]), np.asarray(r[1])
+
+    tpu_ms = _slope(lambda i: roots(dev), fetch)
+    return {
+        "cpu_ms": round(cpu_ms, 3),
+        "cpu_backend": "native-cc" if use_native else "host-numpy",
+        "tpu_ms": round(tpu_ms, 3),
+        "speedup": round(cpu_ms / tpu_ms, 2),
+    }
+
+
+def bench_repair(k: int, erase_frac: float = 0.25):
+    """Config 4: Repair of a 2k x 2k EDS with 25% random erasures.
+
+    Repair is host-orchestrated by design (data-dependent elimination
+    order — SURVEY §7 hard part 4); this is an honest host-path number,
+    not a TPU kernel."""
+    from celestia_tpu import da
+    from celestia_tpu.da import repair as repair_mod
+
+    sq = build_square(k)
+    eds = da.extend_shares(sq).data
+    rng = np.random.default_rng(7)
+    width = 2 * k
+    present = np.ones((width, width), dtype=bool)
+    n_erase = int(erase_frac * width * width)
+    flat = rng.choice(width * width, size=n_erase, replace=False)
+    present[np.unravel_index(flat, (width, width))] = False
+
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        fixed = repair_mod.repair(eds, present)
+        best = min(best, time.perf_counter() - t0)
+    ok = np.array_equal(fixed, eds)
+    return {"host_ms": round(best * 1e3, 3), "recovered": bool(ok)}
+
+
+def fetch_floor_ms():
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.device_put(np.ones((8, 128), np.uint8))
+    f = jax.jit(lambda a: a.astype(jnp.int32).sum())
+    np.asarray(f(x))
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        best = min(best, time.perf_counter() - t0)
+    return round(best * 1e3, 3)
 
 
 def main():
-    k = int(sys.argv[1]) if len(sys.argv) > 1 else 128
-    batch = 8
-    sq = build_square(k)
-    cpu_ms, dah_cpu, cpu_backend = time_host(sq, repeats=3)
-    tpu_ms, latency_ms, e2e_ms, dah_tpu = time_tpu(sq, repeats=5, batch=batch)
-    assert dah_cpu == dah_tpu, "DAH mismatch between CPU and TPU paths"
+    headline_k = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+
+    configs = {}
+    configs["1_smoke_k2"] = bench_extend_config(2)
+    configs["2_k32"] = bench_extend_config(32)
+    head = bench_extend_config(headline_k)
+    configs[f"3_headline_k{headline_k}"] = head
+    configs["4_repair_k128_25pct"] = bench_repair(128)
+    configs["5_nmt_only_k128"] = bench_nmt_only(128)
+
+    for name, cfg in configs.items():
+        if "parity" in cfg:
+            assert cfg["parity"], f"DAH mismatch between CPU and TPU paths ({name})"
     print(
         json.dumps(
             {
-                "metric": f"extend_block_k{k}_tpu_ms_per_square",
-                "value": round(tpu_ms, 3),
+                "metric": f"extend_block_k{headline_k}_tpu_ms_per_square",
+                "value": head["tpu_ms"],
                 "unit": "ms",
-                "vs_baseline": round(cpu_ms / tpu_ms, 2),
-                "cpu_baseline_ms": round(cpu_ms, 3),
-                "cpu_backend": cpu_backend,
-                "tpu_single_call_ms": round(latency_ms, 3),
-                "tpu_e2e_with_transfer_ms": round(e2e_ms, 3),
-                "batch": batch,
-                "dah": dah_tpu.hex(),
-                "parity": True,
+                "vs_baseline": head["speedup"],
+                "cpu_baseline_ms": head["cpu_ms"],
+                "cpu_backend": head["cpu_backend"],
+                # slope-fit serialized per-call device time (unbatched); the
+                # tunnel-inclusive raw latency is the _with_fetch_ number
+                "tpu_single_call_ms": head["tpu_ms"],
+                "tpu_single_call_note": "slope-fit per-call device time, unbatched; tunnel RTT excluded (see tpu_single_dispatch_with_fetch_ms and tunnel_fetch_floor_ms)",
+                "tpu_single_dispatch_with_fetch_ms": head[
+                    "tpu_single_dispatch_with_fetch_ms"
+                ],
+                "tunnel_fetch_floor_ms": fetch_floor_ms(),
+                "dah": head["dah"],
+                "parity": head["parity"],
+                "configs": configs,
             }
         )
     )

@@ -110,19 +110,48 @@ def _leaf_namespaces(q0_ns: jnp.ndarray, k: int) -> jnp.ndarray:
     return jnp.concatenate([top, bottom], axis=0)
 
 
+def nmt_roots_of_eds(eds: jnp.ndarray, leaf_ns: jnp.ndarray):
+    """(2k,2k,512) EDS + per-cell leaf namespaces -> (row_roots, col_roots).
+
+    Row and column trees are reduced in ONE level-synchronous pass (stacked
+    on a leading axis): the serial depth of the hot path is log2(2k) tree
+    levels total instead of 2x that, and every level runs with twice the
+    lanes — the latency-bound top levels are where that matters.
+    """
+    leaf_nodes = nmt_leaf_nodes(leaf_ns, eds)  # (2k, 2k, 90)
+    stacked = jnp.stack([leaf_nodes, jnp.swapaxes(leaf_nodes, 0, 1)], axis=0)
+    roots = nmt_reduce_axis(stacked)  # (2, 2k, 90)
+    return roots[0], roots[1]
+
+
+def _roots_of(shares: jnp.ndarray, m2: jnp.ndarray):
+    """Shared core: (k,k,512) -> (eds, row_roots, col_roots)."""
+    k = shares.shape[0]
+    eds = rs_tpu.extend_square(shares, m2)
+    leaf_ns = _leaf_namespaces(shares[..., :NAMESPACE_SIZE], k)
+    row_roots, col_roots = nmt_roots_of_eds(eds, leaf_ns)
+    return eds, row_roots, col_roots
+
+
 def extend_and_root(
     shares: jnp.ndarray, m2: jnp.ndarray
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """(k, k, 512) uint8 -> (eds (2k,2k,512), row_roots (2k,90),
     col_roots (2k,90), dah_hash (32,)). m2 = rs_tpu.encode_bit_matrix(k)."""
-    k = shares.shape[0]
-    eds = rs_tpu.extend_square(shares, m2)
-    leaf_ns = _leaf_namespaces(shares[..., :NAMESPACE_SIZE], k)
-    leaf_nodes = nmt_leaf_nodes(leaf_ns, eds)  # (2k, 2k, 90)
-    row_roots = nmt_reduce_axis(leaf_nodes)  # reduce axis 1 -> (2k, 90)
-    col_roots = nmt_reduce_axis(jnp.swapaxes(leaf_nodes, 0, 1))
+    eds, row_roots, col_roots = _roots_of(shares, m2)
     dah = merkle_root_pow2(jnp.concatenate([row_roots, col_roots], axis=0))
     return eds, row_roots, col_roots, dah
+
+
+def extend_and_roots_only(shares: jnp.ndarray, m2: jnp.ndarray):
+    """Deployment variant: (k,k,512) -> (eds, row_roots, col_roots).
+
+    The DAH hash over the 4k axis roots is a tiny (~1k-node) merkle tree —
+    latency-bound on device but ~sub-ms on host, and the node needs the
+    roots host-side anyway to build the DataAvailabilityHeader. So the
+    device program stops at the axis roots and the host finishes the DAH
+    (byte-identical; see app/_extend_and_hash)."""
+    return _roots_of(shares, m2)
 
 
 @functools.lru_cache(maxsize=8)
@@ -134,6 +163,25 @@ def _jitted_for_k(k: int):
         return extend_and_root(shares, m2)
 
     return run
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_roots_for_k(k: int):
+    m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
+
+    @jax.jit
+    def run(shares):
+        return extend_and_roots_only(shares, m2)
+
+    return run
+
+
+def extend_roots_device(shares: np.ndarray):
+    """Host deployment entry: (k,k,512) uint8 -> numpy (eds, row_roots,
+    col_roots); the caller computes the DAH hash host-side (da module)."""
+    k = shares.shape[0]
+    eds, rows, cols = _jitted_roots_for_k(k)(jnp.asarray(shares))
+    return np.asarray(eds), np.asarray(rows), np.asarray(cols)
 
 
 def extend_and_root_batched(shares: jnp.ndarray, m2: jnp.ndarray):

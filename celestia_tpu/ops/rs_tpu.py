@@ -88,6 +88,13 @@ def extend_square(q0: jnp.ndarray, m2: jnp.ndarray) -> jnp.ndarray:
 
     Quadrant layout per rsmt2d (see celestia_tpu.da): Q1 = row-extend Q0,
     Q2 = column-extend Q0, Q3 = row-extend Q2.
+
+    This XLA spelling measured FASTER than the hand-written Pallas kernel
+    on v5e (0.39 ms vs 1.41 ms per k=128 extend — XLA's fusion of the
+    unpack/dot/mask/pack chain beats the hand tiling), so it is the
+    default everywhere; ops.rs_pallas remains as an explicitly-invoked
+    alternative and is kept bit-exact by tests. It also keeps this
+    function GSPMD-partitionable for the sharded multichip paths.
     """
     # q0 is (rows, cols, B): the column index IS the shard axis for row
     # extension, so the layout already matches rs_encode_rows.

@@ -84,3 +84,37 @@ class TestExtendAndRoot:
         dah_h = da.new_data_availability_header(eds_h).hash()
         _, _, _, dah_t = extend_tpu.extend_and_root_device(sq)
         assert dah_t.tobytes() == dah_h
+
+
+class TestPallasKernel:
+    """The all-VMEM Pallas encode (ops.rs_pallas) must be bit-exact vs the
+    XLA spelling; interpret mode exercises it on the CPU test platform."""
+
+    @pytest.mark.parametrize("k", [32, 64])
+    def test_pallas_extend_matches_xla(self, k):
+        import jax.numpy as jnp
+
+        from celestia_tpu.ops import rs_pallas, rs_tpu
+
+        rng = np.random.default_rng(200 + k)
+        q0 = rand_square(rng, k)
+        m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
+        # reference: the pure-XLA quadrant chain (extend_square is XLA-only)
+        ref = np.asarray(rs_tpu.extend_square(jnp.asarray(q0), m2))
+        pal = np.asarray(rs_pallas.extend_square(jnp.asarray(q0), m2, interpret=True))
+        assert np.array_equal(ref, pal)
+
+    def test_roots_only_matches_full(self):
+        import jax.numpy as jnp
+
+        from celestia_tpu.ops import rs_tpu
+
+        k = 4
+        rng = np.random.default_rng(77)
+        sq = rand_square(rng, k)
+        m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
+        eds_f, rows_f, cols_f, _dah = extend_tpu.extend_and_root(jnp.asarray(sq), m2)
+        eds_r, rows_r, cols_r = extend_tpu.extend_and_roots_only(jnp.asarray(sq), m2)
+        assert np.array_equal(np.asarray(eds_f), np.asarray(eds_r))
+        assert np.array_equal(np.asarray(rows_f), np.asarray(rows_r))
+        assert np.array_equal(np.asarray(cols_f), np.asarray(cols_r))
