@@ -27,7 +27,14 @@ from celestia_tpu.x.bank import BankKeeper, MsgSend
 from celestia_tpu.x.blob import BlobKeeper, MsgPayForBlobs, validate_blob_tx
 from celestia_tpu.x.blob.types import pfb_blob_sizes
 from celestia_tpu.x.blobstream import BlobstreamKeeper, MsgRegisterEVMAddress
+from celestia_tpu.x.distribution import (
+    DistributionKeeper,
+    MsgWithdrawValidatorRewards,
+)
+from celestia_tpu.x.gov import GovKeeper, MsgDeposit, MsgSubmitProposal, MsgVote
 from celestia_tpu.x.mint import MintKeeper
+from celestia_tpu.x.paramfilter import apply_param_changes
+from celestia_tpu.x.slashing import MsgUnjail, SlashingKeeper
 from celestia_tpu.x.staking import MsgDelegate, MsgUndelegate, StakingKeeper
 from celestia_tpu.x.upgrade import MsgVersionChange, UpgradeKeeper
 
@@ -70,6 +77,9 @@ class App:
         self.staking = StakingKeeper(self.store, self.bank)
         self.blobstream = BlobstreamKeeper(self.store, self.staking)
         self.staking.hooks.append(self.blobstream)  # ref: app/app.go:349-354
+        self.gov = GovKeeper(self.store, self.bank, self.staking)
+        self.distribution = DistributionKeeper(self.store, self.bank, self.staking)
+        self.slashing = SlashingKeeper(self.store, self.staking)
         self.upgrade = UpgradeKeeper(upgrade_schedule or {})
         self.height = 0
         self.block_time = 0.0
@@ -316,15 +326,39 @@ class App:
     # ------------------------------------------------------------------ #
     # Block execution: BeginBlock -> DeliverTx* -> EndBlock -> Commit
 
-    def begin_block(self, block_time: float | None = None) -> None:
+    def begin_block(
+        self,
+        block_time: float | None = None,
+        last_commit_signers: list[str] | None = None,
+        evidence: list | None = None,
+    ) -> None:
+        """ref: module BeginBlocker order app/app.go:452-473 — mint,
+        distribution, slashing (last-commit liveness), evidence.
+
+        last_commit_signers: operator addresses that signed the previous
+        block (ABCI LastCommitInfo analogue; None = skip liveness).
+        evidence: list of slashing.Equivocation (ABCI ByzantineValidators).
+        """
         self.block_time = block_time if block_time is not None else self.block_time + 15.0
         self._deliver_store = self.store.branch()
         self._deliver_ctx = self._new_ctx(self._deliver_store, ExecMode.DELIVER)
         # BeginBlock state effects go through the deliver branch — they must
         # only reach committed state at Commit (crash-replay determinism).
-        MintKeeper(
-            self._deliver_store, BankKeeper(self._deliver_store)
-        ).begin_blocker(self._deliver_ctx)
+        store = self._deliver_store
+        bank = BankKeeper(store)
+        MintKeeper(store, bank).begin_blocker(self._deliver_ctx)
+        staking = StakingKeeper(store, bank)
+        staking.hooks.append(BlobstreamKeeper(store, staking))
+        DistributionKeeper(store, bank, staking).begin_blocker(self._deliver_ctx)
+        slashing = SlashingKeeper(store, staking)
+        if last_commit_signers is not None:
+            signers = set(last_commit_signers)
+            for v in staking.bonded_validators():
+                slashing.handle_validator_signature(
+                    self._deliver_ctx, v.operator, v.operator in signers
+                )
+        for ev in evidence or []:
+            slashing.handle_double_sign(self._deliver_ctx, ev)
 
     def deliver_tx(self, raw_tx: bytes) -> TxResult:
         """ref: app/deliver_tx.go:10-23"""
@@ -399,19 +433,67 @@ class App:
             BlobstreamKeeper(ctx.store, staking).register_evm_address(
                 msg.validator_address, msg.evm_address
             )
+        elif isinstance(msg, MsgSubmitProposal):
+            self._gov_keeper(ctx).submit_proposal(
+                ctx, msg.proposer, msg.changes, msg.initial_deposit
+            )
+        elif isinstance(msg, MsgDeposit):
+            self._gov_keeper(ctx).deposit(
+                ctx, msg.proposal_id, msg.depositor, msg.amount
+            )
+        elif isinstance(msg, MsgVote):
+            self._gov_keeper(ctx).vote(ctx, msg.proposal_id, msg.voter, msg.option)
+        elif isinstance(msg, MsgWithdrawValidatorRewards):
+            bank = BankKeeper(ctx.store)
+            DistributionKeeper(
+                ctx.store, bank, StakingKeeper(ctx.store, bank)
+            ).withdraw_rewards(ctx, msg.validator_address)
+        elif isinstance(msg, MsgUnjail):
+            bank = BankKeeper(ctx.store)
+            staking = StakingKeeper(ctx.store, bank)
+            staking.hooks.append(BlobstreamKeeper(ctx.store, staking))
+            SlashingKeeper(ctx.store, staking).unjail(ctx, msg.validator_address)
         else:
             raise ValueError(f"unroutable message type {type(msg).__name__}")
 
+    def _gov_keeper(self, ctx) -> GovKeeper:
+        bank = BankKeeper(ctx.store)
+        return GovKeeper(ctx.store, bank, StakingKeeper(ctx.store, bank))
+
     def end_block(self) -> dict:
-        """ref: app/app.go:575-587 (EndBlocker upgrade bump) + blobstream
-        EndBlocker (x/blobstream/abci.go:28)"""
-        if self._deliver_store is not None and self._deliver_ctx is not None:
-            staking = StakingKeeper(self._deliver_store, BankKeeper(self._deliver_store))
-            BlobstreamKeeper(self._deliver_store, staking).end_blocker(self._deliver_ctx)
+        """ref: EndBlocker order app/app.go:475-496 — gov tally first, then
+        staking/blobstream valset effects, then the upgrade bump
+        (app/app.go:575-587)."""
         result = {}
+        if self._deliver_store is not None and self._deliver_ctx is not None:
+            store, ctx = self._deliver_store, self._deliver_ctx
+            bank = BankKeeper(store)
+            staking = StakingKeeper(store, bank)
+            gov = GovKeeper(store, bank, staking)
+            finished = gov.end_blocker(
+                ctx, lambda changes: apply_param_changes(self._gov_target(store), changes)
+            )
+            if finished:
+                result["gov_finished"] = [
+                    {"id": p.id, "status": p.status, "log": p.fail_log}
+                    for p in finished
+                ]
+            BlobstreamKeeper(store, staking).end_blocker(ctx)
         if self.upgrade.should_upgrade():
             result["app_version"] = self.upgrade.pending_app_version
         return result
+
+    def _gov_target(self, store):
+        """A keeper view over the deliver branch for gov param application
+        (apply_param_changes expects .blob / .blobstream attributes)."""
+
+        class _Target:
+            blob = BlobKeeper(store)
+            blobstream = BlobstreamKeeper(
+                store, StakingKeeper(store, BankKeeper(store))
+            )
+
+        return _Target()
 
     def commit(self) -> bytes:
         if self._deliver_store is not None:

@@ -116,6 +116,23 @@ def _handler_for(node: Node):
                     self._reply(
                         {"balance": node.app.bank.get_balance(parts[1], parts[2])}
                     )
+                elif len(parts) == 3 and parts[0] == "proof" and parts[1] == "state":
+                    # /proof/state/<hex-key> — SMT inclusion/absence proof
+                    # against the committed app hash (IAVL store-proof
+                    # analogue; ref: baseapp "store" query with prove=true)
+                    key = bytes.fromhex(parts[2])
+                    store = node.app.store
+                    value = store.get(key)
+                    root, proof = store.prove_with_root(key)
+                    self._reply(
+                        {
+                            "key": key.hex(),
+                            "value": value.hex() if value is not None else None,
+                            "app_hash": root.hex(),
+                            "height": node.app.height,
+                            "proof": proof.marshal(),
+                        }
+                    )
                 elif len(parts) == 3 and parts[0] == "proof" and parts[1] == "tx":
                     # /proof/tx/<height>:<tx_index> — tx inclusion proof
                     # (ref: pkg/proof/querier.go txInclusionProof route)

@@ -57,8 +57,8 @@ def _encode_kernel(x_ref, m2_ref, o_ref):
         preferred_element_type=jnp.int32,
     )  # (8k, TN)
     pbits = (acc & 1).reshape(k, 8, x.shape[-1])
-    weights = jax.lax.broadcasted_iota(jnp.int32, (k, 8, x.shape[-1]), 1)
-    packed = (pbits << weights).sum(axis=1)
+    # same bit weights as the unpack: shift bit b back to position b
+    packed = (pbits << shifts).sum(axis=1)
     o_ref[...] = packed.astype(jnp.uint8)
 
 

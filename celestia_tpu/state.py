@@ -4,8 +4,10 @@ The reference commits an IAVL multistore per block (SURVEY §5
 checkpoint/resume: baseapp + store keys, app/app.go:268-279). This module
 provides the same capabilities in a self-contained form:
 
-- `StateStore`: committed map + per-block app hash over sorted (key, value)
-  pairs (deterministic, consensus-usable).
+- `StateStore`: committed map, merkleized by an incremental sparse Merkle
+  tree (celestia_tpu.smt): app hash = SMT root, commit cost O(dirty keys ·
+  log) independent of total state size, and per-key inclusion/absence
+  proofs for queries.
 - `CacheStore.branch()`: writable overlay used for proposal handling /
   CheckTx so speculative execution never touches committed state; `write()`
   flushes to the parent (DeliverTx -> Commit flow).
@@ -14,8 +16,10 @@ provides the same capabilities in a self-contained form:
 
 from __future__ import annotations
 
-import hashlib
 import json
+import threading
+
+from celestia_tpu import smt as smt_mod
 
 
 class CacheStore:
@@ -65,12 +69,17 @@ class CacheStore:
 
 
 class StateStore:
-    """Committed state with per-height app hashes."""
+    """Committed state with per-height app hashes (SMT root)."""
 
     def __init__(self):
         self._data: dict[bytes, bytes] = {}
         self.version = 0
         self.app_hashes: dict[int, bytes] = {}
+        self._smt = smt_mod.SparseMerkleTree()
+        self._dirty: set[bytes] = set()
+        # Guards SMT mutation: the node RPC serves proofs from handler
+        # threads (ThreadingHTTPServer) while the node thread commits.
+        self._smt_lock = threading.Lock()
 
     def get(self, key: bytes) -> bytes | None:
         return self._data.get(key)
@@ -79,9 +88,11 @@ class StateStore:
         if not isinstance(key, bytes) or not isinstance(value, bytes):
             raise TypeError("store keys/values must be bytes")
         self._data[key] = value
+        self._dirty.add(key)
 
     def delete(self, key: bytes) -> None:
         self._data.pop(key, None)
+        self._dirty.add(key)
 
     def branch(self) -> CacheStore:
         return CacheStore(self)
@@ -114,12 +125,39 @@ class StateStore:
         store._data = {
             bytes.fromhex(k): bytes.fromhex(v) for k, v in payload["data"].items()
         }
+        store._dirty = set(store._data)  # rebuild the SMT from scratch
         store.commit_hash_refresh()
         return store
 
+    def _fold_dirty(self) -> None:
+        for key in self._dirty:
+            value = self._data.get(key)
+            self._smt.update(smt_mod.key_hash(key), value)
+        self._dirty.clear()
+
     def commit_hash_refresh(self) -> None:
-        h = hashlib.sha256()
-        for k in sorted(self._data):
-            h.update(hashlib.sha256(k).digest())
-            h.update(hashlib.sha256(self._data[k]).digest())
-        self.app_hashes[self.version] = h.digest()
+        """Fold dirty keys into the SMT; app hash = the new root.
+
+        Incremental: cost is O(|dirty| · log), independent of |state|."""
+        with self._smt_lock:
+            self._fold_dirty()
+            self.app_hashes[self.version] = self._smt.root
+
+    # --- state proofs (IAVL store-proof analogue) ---
+
+    def prove(self, key: bytes) -> smt_mod.Proof:
+        """Inclusion/absence proof for key against the committed app hash."""
+        return self.prove_with_root(key)[1]
+
+    def prove_with_root(self, key: bytes) -> tuple[bytes, smt_mod.Proof]:
+        """Atomically return (root, proof) so the advertised root always
+        matches the proof even if a commit races on another thread."""
+        with self._smt_lock:
+            self._fold_dirty()
+            return self._smt.root, self._smt.prove(smt_mod.key_hash(key))
+
+    @staticmethod
+    def verify_proof(
+        app_hash: bytes, key: bytes, value: bytes | None, proof: smt_mod.Proof
+    ) -> bool:
+        return smt_mod.verify_proof(app_hash, key, value, proof)

@@ -56,6 +56,20 @@ class BankKeeper:
         supply = int.from_bytes(raw, "big") if raw else 0
         self.store.set(supply_key, (supply + amount).to_bytes(16, "big"))
 
+    def burn(self, from_addr: str, amount: int, denom: str = BOND_DENOM) -> None:
+        """Destroy coins held by a (module) account, shrinking supply
+        (ref: bank Keeper.BurnCoins — slashing burns from the bonded pool)."""
+        bal = self.get_balance(from_addr, denom)
+        if bal < amount:
+            raise ValueError(f"burn exceeds balance of {from_addr}")
+        self.set_balance(from_addr, bal - amount, denom)
+        supply_key = SUPPLY_KEY + denom.encode()
+        raw = self.store.get(supply_key)
+        supply = int.from_bytes(raw, "big") if raw else 0
+        if supply < amount:
+            raise ValueError("burn exceeds total supply")
+        self.store.set(supply_key, (supply - amount).to_bytes(16, "big"))
+
     def total_supply(self, denom: str = BOND_DENOM) -> int:
         raw = self.store.get(SUPPLY_KEY + denom.encode())
         return int.from_bytes(raw, "big") if raw else 0

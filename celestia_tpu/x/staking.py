@@ -31,10 +31,11 @@ class Validator:
     operator: str  # bech32 account address of the operator
     tokens: int  # bonded utia
     moniker: str = ""
+    jailed: bool = False
 
     @property
     def power(self) -> int:
-        return self.tokens // POWER_REDUCTION
+        return 0 if self.jailed else self.tokens // POWER_REDUCTION
 
     def marshal(self) -> bytes:
         return json.dumps(dataclasses.asdict(self), sort_keys=True).encode()
@@ -116,6 +117,84 @@ class StakingKeeper:
     def last_unbonding_height(self) -> int:
         raw = self.store.get(LAST_UNBONDING_HEIGHT_KEY)
         return int.from_bytes(raw, "big") if raw else 0
+
+    def delegations_of(self, delegator: str) -> dict[str, int]:
+        """All (validator -> tokens) records of one delegator (gov voting
+        power is the voter's own bonded stake)."""
+        prefix = DELEGATION_PREFIX + delegator.encode() + b"/"
+        return {
+            k[len(prefix):].decode(): int.from_bytes(raw, "big")
+            for k, raw in self.store.iter_prefix(prefix)
+        }
+
+    def delegations_to(self, validator_operator: str) -> dict[str, int]:
+        """All (delegator -> tokens) records bonded to one validator."""
+        suffix = b"/" + validator_operator.encode()
+        out = {}
+        for k, raw in self.store.iter_prefix(DELEGATION_PREFIX):
+            if k.endswith(suffix):
+                delegator = k[len(DELEGATION_PREFIX): -len(suffix)].decode()
+                out[delegator] = int.from_bytes(raw, "big")
+        return out
+
+    def slash(self, ctx, validator_operator: str, fraction_dec: int) -> int:
+        """Burn fraction (Dec-scaled 1e18) of a validator's bonded tokens.
+
+        SDK staking slashes delegations pro-rata via the exchange rate; the
+        explicit records here are scaled down directly. Burned tokens leave
+        the bonded pool and total supply (ref: staking Keeper.Slash).
+        Returns the burned amount."""
+        v = self.get_validator(validator_operator)
+        if v is None or fraction_dec <= 0:
+            return 0
+        one = 10**18
+        burn_total = v.tokens * fraction_dec // one
+        if burn_total <= 0:
+            return 0
+        # Per-delegation floor cuts first, then distribute the rounding
+        # remainder (deterministically, sorted order) so the invariant
+        # sum(delegations) == v.tokens survives the slash — otherwise the
+        # last delegator to undelegate finds their recorded stake
+        # unbacked by the validator total.
+        remaining = burn_total
+        delegations = self.delegations_to(validator_operator)
+        cuts = {}
+        for delegator, tokens in sorted(delegations.items()):
+            cut = min(tokens * fraction_dec // one, remaining)
+            cuts[delegator] = cut
+            remaining -= cut
+        for delegator, tokens in sorted(delegations.items()):
+            if remaining <= 0:
+                break
+            extra = min(tokens - cuts[delegator], remaining)
+            cuts[delegator] += extra
+            remaining -= extra
+        for delegator, tokens in sorted(delegations.items()):
+            self._set_delegation(
+                delegator, validator_operator, tokens - cuts[delegator]
+            )
+        v.tokens -= burn_total
+        self.set_validator(v)
+        self.bank.burn(BONDED_POOL, burn_total)
+        for hook in self.hooks:
+            hook.after_validator_bond_change(ctx)
+        return burn_total
+
+    def jail(self, ctx, validator_operator: str) -> None:
+        v = self.get_validator(validator_operator)
+        if v is not None and not v.jailed:
+            v.jailed = True
+            self.set_validator(v)
+            for hook in self.hooks:
+                hook.after_validator_bond_change(ctx)
+
+    def unjail(self, ctx, validator_operator: str) -> None:
+        v = self.get_validator(validator_operator)
+        if v is not None and v.jailed:
+            v.jailed = False
+            self.set_validator(v)
+            for hook in self.hooks:
+                hook.after_validator_bond_change(ctx)
 
 
 URL_MSG_DELEGATE = "/cosmos.staking.v1beta1.MsgDelegate"

@@ -365,12 +365,15 @@ class TestMint:
         assert calculate_inflation_rate(100) == 15 * 10**15
 
     def test_block_provision_minted(self):
+        """Mint provisions land in the fee collector and are swept into
+        distribution (community pool, no bonded validators here) at the
+        next BeginBlock — measure the sweep destination."""
         app = fresh_app()
-        from celestia_tpu.x.bank import FEE_COLLECTOR
+        from celestia_tpu.x.distribution import DISTRIBUTION_MODULE_ACCOUNT
 
-        before = app.bank.get_balance(FEE_COLLECTOR)
+        before = app.bank.get_balance(DISTRIBUTION_MODULE_ACCOUNT)
         run_block(app, [])
-        after = app.bank.get_balance(FEE_COLLECTOR)
+        after = app.bank.get_balance(DISTRIBUTION_MODULE_ACCOUNT)
         minted = after - before
         # 15s of 8% on ~10B supply ~= 10e9*0.08*15/31556952 ~= 380
         assert 300 < minted < 500, minted

@@ -1,0 +1,321 @@
+"""gov / slashing / evidence / distribution tier tests.
+
+Reference models: SDK gov with paramfilter handler
+(x/paramfilter/gov_handler.go), slashing/evidence defaults
+(app/default_overrides.go:100-104), distribution AllocateTokens.
+"""
+
+import pytest
+
+from celestia_tpu import blob as blob_pkg  # noqa: F401 (parity with test_app)
+from celestia_tpu.app import App
+from celestia_tpu.crypto import PrivateKey
+from celestia_tpu.tx import Fee, sign_tx
+from celestia_tpu.x import gov as gov_mod
+from celestia_tpu.x import slashing as slashing_mod
+from celestia_tpu.x.paramfilter import ParamChange
+from celestia_tpu.x.gov import MsgSubmitProposal, MsgVote
+from celestia_tpu.x.slashing import Equivocation
+from celestia_tpu.x.staking import MsgDelegate
+
+ALICE = PrivateKey.from_secret(b"alice")
+BOB = PrivateKey.from_secret(b"bob")
+VAL = "celestiavaloper1gov"
+
+
+def fresh_app() -> App:
+    app = App()
+    app.init_chain(
+        {ALICE.bech32_address(): 100_000_000_000, BOB.bech32_address(): 50_000_000_000},
+        genesis_time=0.0,
+    )
+    p0 = app.prepare_proposal([])
+    assert app.process_proposal(p0)
+    app.begin_block(15.0)
+    app.end_block()
+    app.commit()
+    return app
+
+
+def run_block(app, txs, block_time=None, signers=None, evidence=None):
+    block = app.prepare_proposal(txs)
+    assert app.process_proposal(block)
+    app.begin_block(
+        block_time if block_time is not None else app.block_time + 15.0,
+        last_commit_signers=signers,
+        evidence=evidence,
+    )
+    results = [app.deliver_tx(t) for t in block.txs]
+    out = app.end_block()
+    app.commit()
+    return results, out
+
+
+def signed(app, key, msgs, gas=300_000):
+    acc = app.accounts.get_account(key.bech32_address())
+    return sign_tx(
+        key, msgs, app.chain_id, acc.account_number, acc.sequence,
+        Fee(amount=gas, gas_limit=gas),
+    ).marshal()
+
+
+def delegate(app, key, amount):
+    rs, _ = run_block(
+        app, [signed(app, key, [MsgDelegate(key.bech32_address(), VAL, amount)])]
+    )
+    assert all(r.code == 0 for r in rs), [r.log for r in rs]
+
+
+class TestGovParamChange:
+    def test_gov_changes_blob_params_end_to_end(self):
+        app = fresh_app()
+        delegate(app, ALICE, 40_000_000_000)
+
+        # submit with full deposit -> voting starts
+        changes = [ParamChange("blob", "GovMaxSquareSize", "32")]
+        rs, _ = run_block(
+            app,
+            [signed(app, ALICE, [MsgSubmitProposal(
+                ALICE.bech32_address(), changes, gov_mod.MIN_DEPOSIT)])],
+        )
+        assert all(r.code == 0 for r in rs), [r.log for r in rs]
+        props = app.gov.proposals()
+        assert len(props) == 1 and props[0].status == gov_mod.STATUS_VOTING
+        pid = props[0].id
+
+        rs, _ = run_block(
+            app,
+            [signed(app, ALICE, [MsgVote(pid, ALICE.bech32_address(), "yes")])],
+        )
+        assert all(r.code == 0 for r in rs), [r.log for r in rs]
+
+        # jump past the voting period; tally runs in EndBlock
+        before = app.bank.get_balance(ALICE.bech32_address())
+        _, out = run_block(
+            app, [], block_time=app.block_time + gov_mod.VOTING_PERIOD + 1
+        )
+        assert out["gov_finished"][0]["status"] == gov_mod.STATUS_PASSED
+        assert app.blob.get_params().gov_max_square_size == 32
+        # deposit refunded
+        assert app.bank.get_balance(ALICE.bech32_address()) == before + gov_mod.MIN_DEPOSIT
+
+    def test_forbidden_param_fails_proposal(self):
+        app = fresh_app()
+        delegate(app, ALICE, 40_000_000_000)
+        changes = [ParamChange("staking", "BondDenom", "fake")]
+        rs, _ = run_block(
+            app,
+            [signed(app, ALICE, [MsgSubmitProposal(
+                ALICE.bech32_address(), changes, gov_mod.MIN_DEPOSIT)])],
+        )
+        assert all(r.code == 0 for r in rs)
+        pid = app.gov.proposals()[0].id
+        rs, _ = run_block(
+            app, [signed(app, ALICE, [MsgVote(pid, ALICE.bech32_address(), "yes")])]
+        )
+        _, out = run_block(
+            app, [], block_time=app.block_time + gov_mod.VOTING_PERIOD + 1
+        )
+        fin = out["gov_finished"][0]
+        assert fin["status"] == gov_mod.STATUS_FAILED
+        assert "hardfork" in fin["log"]
+
+    def test_quorum_not_reached_rejects(self):
+        app = fresh_app()
+        delegate(app, ALICE, 40_000_000_000)
+        delegate(app, BOB, 10_000_000_000)
+        changes = [ParamChange("blob", "GasPerBlobByte", "16")]
+        rs, _ = run_block(
+            app,
+            [signed(app, ALICE, [MsgSubmitProposal(
+                ALICE.bech32_address(), changes, gov_mod.MIN_DEPOSIT)])],
+        )
+        pid = app.gov.proposals()[0].id
+        # only Bob (20% of bonded) votes -> quorum 33.4% missed
+        rs, _ = run_block(
+            app, [signed(app, BOB, [MsgVote(pid, BOB.bech32_address(), "yes")])]
+        )
+        assert all(r.code == 0 for r in rs), [r.log for r in rs]
+        _, out = run_block(
+            app, [], block_time=app.block_time + gov_mod.VOTING_PERIOD + 1
+        )
+        assert out["gov_finished"][0]["status"] == gov_mod.STATUS_REJECTED
+        assert app.blob.get_params().gas_per_blob_byte == 8  # unchanged
+
+    def test_non_staker_cannot_vote(self):
+        app = fresh_app()
+        delegate(app, ALICE, 40_000_000_000)
+        changes = [ParamChange("blob", "GasPerBlobByte", "16")]
+        run_block(
+            app,
+            [signed(app, ALICE, [MsgSubmitProposal(
+                ALICE.bech32_address(), changes, gov_mod.MIN_DEPOSIT)])],
+        )
+        pid = app.gov.proposals()[0].id
+        rs, _ = run_block(
+            app, [signed(app, BOB, [MsgVote(pid, BOB.bech32_address(), "yes")])]
+        )
+        assert any(r.code != 0 and "no bonded stake" in r.log for r in rs)
+
+
+class TestSlashingEvidence:
+    def test_double_sign_slashes_and_updates_blobstream_valset(self):
+        app = fresh_app()
+        delegate(app, ALICE, 10_000_000_000)
+        # second validator so the post-jail valset is non-empty
+        rs, _ = run_block(
+            app,
+            [signed(app, BOB, [MsgDelegate(BOB.bech32_address(), "celestiavaloper1other", 10_000_000_000)])],
+        )
+        assert all(r.code == 0 for r in rs), [r.log for r in rs]
+        val = app.staking.get_validator(VAL)
+        tokens_before = val.tokens
+        from celestia_tpu.x.bank import BONDED_POOL
+
+        pool_before = app.bank.get_balance(BONDED_POOL)
+        nonce_before = app.blobstream.latest_nonce()
+
+        _, _ = run_block(
+            app, [], evidence=[Equivocation(validator=VAL, height=app.height)]
+        )
+        burn = tokens_before * 2 // 100  # 2% slash fraction
+        val = app.staking.get_validator(VAL)
+        assert val.jailed
+        assert val.tokens == tokens_before - burn
+        # slashed tokens are burned out of the bonded pool
+        assert app.bank.get_balance(BONDED_POOL) == pool_before - burn
+        info = app.slashing.signing_info(VAL)
+        assert info.tombstoned
+        # jailing zeroed VAL's power -> blobstream emitted a new valset in
+        # which the remaining validator holds all normalized power
+        assert app.blobstream.latest_nonce() > nonce_before
+        latest = app.blobstream.latest_valset()
+        assert latest is not None and len(latest["members"]) == 1
+
+    def test_tombstoned_validator_cannot_unjail(self):
+        app = fresh_app()
+        delegate(app, ALICE, 10_000_000_000)
+        run_block(app, [], evidence=[Equivocation(validator=VAL, height=app.height)])
+        # VAL's operator address is not a real account here; call keeper directly
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="tombstoned"):
+            app.slashing.unjail(
+                app._new_ctx(app.store.branch(), __import__(
+                    "celestia_tpu.app.context", fromlist=["ExecMode"]).ExecMode.DELIVER),
+                VAL,
+            )
+
+    def test_downtime_jails_after_window(self, monkeypatch):
+        # shrink the window so the test runs in a few blocks
+        monkeypatch.setattr(slashing_mod, "SIGNED_BLOCKS_WINDOW", 8)
+        app = fresh_app()
+        delegate(app, ALICE, 10_000_000_000)
+        # miss every block: after the window fills, >25% missed -> jail
+        for _ in range(9):
+            run_block(app, [], signers=[])
+        val = app.staking.get_validator(VAL)
+        assert val.jailed
+        info = app.slashing.signing_info(VAL)
+        assert not info.tombstoned
+        assert info.jailed_until > 0
+
+    def test_signing_keeps_validator_bonded(self):
+        app = fresh_app()
+        delegate(app, ALICE, 10_000_000_000)
+        for _ in range(5):
+            run_block(app, [], signers=[VAL])
+        assert not app.staking.get_validator(VAL).jailed
+
+
+class TestDistribution:
+    def test_fees_flow_to_validators_and_community_pool(self):
+        app = fresh_app()
+        delegate(app, ALICE, 10_000_000_000)
+        # a block with a fee-paying tx
+        from celestia_tpu.x.bank import MsgSend
+
+        rs, _ = run_block(
+            app,
+            [signed(app, BOB, [MsgSend(BOB.bech32_address(), ALICE.bech32_address(), 1)])],
+        )
+        assert all(r.code == 0 for r in rs)
+        # fees from that block are allocated in the NEXT BeginBlock
+        run_block(app, [])
+        rewards = app.distribution.outstanding_rewards(VAL)
+        assert rewards > 0
+        assert app.distribution.community_pool() > 0
+
+        # operator withdraws (VAL has no account/key here; call keeper path
+        # through a deliver context to exercise the bank transfer)
+        from celestia_tpu.app.context import ExecMode
+
+        branch = app.store.branch()
+        ctx = app._new_ctx(branch, ExecMode.DELIVER)
+        from celestia_tpu.x.bank import BankKeeper
+        from celestia_tpu.x.distribution import DistributionKeeper
+        from celestia_tpu.x.staking import StakingKeeper
+
+        bank = BankKeeper(branch)
+        dist = DistributionKeeper(branch, bank, StakingKeeper(branch, bank))
+        got = dist.withdraw_rewards(ctx, VAL)
+        assert got == rewards
+        assert bank.get_balance(VAL) >= rewards
+
+
+class TestReviewRegressions:
+    def test_third_party_deposit_refunded_to_depositor(self):
+        """Deposits are refunded per depositor, not pooled to the proposer."""
+        app = fresh_app()
+        delegate(app, ALICE, 40_000_000_000)
+        changes = [ParamChange("blob", "GasPerBlobByte", "16")]
+        rs, _ = run_block(
+            app,
+            [signed(app, ALICE, [MsgSubmitProposal(
+                ALICE.bech32_address(), changes, 1_000)])],
+        )
+        assert all(r.code == 0 for r in rs)
+        pid = app.gov.proposals()[0].id
+        from celestia_tpu.x.gov import MsgDeposit
+
+        bob_before = app.bank.get_balance(BOB.bech32_address())
+        topup = gov_mod.MIN_DEPOSIT - 1_000
+        rs, _ = run_block(
+            app, [signed(app, BOB, [MsgDeposit(pid, BOB.bech32_address(), topup)])]
+        )
+        assert all(r.code == 0 for r in rs), [r.log for r in rs]
+        rs, _ = run_block(
+            app, [signed(app, ALICE, [MsgVote(pid, ALICE.bech32_address(), "yes")])]
+        )
+        _, out = run_block(
+            app, [], block_time=app.block_time + gov_mod.VOTING_PERIOD + 1
+        )
+        assert out["gov_finished"][0]["status"] == gov_mod.STATUS_PASSED
+        # Bob got his top-up back (minus the fees he paid for the deposit tx)
+        fee = 300_000
+        assert app.bank.get_balance(BOB.bech32_address()) == bob_before - fee
+
+    def test_slash_preserves_delegation_invariant(self):
+        """sum(delegations) == validator.tokens after a slash with floor
+        rounding (three 30-utia-scale delegations, 2% slash)."""
+        from celestia_tpu.app.context import ExecMode
+        from celestia_tpu.x.bank import BankKeeper
+        from celestia_tpu.x.staking import StakingKeeper
+
+        app = fresh_app()
+        branch = app.store.branch()
+        bank = BankKeeper(branch)
+        staking = StakingKeeper(branch, bank)
+        ctx = app._new_ctx(branch, ExecMode.DELIVER)
+        for i, who in enumerate(("d1", "d2", "d3")):
+            bank.mint(who, 100)
+            staking.delegate(ctx, who, "valx", 30)
+        burned = staking.slash(ctx, "valx", 20 * 10**15)  # 2%
+        v = staking.get_validator("valx")
+        assert burned == 90 * 2 // 100 == 1
+        total_delegated = sum(staking.delegations_to("valx").values())
+        assert total_delegated == v.tokens  # invariant holds
+        # every delegator can exit fully
+        for who, tokens in sorted(staking.delegations_to("valx").items()):
+            staking.undelegate(ctx, who, "valx", tokens)
+        assert staking.get_validator("valx").tokens == 0

@@ -1,0 +1,140 @@
+"""Merkleized state commitment tests (reference model: IAVL multistore
+commits + store proofs, app/app.go:263-279)."""
+
+import numpy as np
+
+from celestia_tpu import smt
+from celestia_tpu.state import StateStore
+
+
+class TestSparseMerkleTree:
+    def test_empty_root_stable(self):
+        t = smt.SparseMerkleTree()
+        assert t.root == smt.DEFAULT[0]
+
+    def test_update_and_prove(self):
+        t = smt.SparseMerkleTree()
+        t.update(smt.key_hash(b"alpha"), b"1")
+        t.update(smt.key_hash(b"beta"), b"2")
+        p = t.prove(smt.key_hash(b"alpha"))
+        assert smt.verify_proof(t.root, b"alpha", b"1", p)
+        assert not smt.verify_proof(t.root, b"alpha", b"2", p)
+        assert not smt.verify_proof(t.root, b"gamma", b"1", p)
+
+    def test_absence_proof(self):
+        t = smt.SparseMerkleTree()
+        t.update(smt.key_hash(b"alpha"), b"1")
+        p = t.prove(smt.key_hash(b"missing"))
+        assert smt.verify_proof(t.root, b"missing", None, p)
+        assert not smt.verify_proof(t.root, b"missing", b"x", p)
+
+    def test_delete_restores_root(self):
+        t = smt.SparseMerkleTree()
+        t.update(smt.key_hash(b"a"), b"1")
+        root1 = t.root
+        t.update(smt.key_hash(b"b"), b"2")
+        t.update(smt.key_hash(b"b"), None)
+        assert t.root == root1
+        t.update(smt.key_hash(b"a"), None)
+        assert t.root == smt.DEFAULT[0]
+        assert not t._nodes  # fully pruned
+
+    def test_order_independence(self):
+        items = [(bytes([i]), bytes([i * 2 % 251])) for i in range(20)]
+        t1 = smt.SparseMerkleTree()
+        for k, v in items:
+            t1.update(smt.key_hash(k), v)
+        t2 = smt.SparseMerkleTree()
+        for k, v in reversed(items):
+            t2.update(smt.key_hash(k), v)
+        assert t1.root == t2.root
+
+    def test_proof_roundtrip_marshal(self):
+        t = smt.SparseMerkleTree()
+        t.update(smt.key_hash(b"k"), b"v")
+        p = t.prove(smt.key_hash(b"k"))
+        p2 = smt.Proof.unmarshal(p.marshal())
+        assert smt.verify_proof(t.root, b"k", b"v", p2)
+
+
+class TestMerkleizedStateStore:
+    def test_app_hash_is_smt_root(self):
+        s = StateStore()
+        s.set(b"x", b"1")
+        h1 = s.commit()
+        s.set(b"y", b"2")
+        h2 = s.commit()
+        assert h1 != h2
+        p = s.prove(b"x")
+        assert StateStore.verify_proof(h2, b"x", b"1", p)
+        assert not StateStore.verify_proof(h1, b"y", b"2", s.prove(b"y"))
+
+    def test_commit_cost_independent_of_state_size(self):
+        """O(dirty · log) commits: hashing work per commit must depend on
+        the number of changed keys, not total state size."""
+        rng = np.random.default_rng(0)
+
+        def one_commit_cost(preload: int) -> int:
+            s = StateStore()
+            for i in range(preload):
+                s.set(b"pre/%d" % i, bytes(rng.integers(0, 256, 8, dtype=np.uint8)))
+            s.commit()
+            before = s._smt.hash_count
+            for i in range(10):
+                s.set(b"hot/%d" % i, b"v")
+            s.commit()
+            return s._smt.hash_count - before
+
+        small = one_commit_cost(10)
+        large = one_commit_cost(2000)
+        assert small == large  # exactly the same hashing work
+
+    def test_snapshot_restore_same_root(self):
+        s = StateStore()
+        for i in range(50):
+            s.set(b"k%d" % i, b"v%d" % i)
+        s.commit()
+        s2 = StateStore.restore(s.snapshot())
+        assert s2.app_hashes[s2.version] == s.app_hashes[s.version]
+        p = s2.prove(b"k7")
+        assert StateStore.verify_proof(s.app_hashes[s.version], b"k7", b"v7", p)
+
+
+class TestStateProofRPC:
+    def test_proof_route(self):
+        import json
+        import urllib.request
+
+        from celestia_tpu.app import App
+        from celestia_tpu.node.node import Node
+        from celestia_tpu.node.rpc import RpcServer
+        from celestia_tpu.crypto import PrivateKey
+
+        key = PrivateKey.from_secret(b"smt-rpc")
+        app = App()
+        app.init_chain({key.bech32_address(): 1_000_000}, genesis_time=0.0)
+        node = Node(app)
+        node.produce_block()
+        srv = RpcServer(node, port=0)
+        srv.start()
+        try:
+            port = srv.server.server_address[1]
+            from celestia_tpu.x.bank import _balance_key
+
+            k = _balance_key(key.bech32_address(), "utia")
+            url = f"http://127.0.0.1:{port}/proof/state/{k.hex()}"
+            resp = json.loads(urllib.request.urlopen(url).read())
+            assert resp["value"] is not None
+            proof = __import__("celestia_tpu.smt", fromlist=["Proof"]).Proof.unmarshal(
+                resp["proof"]
+            )
+            from celestia_tpu import smt as smt_mod
+
+            assert smt_mod.verify_proof(
+                bytes.fromhex(resp["app_hash"]),
+                k,
+                bytes.fromhex(resp["value"]),
+                proof,
+            )
+        finally:
+            srv.stop()
