@@ -1,0 +1,207 @@
+"""Seeded property/fuzz suite for the consensus-critical square pipeline.
+
+Port of the reference's FuzzSquare (pkg/square/square_fuzz_test.go:1-104):
+random mixes of normal txs and blob txs must satisfy, for every case:
+- Build never raises and Construct(ordered) == Build square
+- ordered txs ⊆ input txs
+- Deconstruct inverts the square back to exactly the ordered txs
+- (sampled) the square extends to an EDS + DAH, and every PFB share
+  commitment is recomputable from the EDS row trees at the wrapped
+  share indexes (ADR-013 containment)
+
+Plus randomized ProcessProposal tamper tests (app/test/fuzz_abci_test.go
+analogue): random single-byte/structural tampering of a valid proposal
+must be rejected.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from celestia_tpu import appconsts, blob as blob_pkg, da
+from celestia_tpu import namespace as ns
+from celestia_tpu import square as square_pkg
+from celestia_tpu.crypto import PrivateKey
+from celestia_tpu.inclusion.cache import EDSSubtreeRootCacher, get_commitment
+from celestia_tpu.shares import to_bytes
+from celestia_tpu.shares.splitters import sparse_shares_needed
+from celestia_tpu.tx import Fee, decode_tx, sign_tx
+from celestia_tpu.x.blob.types import MsgPayForBlobs, new_msg_pay_for_blobs, pfb_blob_sizes
+from celestia_tpu.x.bank import MsgSend
+
+APP_VERSION = 1
+MAX_SQUARE = appconsts.square_size_upper_bound(APP_VERSION)
+
+KEY = PrivateKey.from_secret(b"fuzz")
+ADDR = KEY.bech32_address()
+
+
+def rand_namespace(rng) -> ns.Namespace:
+    return ns.new_v0(bytes(rng.integers(1, 255, size=10, dtype=np.uint8)))
+
+
+def rand_send_tx(rng, seq: int) -> bytes:
+    return sign_tx(
+        KEY, [MsgSend(ADDR, ADDR, int(rng.integers(1, 1000)))],
+        "fuzz-chain", 0, seq, Fee(amount=1000, gas_limit=100_000),
+    ).marshal()
+
+
+def rand_blob_tx(rng, seq: int, max_blob: int) -> bytes:
+    n_blobs = int(rng.integers(1, 4))
+    blobs = [
+        blob_pkg.new_blob(
+            rand_namespace(rng),
+            bytes(rng.integers(0, 256, size=int(rng.integers(1, max_blob)), dtype=np.uint8)),
+            0,
+        )
+        for _ in range(n_blobs)
+    ]
+    blobs.sort(key=lambda b: bytes(b.namespace_id))
+    msg = new_msg_pay_for_blobs(ADDR, *blobs)
+    tx = sign_tx(KEY, [msg], "fuzz-chain", 0, seq,
+                 Fee(amount=1000, gas_limit=100_000))
+    return blob_pkg.marshal_blob_tx(tx.marshal(), blobs)
+
+
+def gen_case(rng, max_blob=8_000):
+    normal = int(rng.integers(0, 8))
+    pfbs = int(rng.integers(0, 10))
+    txs = []
+    for i in range(normal):
+        txs.append(rand_send_tx(rng, i))
+    for i in range(pfbs):
+        txs.append(rand_blob_tx(rng, normal + i, max_blob))
+    # shuffle so normal/blob interleave like a real mempool
+    order = rng.permutation(len(txs))
+    return [txs[i] for i in order]
+
+
+class TestFuzzSquare:
+    N_CASES = 1000
+    EXTEND_EVERY = 25  # full EDS + commitment containment on a sample
+
+    def test_build_construct_deconstruct_roundtrip(self):
+        rng = np.random.default_rng(3554045230938829713 % 2**63)
+        for case in range(self.N_CASES):
+            txs = gen_case(rng)
+            sq, ordered = square_pkg.build(txs, APP_VERSION, MAX_SQUARE)
+            # ordered ⊆ input
+            pool = {t for t in txs}
+            assert all(t in pool for t in ordered), f"case {case}: foreign tx"
+            sq2 = square_pkg.construct(ordered, APP_VERSION, MAX_SQUARE)
+            assert [s.data for s in sq] == [s.data for s in sq2], (
+                f"case {case}: Construct != Build"
+            )
+            back = square_pkg.deconstruct(sq2, pfb_blob_sizes)
+            assert back == ordered, f"case {case}: Deconstruct mismatch"
+
+            if case % self.EXTEND_EVERY == 0 and len(sq) > 1:
+                self._check_extension_and_commitments(sq, ordered, case)
+
+    def _check_extension_and_commitments(self, sq, ordered, case):
+        k = square_pkg.square_size(len(sq))
+        arr = np.frombuffer(b"".join(to_bytes(sq)), dtype=np.uint8).reshape(
+            k, k, appconsts.SHARE_SIZE
+        )
+        eds = da.extend_shares(arr)
+        dah = da.new_data_availability_header(eds)
+        assert len(dah.row_roots) == 2 * k
+
+        # every wrapped PFB's commitments must be recomputable from the EDS
+        cacher = EDSSubtreeRootCacher(eds)
+        threshold = appconsts.subtree_root_threshold(APP_VERSION)
+        pfb_region = square_pkg.get_share_range_for_namespace(
+            sq, ns.PAY_FOR_BLOB_NAMESPACE
+        )
+        if pfb_region.start == pfb_region.end:
+            return
+        from celestia_tpu.square import parse_txs
+
+        for wpfb_bytes in parse_txs(sq[pfb_region.start: pfb_region.end]):
+            wpfb, is_wpfb = blob_pkg.unmarshal_index_wrapper(wpfb_bytes)
+            assert is_wpfb, f"case {case}: PFB region tx not an IndexWrapper"
+            tx = decode_tx(wpfb.tx)
+            msg = tx.msgs[0]
+            assert isinstance(msg, MsgPayForBlobs)
+            for blob_i, share_index in enumerate(wpfb.share_indexes):
+                commitment = get_commitment(
+                    cacher,
+                    share_index,
+                    sparse_shares_needed(msg.blob_sizes[blob_i]),
+                    threshold,
+                )
+                assert commitment == msg.share_commitments[blob_i], (
+                    f"case {case}: commitment containment failed"
+                )
+
+
+class TestFuzzProcessProposal:
+    """Randomly tampered proposals must be rejected
+    (app/test/fuzz_abci_test.go analogue)."""
+
+    N_CASES = 60
+
+    def _fresh_app(self):
+        from celestia_tpu.app import App
+
+        app = App()
+        app.init_chain({ADDR: 10**12}, genesis_time=0.0)
+        p0 = app.prepare_proposal([])
+        assert app.process_proposal(p0)
+        app.begin_block(15.0)
+        app.end_block()
+        app.commit()
+        return app
+
+    def test_tampered_proposals_rejected(self):
+        import dataclasses
+
+        from celestia_tpu.x.blob.types import estimate_gas
+
+        rng = np.random.default_rng(42424242)
+        app = self._fresh_app()
+        acc = app.accounts.get_account(ADDR)
+
+        b = blob_pkg.new_blob(ns.new_v0(b"fuzztamper"), b"\x11" * 3000, 0)
+        gas = estimate_gas([3000])
+        pfb = sign_tx(
+            KEY, [new_msg_pay_for_blobs(ADDR, b)], app.chain_id,
+            acc.account_number, acc.sequence, Fee(amount=gas, gas_limit=gas),
+        )
+        raw = blob_pkg.marshal_blob_tx(pfb.marshal(), [b])
+        block = app.prepare_proposal([raw])
+        assert app.process_proposal(block)
+
+        rejected = 0
+        for case in range(self.N_CASES):
+            mode = case % 4
+            tampered = dataclasses.replace(block)
+            if mode == 0 and block.txs:
+                # flip a random byte in a random tx
+                ti = int(rng.integers(0, len(block.txs)))
+                txb = bytearray(block.txs[ti])
+                bi = int(rng.integers(0, len(txb)))
+                txb[bi] ^= int(rng.integers(1, 256))
+                txs = list(block.txs)
+                txs[ti] = bytes(txb)
+                tampered = dataclasses.replace(block, txs=txs)
+            elif mode == 1:
+                # wrong square size
+                tampered = dataclasses.replace(
+                    block, square_size=max(1, block.square_size * 2) % 256 or 1
+                )
+            elif mode == 2:
+                # tampered data hash
+                h = bytearray(block.hash)
+                h[int(rng.integers(0, 32))] ^= 0xFF
+                tampered = dataclasses.replace(block, hash=bytes(h))
+            else:
+                # append a duplicate tx (breaks exact reconstruction)
+                tampered = dataclasses.replace(block, txs=list(block.txs) + [raw])
+            if not app.process_proposal(tampered):
+                rejected += 1
+        # every tamper class must be rejected (byte flips can occasionally
+        # produce an undecodable-but-droppable tx; require near-total)
+        assert rejected == self.N_CASES, f"{self.N_CASES - rejected} tampers accepted"
