@@ -17,10 +17,16 @@ Structure exploited on-device:
 - Axis length 2k is a power of two, so the RFC-6962 split (largest power
   of two < n) degenerates to a perfectly balanced binary tree:
   level-synchronous pairwise reduction with static shapes at every level.
-- Namespace min/max propagation follows nmt v0.20 with IgnoreMaxNamespace:
-  min = left.min; max = left.max if right.min == parity else right.max.
-  (For the honest squares this path computes — sorted namespaces, parity
-  in Q1/Q2/Q3 — this is exactly the general hasher's result.)
+- Namespace min/max propagation follows nmt v0.20 with IgnoreMaxNamespace.
+  The device kernel uses the two-branch specialization
+  (min = left.min; max = left.max if right.min == parity else right.max),
+  which is provably equal to the general three-branch hasher
+  (ops/nmt_host.hash_node) on every tree whose leaf namespaces are
+  non-decreasing — the invariant nmt itself enforces via
+  ErrInvalidPushOrder/ErrUnorderedSiblings, and which the square builder
+  guarantees (Q0 sorted by construction, parity in Q1/Q2/Q3).
+  tests/test_nmt_semantics.py pins host/device agreement on adversarial
+  vectors including max-namespace leaves inside Q0.
 
 Outputs are byte-identical to celestia_tpu.da (host) and therefore to the
 reference DAH.

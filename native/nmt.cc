@@ -98,7 +98,13 @@ void nmt_hash_node(const uint8_t* left, const uint8_t* right, uint8_t* node) {
   msg[0] = 0x01;
   std::memcpy(msg + 1, left, kNodeSize);
   std::memcpy(msg + 1 + kNodeSize, right, kNodeSize);
-  // min = left.min; max = (right.min == parity) ? left.max : right.max
+  // Two-branch specialization of nmt v0.20 HashNode (IgnoreMaxNamespace):
+  //   min = left.min; max = (right.min == parity) ? left.max : right.max
+  // Equal to the general three-branch rule for every tree with
+  // non-decreasing leaf namespaces — guaranteed here because this path only
+  // hashes honest EDS axes (Q0 sorted, parity in Q1/Q2/Q3). The general
+  // hasher incl. order validation lives in ops/nmt_host.py; agreement is
+  // pinned by tests/test_nmt_semantics.py.
   std::memcpy(node, left, kNsSize);
   bool right_parity = std::memcmp(right, kParityNs, kNsSize) == 0;
   std::memcpy(node + kNsSize, (right_parity ? left : right) + kNsSize, kNsSize);

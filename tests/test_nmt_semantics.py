@@ -1,0 +1,226 @@
+"""Adversarial vectors for the nmt v0.20 IgnoreMaxNamespace semantics.
+
+Pins three facts (VERDICT r1 item 10, ref pkg/wrapper/nmt_wrapper.go:55-62):
+
+1. The host hasher implements the FULL three-branch HashNode max rule
+   (maxNs = MAX_NS if left.min == MAX_NS; left.max if right.min == MAX_NS;
+   else max(left.max, right.max)) and min = min(left.min, right.min).
+2. Order validation mirrors nmt: hashing out-of-order siblings raises
+   (ErrUnorderedSiblings analogue), pushing decreasing leaves raises
+   (ErrInvalidPushOrder analogue) — malformed trees error, never produce
+   a silently-wrong root.
+3. The device kernel's two-branch specialization agrees byte-for-byte
+   with the general host hasher on every validly-ordered tree, including
+   the adversarial-but-ordered case of max-namespace (parity-valued)
+   leaves inside Q0.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from celestia_tpu import namespace as ns
+from celestia_tpu.appconsts import NAMESPACE_SIZE, SHARE_SIZE
+from celestia_tpu.ops import nmt_host
+from celestia_tpu.ops.nmt_host import (
+    InvalidPushOrderError,
+    UnorderedSiblingsError,
+    hash_leaf,
+    hash_node,
+    nmt_root,
+)
+
+PARITY = ns.PARITY_SHARES_NAMESPACE.bytes
+
+
+def mk_ns(b: int) -> bytes:
+    return bytes(NAMESPACE_SIZE - 1) + bytes([b])
+
+
+def mk_node(min_ns: bytes, max_ns: bytes, tag: bytes = b"x") -> bytes:
+    return min_ns + max_ns + hashlib.sha256(tag).digest()
+
+
+class TestHashNodeBranches:
+    def test_plain_max_propagation(self):
+        """else-branch: max = max(left.max, right.max) (here right.max)."""
+        left = mk_node(mk_ns(1), mk_ns(2), b"l")
+        right = mk_node(mk_ns(3), mk_ns(7), b"r")
+        out = hash_node(left, right)
+        assert out[:NAMESPACE_SIZE] == mk_ns(1)
+        assert out[NAMESPACE_SIZE : 2 * NAMESPACE_SIZE] == mk_ns(7)
+
+    def test_right_min_parity_ignores_right_max(self):
+        """2nd branch: right subtree is all parity -> max = left.max."""
+        left = mk_node(mk_ns(1), mk_ns(5), b"l")
+        right = mk_node(PARITY, PARITY, b"r")
+        out = hash_node(left, right)
+        assert out[:NAMESPACE_SIZE] == mk_ns(1)
+        assert out[NAMESPACE_SIZE : 2 * NAMESPACE_SIZE] == mk_ns(5)
+
+    def test_left_min_parity_keeps_parity_max(self):
+        """1st branch: left subtree already all-parity -> max stays MAX_NS."""
+        left = mk_node(PARITY, PARITY, b"l")
+        right = mk_node(PARITY, PARITY, b"r")
+        out = hash_node(left, right)
+        assert out[:NAMESPACE_SIZE] == PARITY
+        assert out[NAMESPACE_SIZE : 2 * NAMESPACE_SIZE] == PARITY
+
+    def test_ignore_disabled_uses_true_max(self):
+        left = mk_node(mk_ns(1), mk_ns(5), b"l")
+        right = mk_node(PARITY, PARITY, b"r")
+        out = hash_node(left, right, ignore_max_ns=False)
+        assert out[NAMESPACE_SIZE : 2 * NAMESPACE_SIZE] == PARITY
+
+    def test_digest_never_depends_on_branch(self):
+        """The sha256 part hashes raw child nodes; only the ns prefix differs."""
+        left = mk_node(mk_ns(1), mk_ns(5), b"l")
+        right = mk_node(PARITY, PARITY, b"r")
+        a = hash_node(left, right)[2 * NAMESPACE_SIZE :]
+        b = hash_node(left, right, ignore_max_ns=False)[2 * NAMESPACE_SIZE :]
+        assert a == b
+
+
+class TestOrderValidation:
+    def test_unordered_siblings_raise(self):
+        """nmt ErrUnorderedSiblings: right.min < left.max."""
+        left = mk_node(mk_ns(1), mk_ns(9), b"l")
+        right = mk_node(mk_ns(3), mk_ns(4), b"r")
+        with pytest.raises(UnorderedSiblingsError):
+            hash_node(left, right)
+
+    def test_equal_boundary_allowed(self):
+        """right.min == left.max is legal (same namespace spans subtrees)."""
+        left = mk_node(mk_ns(1), mk_ns(3), b"l")
+        right = mk_node(mk_ns(3), mk_ns(4), b"r")
+        hash_node(left, right)  # must not raise
+
+    def test_decreasing_leaf_push_raises(self):
+        leaves = [mk_ns(5) + b"a" * 8, mk_ns(2) + b"b" * 8]
+        with pytest.raises(InvalidPushOrderError):
+            nmt_root(leaves)
+
+    def test_parity_leaf_before_real_ns_raises(self):
+        """A parity-namespace leaf followed by a real one is out of order."""
+        leaves = [PARITY + b"a" * 8, mk_ns(2) + b"b" * 8]
+        with pytest.raises(InvalidPushOrderError):
+            nmt_root(leaves)
+
+    def test_unordered_error_is_verification_failure(self):
+        """Proof verifiers treat it as ValueError, matching their failure mode."""
+        assert issubclass(UnorderedSiblingsError, ValueError)
+        assert issubclass(InvalidPushOrderError, ValueError)
+
+
+def _reference_general_root(leaves):
+    """Independent straight-from-the-spec implementation of the full nmt
+    v0.20 hasher (three-branch max, min of both children), used as the
+    cross-check oracle against both the production host path and the device
+    kernel."""
+
+    def leaf(l):
+        nid = l[:NAMESPACE_SIZE]
+        return nid + nid + hashlib.sha256(b"\x00" + l).digest()
+
+    def node(a, b):
+        amin, amax = a[:NAMESPACE_SIZE], a[NAMESPACE_SIZE : 2 * NAMESPACE_SIZE]
+        bmin, bmax = b[:NAMESPACE_SIZE], b[NAMESPACE_SIZE : 2 * NAMESPACE_SIZE]
+        if amin == PARITY:
+            mx = PARITY
+        elif bmin == PARITY:
+            mx = amax
+        else:
+            mx = max(amax, bmax)
+        return min(amin, bmin) + mx + hashlib.sha256(b"\x01" + a + b).digest()
+
+    def rec(ls):
+        if len(ls) == 1:
+            return leaf(ls[0])
+        k = 1
+        while k * 2 < len(ls):
+            k *= 2
+        return node(rec(ls[:k]), rec(ls[k:]))
+
+    return rec(leaves)
+
+
+class TestHostDeviceAgreement:
+    @pytest.fixture(scope="class")
+    def jnp(self):
+        import jax.numpy as jnp
+
+        return jnp
+
+    def _device_row_root(self, jnp, leaf_ns_rows, data_rows):
+        from celestia_tpu.ops.extend_tpu import nmt_leaf_nodes, nmt_reduce_axis
+
+        ns_arr = jnp.asarray(
+            np.stack([np.frombuffer(n, dtype=np.uint8) for n in leaf_ns_rows])
+        )
+        data_arr = jnp.asarray(
+            np.stack([np.frombuffer(d, dtype=np.uint8) for d in data_rows])
+        )
+        nodes = nmt_leaf_nodes(ns_arr, data_arr)
+        return bytes(np.asarray(nmt_reduce_axis(nodes)))
+
+    def test_max_ns_leaf_in_q0_matches_general_hasher(self, jnp):
+        """Adversarial-but-ordered: the LAST Q0 leaf carries the maximal
+        (parity-valued) namespace. The two-branch device rule, the host
+        production hasher and the independent three-branch oracle must all
+        produce the same root."""
+        k = 4  # 8-leaf row: 4 Q0 cells + 4 parity cells
+        data = [bytes([i] * (SHARE_SIZE - NAMESPACE_SIZE)) for i in range(2 * k)]
+        ns_row = [mk_ns(1), mk_ns(2), mk_ns(3), PARITY] + [PARITY] * k
+        leaves = [n + d for n, d in zip(ns_row, data)]
+
+        host_root = nmt_root(leaves)
+        oracle_root = _reference_general_root(leaves)
+        dev_root = self._device_row_root(jnp, ns_row, data)
+        assert host_root == oracle_root == dev_root
+
+    def test_all_parity_row_matches(self, jnp):
+        k = 4
+        data = [bytes([7 + i] * (SHARE_SIZE - NAMESPACE_SIZE)) for i in range(2 * k)]
+        ns_row = [PARITY] * (2 * k)
+        leaves = [n + d for n, d in zip(ns_row, data)]
+        host_root = nmt_root(leaves)
+        assert host_root == _reference_general_root(leaves)
+        assert host_root == self._device_row_root(jnp, ns_row, data)
+        assert host_root[:NAMESPACE_SIZE] == PARITY
+        assert host_root[NAMESPACE_SIZE : 2 * NAMESPACE_SIZE] == PARITY
+
+    def test_honest_row_shape_matches(self, jnp):
+        k = 8
+        data = [bytes([i] * (SHARE_SIZE - NAMESPACE_SIZE)) for i in range(2 * k)]
+        ns_row = [mk_ns(i + 1) for i in range(k)] + [PARITY] * k
+        leaves = [n + d for n, d in zip(ns_row, data)]
+        host_root = nmt_root(leaves)
+        assert host_root == _reference_general_root(leaves)
+        assert host_root == self._device_row_root(jnp, ns_row, data)
+
+    def test_randomized_ordered_rows_agree(self, jnp):
+        rng = np.random.default_rng(1234)
+        for _ in range(25):
+            k = int(rng.choice([2, 4, 8]))
+            n_parityish = int(rng.integers(0, k + 1))  # parity-ns leaves in Q0
+            q0 = sorted(
+                mk_ns(int(b)) for b in rng.integers(1, 200, size=k - n_parityish)
+            ) + [PARITY] * n_parityish
+            ns_row = q0 + [PARITY] * k
+            data = [bytes(rng.integers(0, 256, size=64, dtype=np.uint8)) for _ in range(2 * k)]
+            leaves = [n + d for n, d in zip(ns_row, data)]
+            host_root = nmt_root(leaves)
+            assert host_root == _reference_general_root(leaves)
+
+    def test_dah_oracle_still_pinned(self):
+        """The full-semantics hasher must not move any committed root: the
+        hard-coded reference DAH vectors (tests/test_dah_oracle.py) run in
+        the same suite; here we just re-pin the minimum-square root."""
+        from celestia_tpu import da
+
+        dah = da.min_data_availability_header()
+        assert (
+            dah.hash().hex()
+            == "3d96b7d238e7e0456f6af8e7cdf0a67bd6cf9c2089ecb559c659dcaa1f880353"
+        )
