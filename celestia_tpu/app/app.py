@@ -91,6 +91,25 @@ class App:
         # Commit so it re-branches from the new committed state.
         self._check_store = None
 
+    def rebind_store(self, store: StateStore) -> None:
+        """Point the app and ALL its keepers at a replacement committed
+        store (restore/import paths). Keepers are reconstructed exactly as
+        in __init__ so none is left reading the discarded store."""
+        self.store = store
+        self.accounts = AccountKeeper(store)
+        self.bank = BankKeeper(store)
+        self.blob = BlobKeeper(store)
+        self.mint = MintKeeper(store, self.bank)
+        self.staking = StakingKeeper(store, self.bank)
+        self.blobstream = BlobstreamKeeper(store, self.staking)
+        self.staking.hooks.append(self.blobstream)
+        self.gov = GovKeeper(store, self.bank, self.staking)
+        self.distribution = DistributionKeeper(store, self.bank, self.staking)
+        self.slashing = SlashingKeeper(store, self.staking)
+        self._deliver_store = None
+        self._deliver_ctx = None
+        self._check_store = None
+
     # ------------------------------------------------------------------ #
     # genesis
 

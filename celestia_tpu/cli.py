@@ -35,6 +35,7 @@ def _save_keys(home: pathlib.Path, keys: dict) -> None:
 
 
 def cmd_init(args):
+    from celestia_tpu.config import write_default_configs
     from celestia_tpu.crypto import PrivateKey
 
     home = _home(args)
@@ -50,8 +51,12 @@ def cmd_init(args):
         "accounts": {key.bech32_address(): 1_000_000_000_000},
     }
     (home / "genesis.json").write_text(json.dumps(genesis, indent=2))
+    # layered config files (ref: app/default_overrides.go:230-271 written by
+    # celestia-appd init; start layers defaults < files < env < flags)
+    write_default_configs(home)
     print(f"initialized chain {args.chain_id} at {home}")
     print(f"validator address: {key.bech32_address()}")
+    print(f"wrote {home}/config/config.toml and {home}/config/app.toml")
 
 
 def _build_node(home: pathlib.Path):
@@ -61,23 +66,38 @@ def _build_node(home: pathlib.Path):
     genesis = json.loads((home / "genesis.json").read_text())
     if (home / "meta.json").exists():
         return Node.load(str(home))
+    if "app_state" in genesis:
+        # genesis produced by `export` — rebuild the full module state
+        from celestia_tpu.app.export import import_genesis
+
+        app = import_genesis(genesis)
+        return Node(app, home=str(home))
     app = App(chain_id=genesis["chain_id"])
     app.init_chain(genesis["accounts"], genesis_time=genesis["genesis_time"])
     return Node(app, home=str(home))
 
 
 def cmd_start(args):
+    from celestia_tpu.config import load_config
     from celestia_tpu.node.rpc import RpcServer
 
     home = _home(args)
+    flag_overrides = {}
+    if args.block_time is not None:
+        flag_overrides["consensus.goal_block_time_seconds"] = args.block_time
+    cfg = load_config(home, flag_overrides)
     node = _build_node(home)
+    node.app.min_gas_price = cfg.app.min_gas_price
+    node.mempool.ttl_blocks = cfg.consensus.mempool.ttl_num_blocks
+    node.mempool.max_tx_bytes = cfg.consensus.mempool.max_tx_bytes
     server = RpcServer(node, port=args.port)
     server.start()
     print(f"node started: chain {node.app.chain_id} height {node.latest_height()} "
-          f"rpc http://127.0.0.1:{server.port}")
+          f"rpc http://127.0.0.1:{server.port} "
+          f"min-gas-price {cfg.app.min_gas_price}")
     try:
         while True:
-            time.sleep(args.block_time)
+            time.sleep(cfg.consensus.goal_block_time_seconds)
             block = node.produce_block()
             node.save_snapshot()
             print(f"height {block.height} txs {len(block.txs)} "
@@ -86,6 +106,24 @@ def cmd_start(args):
         server.stop()
         node.save_snapshot()
         print("node stopped")
+
+
+def cmd_export(args):
+    """ref: app/export.go via `celestia-appd export` — print (or write) a
+    genesis document a fresh node can start from."""
+    from celestia_tpu.app.export import export_app_state_and_validators
+
+    home = _home(args)
+    node = _build_node(home)
+    genesis = export_app_state_and_validators(
+        node.app, for_zero_height=args.for_zero_height
+    )
+    text = json.dumps(genesis, indent=2, sort_keys=True)
+    if args.output:
+        pathlib.Path(args.output).write_text(text)
+        print(f"exported genesis (height {genesis['height']}) to {args.output}")
+    else:
+        print(text)
 
 
 def cmd_keys(args):
@@ -164,7 +202,12 @@ def main(argv=None):
 
     sub.add_parser("init")
     p_start = sub.add_parser("start")
-    p_start.add_argument("--block-time", type=float, default=15.0)
+    # None = "flag not passed" so config-file/env values aren't masked
+    p_start.add_argument("--block-time", type=float, default=None)
+
+    p_export = sub.add_parser("export")
+    p_export.add_argument("--for-zero-height", action="store_true")
+    p_export.add_argument("--output", default=None)
 
     p_keys = sub.add_parser("keys")
     p_keys.add_argument("keys_cmd", choices=["add", "list", "show"])
@@ -192,6 +235,7 @@ def main(argv=None):
     {
         "init": cmd_init,
         "start": cmd_start,
+        "export": cmd_export,
         "keys": cmd_keys,
         "tx": cmd_tx,
         "query": cmd_query,

@@ -209,11 +209,7 @@ class Node:
         meta = json.loads((home_path / "meta.json").read_text())
         app = App(chain_id=meta["chain_id"], app_version=meta["app_version"],
                   **app_kwargs)
-        app.store = StateStore.restore((home_path / "state.json").read_bytes())
-        app.accounts.store = app.store
-        app.bank.store = app.store
-        app.blob.store = app.store
-        app.mint.store = app.store
+        app.rebind_store(StateStore.restore((home_path / "state.json").read_bytes()))
         app.height = meta["height"]
         app.block_time = meta["block_time"]
         node = cls(app, home=home)
