@@ -172,6 +172,79 @@ def _handler_for(node: Node):
                     )
                     proof.validate(block.data_hash)
                     self._reply(_share_proof_json(proof))
+                elif parts == ["blobstream", "nonces"]:
+                    # ref: LatestAttestationNonce + EarliestAttestationNonce
+                    self._reply(
+                        {
+                            "latest": node.app.blobstream.latest_nonce(),
+                            "earliest": node.app.blobstream.earliest_nonce(),
+                        }
+                    )
+                elif len(parts) == 3 and parts[0] == "blobstream" \
+                        and parts[1] == "attestation":
+                    # ref: x/blobstream query server AttestationRequestByNonce
+                    att = node.app.blobstream.get_attestation(int(parts[2]))
+                    if att is None:
+                        self._reply({"error": "attestation not found"}, 404)
+                    else:
+                        self._reply(att)
+                elif parts == ["blobstream", "valset", "latest"]:
+                    from celestia_tpu.x import blobstream_abi as bsabi
+
+                    vs = node.app.blobstream.latest_valset()
+                    if vs is None:
+                        self._reply({"error": "no valset yet"}, 404)
+                    else:
+                        vs = dict(vs)
+                        vs["hash"] = bsabi.validator_set_hash(vs["members"]).hex()
+                        vs["sign_bytes"] = bsabi.valset_sign_bytes(
+                            vs["nonce"], vs["members"]
+                        ).hex()
+                        self._reply(vs)
+                elif len(parts) == 3 and parts[0] == "blobstream" \
+                        and parts[1] == "data_commitment":
+                    # ref: QueryDataCommitmentRangeForHeight + the ABI
+                    # artifacts an orchestrator signs over
+                    from celestia_tpu.x import blobstream_abi as bsabi
+                    from celestia_tpu.x.blobstream_client import (
+                        data_root_tuple_root_for_attestation,
+                    )
+
+                    att = node.app.blobstream.data_commitment_range_for_height(
+                        int(parts[2])
+                    )
+                    if att is None:
+                        self._reply({"error": "no commitment covers height"}, 404)
+                    else:
+                        att = dict(att)
+                        root = data_root_tuple_root_for_attestation(node, att)
+                        att["tuple_root"] = root.hex()
+                        att["sign_bytes"] = bsabi.data_commitment_sign_bytes(
+                            att["nonce"], root
+                        ).hex()
+                        self._reply(att)
+                elif len(parts) == 3 and parts[0] == "blobstream" \
+                        and parts[1] == "data_root_inclusion":
+                    # trpc.DataRootInclusionProof analogue
+                    from celestia_tpu.x import blobstream_abi as bsabi
+                    from celestia_tpu.x.blobstream_client import _tuple_range
+
+                    height = int(parts[2])
+                    att = node.app.blobstream.data_commitment_range_for_height(
+                        height
+                    )
+                    if att is None:
+                        self._reply({"error": "no commitment covers height"}, 404)
+                    else:
+                        heights, roots = _tuple_range(
+                            node, att["begin_block"], att["end_block"]
+                        )
+                        proof = bsabi.prove_data_root_inclusion(
+                            heights, roots, height
+                        )
+                        self._reply(
+                            {"nonce": att["nonce"], "proof": proof.to_json()}
+                        )
                 else:
                     self._reply({"error": "unknown route"}, 404)
             except Exception as e:  # noqa: BLE001

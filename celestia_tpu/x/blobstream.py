@@ -180,6 +180,8 @@ class BlobstreamKeeper:
     # --- current bridge valset (ref: keeper/keeper_valset.go GetCurrentValset) ---
 
     def current_valset_members(self) -> list[BridgeValidator]:
+        from celestia_tpu.x.blobstream_abi import eip55_checksum_address
+
         validators = self.staking.bonded_validators()
         total = sum(v.power for v in validators)
         if total == 0:
@@ -191,7 +193,40 @@ class BlobstreamKeeper:
                 BridgeValidator(power=v.power * NORMALIZED_POWER // total,
                                 evm_address=evm)
             )
+        # ref: x/blobstream/types/validator.go:86-99 Sort — descending
+        # bridge power, ties broken on the EIP-55 checksummed hex string
+        members.sort(key=lambda m: (-m.power, eip55_checksum_address(m.evm_address)))
         return members
+
+    # --- query server (ref: x/blobstream/keeper/query.go) ---
+
+    def earliest_nonce(self) -> int:
+        raw = self.store.get(EARLIEST_NONCE_KEY)
+        return int.from_bytes(raw, "big") if raw else 0
+
+    def data_commitment_range_for_height(self, height: int) -> dict | None:
+        """The data commitment attestation whose [begin, end] range covers
+        height (ref: QueryDataCommitmentRangeForHeight, used by
+        client/verify.go:244)."""
+        for nonce in range(self.latest_nonce(), 0, -1):
+            att = self.get_attestation(nonce)
+            if (
+                att is not None
+                and att.get("type") == "data_commitment"
+                and att["begin_block"] <= height <= att["end_block"]
+            ):
+                return att
+        return None
+
+    def valset_request_before_nonce(self, nonce: int) -> dict | None:
+        """The last valset strictly before the given attestation nonce — the
+        set the contract holds when processing that attestation
+        (ref: QueryLatestValsetRequestBeforeNonce)."""
+        for n in range(min(nonce - 1, self.latest_nonce()), 0, -1):
+            att = self.get_attestation(n)
+            if att is not None and att.get("type") == "valset":
+                return att
+        return None
 
     # --- EndBlocker (ref: x/blobstream/abci.go:28-130) ---
 
