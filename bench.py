@@ -215,6 +215,48 @@ def bench_repair(k: int, erase_frac: float = 0.25):
     return {"host_ms": round(best * 1e3, 3), "recovered": bool(ok)}
 
 
+def bench_codec_service(k: int = 32):
+    """Codec service boundary (SURVEY P2): round-trip overhead of the
+    gRPC sidecar vs the same backend called in-process, measured on
+    ExtendAndRoot (roots-only reply keeps the response small the way a
+    production boundary would)."""
+    from celestia_tpu import da
+    from celestia_tpu.service import CodecClient, CodecServer
+
+    sq = build_square(k)
+    server = CodecServer(port=0, use_tpu=False)
+    server.start()
+    client = CodecClient(f"127.0.0.1:{server.port}")
+    try:
+        rows, _cols, dah = client.extend_and_root(sq)  # warm + parity
+        eds_ref = da.extend_shares(sq.reshape(k * k, 512))
+        dah_ref = da.new_data_availability_header(eds_ref)
+        parity = dah == dah_ref.hash() and rows == dah_ref.row_roots
+
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            client.extend_and_root(sq)
+            best = min(best, time.perf_counter() - t0)
+        service_ms = best * 1e3
+
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            server.backend.extend_and_root(k, 512, sq.tobytes())
+            best = min(best, time.perf_counter() - t0)
+        inproc_ms = best * 1e3
+    finally:
+        client.close()
+        server.stop()
+    return {
+        "service_ms": round(service_ms, 3),
+        "inprocess_ms": round(inproc_ms, 3),
+        "boundary_overhead_ms": round(service_ms - inproc_ms, 3),
+        "parity": bool(parity),
+    }
+
+
 def fetch_floor_ms():
     import jax
     import jax.numpy as jnp
@@ -240,6 +282,7 @@ def main():
     configs[f"3_headline_k{headline_k}"] = head
     configs["4_repair_k128_25pct"] = bench_repair(128)
     configs["5_nmt_only_k128"] = bench_nmt_only(128)
+    configs["6_codec_service_k32"] = bench_codec_service(32)
 
     for name, cfg in configs.items():
         if "parity" in cfg:

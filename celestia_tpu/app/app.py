@@ -34,8 +34,16 @@ from celestia_tpu.x.distribution import (
 from celestia_tpu.x.gov import GovKeeper, MsgDeposit, MsgSubmitProposal, MsgVote
 from celestia_tpu.x.mint import MintKeeper
 from celestia_tpu.x.paramfilter import apply_param_changes
+from celestia_tpu.x.ibc import MsgAcknowledgement, MsgRecvPacket, MsgTimeout
 from celestia_tpu.x.slashing import MsgUnjail, SlashingKeeper
 from celestia_tpu.x.staking import MsgDelegate, MsgUndelegate, StakingKeeper
+from celestia_tpu.x.tokenfilter import TokenFilterMiddleware
+from celestia_tpu.x.transfer import (
+    PORT_ID_TRANSFER,
+    MsgTransfer,
+    TransferIBCModule,
+    TransferKeeper,
+)
 from celestia_tpu.x.upgrade import MsgVersionChange, UpgradeKeeper
 
 from .ante import AnteHandler
@@ -80,6 +88,10 @@ class App:
         self.gov = GovKeeper(self.store, self.bank, self.staking)
         self.distribution = DistributionKeeper(self.store, self.bank, self.staking)
         self.slashing = SlashingKeeper(self.store, self.staking)
+        # transfer stack, top to bottom: tokenfilter -> transfer
+        # (ref: app/app.go:380-385)
+        self.transfer = TransferKeeper(self.store, self.bank)
+        self.ibc = self.transfer.channels
         self.upgrade = UpgradeKeeper(upgrade_schedule or {})
         self.height = 0
         self.block_time = 0.0
@@ -106,6 +118,8 @@ class App:
         self.gov = GovKeeper(store, self.bank, self.staking)
         self.distribution = DistributionKeeper(store, self.bank, self.staking)
         self.slashing = SlashingKeeper(store, self.staking)
+        self.transfer = TransferKeeper(store, self.bank)
+        self.ibc = self.transfer.channels
         self._deliver_store = None
         self._deliver_ctx = None
         self._check_store = None
@@ -472,8 +486,44 @@ class App:
             staking = StakingKeeper(ctx.store, bank)
             staking.hooks.append(BlobstreamKeeper(ctx.store, staking))
             SlashingKeeper(ctx.store, staking).unjail(ctx, msg.validator_address)
+        elif isinstance(msg, MsgTransfer):
+            TransferKeeper(ctx.store, BankKeeper(ctx.store)).send_transfer(
+                ctx, msg.source_port, msg.source_channel, msg.denom,
+                msg.amount, msg.sender, msg.receiver,
+                msg.timeout_timestamp, msg.memo,
+            )
+        elif isinstance(msg, MsgRecvPacket):
+            self._handle_recv_packet(ctx, msg)
+        elif isinstance(msg, MsgAcknowledgement):
+            transfer = TransferKeeper(ctx.store, BankKeeper(ctx.store))
+            transfer.channels.require_relayer(msg.signer)
+            self._transfer_stack(transfer).on_acknowledgement_packet(
+                ctx, msg.packet, msg.acknowledgement
+            )
+        elif isinstance(msg, MsgTimeout):
+            transfer = TransferKeeper(ctx.store, BankKeeper(ctx.store))
+            transfer.channels.require_relayer(msg.signer)
+            self._transfer_stack(transfer).on_timeout_packet(ctx, msg.packet)
         else:
             raise ValueError(f"unroutable message type {type(msg).__name__}")
+
+    @staticmethod
+    def _transfer_stack(transfer: TransferKeeper) -> TokenFilterMiddleware:
+        """tokenfilter over transfer (ref: app/app.go:380-385)."""
+        return TokenFilterMiddleware(TransferIBCModule(transfer))
+
+    def _handle_recv_packet(self, ctx: Context, msg: MsgRecvPacket) -> None:
+        """04-channel RecvPacket: receipt + app callback + written ack.
+        An error ack is NOT a tx failure — state effects of the receipt
+        and ack persist, only the app-level transfer is refused."""
+        packet = msg.packet
+        if packet.destination_port != PORT_ID_TRANSFER:
+            raise ValueError(f"no app bound to port {packet.destination_port}")
+        transfer = TransferKeeper(ctx.store, BankKeeper(ctx.store))
+        transfer.channels.require_relayer(msg.signer)
+        transfer.channels.recv_packet(packet, ctx.block_time)
+        ack = self._transfer_stack(transfer).on_recv_packet(ctx, packet)
+        transfer.channels.write_acknowledgement(packet, ack)
 
     def _gov_keeper(self, ctx) -> GovKeeper:
         bank = BankKeeper(ctx.store)

@@ -1,0 +1,6 @@
+"""Codec service boundary (SURVEY P2): gRPC sidecar exposing the TPU
+codec behind rsmt2d-Codec-shaped RPCs. See tpu_codec.proto."""
+
+from celestia_tpu.service.codec_service import CodecClient, CodecServer
+
+__all__ = ["CodecClient", "CodecServer"]

@@ -1,0 +1,257 @@
+"""TpuCodec gRPC sidecar — the codec service boundary (SURVEY §7 P2).
+
+Serves Encode / ExtendAndRoot / Roots / Repair over whole squares so a Go
+node can plug the TPU codec behind rsmt2d's pluggable `Codec` interface
+(reference: pkg/da/data_availability_header.go:65-75,
+pkg/appconsts/global_consts.go DefaultCodec) by generating a client from
+service/tpu_codec.proto and dialing this server.
+
+Backend order mirrors App._extend_and_hash: TPU (jax) > native C++ >
+numpy reference — all byte-identical (the contract tests pin the DAH
+through the service against the in-process path, and bench.py reports
+the service round-trip overhead so the boundary's latency budget is an
+explicit number, not a hope).
+
+Run standalone:  python -m celestia_tpu.service.codec_service [--port N]
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+
+import grpc
+import numpy as np
+
+from celestia_tpu.appconsts import SHARE_SIZE
+from celestia_tpu.service import wire
+
+SERVICE_NAME = "celestia_tpu.codec.v1.TpuCodec"
+
+log = logging.getLogger("celestia_tpu.codec_service")
+
+
+class CodecBackend:
+    """Dispatches to the fastest available implementation."""
+
+    def __init__(self, use_tpu: bool | None = None):
+        if use_tpu is None:
+            use_tpu = self._tpu_available()
+        self.use_tpu = use_tpu
+
+    @staticmethod
+    def _tpu_available() -> bool:
+        try:
+            import jax
+
+            return any(d.platform != "cpu" for d in jax.devices())
+        except Exception:  # noqa: BLE001 — no jax/device = host backends
+            return False
+
+    def _to_array(self, shares: bytes, width: int, share_size: int) -> np.ndarray:
+        expect = width * width * share_size
+        if len(shares) != expect:
+            raise ValueError(
+                f"share buffer is {len(shares)} bytes, expected {expect} "
+                f"({width}x{width}x{share_size})"
+            )
+        return np.frombuffer(shares, dtype=np.uint8).reshape(
+            width, width, share_size
+        )
+
+    def encode(self, k: int, share_size: int, shares: bytes) -> bytes:
+        arr = self._to_array(shares, k, share_size)
+        if self.use_tpu and share_size == SHARE_SIZE:
+            from celestia_tpu.ops import extend_tpu
+
+            eds, _rows, _cols = extend_tpu.extend_roots_device(arr)
+            return eds.tobytes()
+        from celestia_tpu import da
+
+        eds = da.extend_shares(arr.reshape(k * k, share_size))
+        return np.asarray(eds.data, dtype=np.uint8).tobytes()
+
+    def extend_and_root(self, k: int, share_size: int, shares: bytes):
+        arr = self._to_array(shares, k, share_size)
+        if self.use_tpu and share_size == SHARE_SIZE:
+            from celestia_tpu.ops import extend_tpu
+
+            _eds, rows, cols = extend_tpu.extend_roots_device(arr)
+            row_roots = [r.tobytes() for r in rows]
+            col_roots = [c.tobytes() for c in cols]
+        else:
+            from celestia_tpu import da
+
+            eds = da.extend_shares(arr.reshape(k * k, share_size))
+            row_roots, col_roots = eds.row_roots(), eds.col_roots()
+        from celestia_tpu.ops.nmt_host import merkle_root
+
+        dah = merkle_root(row_roots + col_roots)
+        return row_roots, col_roots, dah
+
+    def roots(self, k: int, share_size: int, eds_bytes: bytes):
+        from celestia_tpu import da
+        from celestia_tpu.ops.nmt_host import merkle_root
+
+        arr = self._to_array(eds_bytes, 2 * k, share_size)
+        eds = da.ExtendedDataSquare(np.array(arr), k)
+        row_roots, col_roots = eds.row_roots(), eds.col_roots()
+        return row_roots, col_roots, merkle_root(row_roots + col_roots)
+
+    def repair(self, k: int, share_size: int, eds_bytes: bytes,
+               present: bytes) -> bytes:
+        from celestia_tpu.da.repair import repair
+
+        arr = self._to_array(eds_bytes, 2 * k, share_size)
+        mask = np.frombuffer(present, dtype=np.uint8).reshape(2 * k, 2 * k) != 0
+        return repair(arr, mask).tobytes()
+
+
+def _handler(fn, req_cls, resp_marshal):
+    def handle(request_bytes, context):
+        try:
+            return resp_marshal(fn(req_cls.unmarshal(request_bytes)))
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except Exception as e:  # noqa: BLE001 — surfaced as INTERNAL
+            log.exception("codec RPC failed")
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    return grpc.unary_unary_rpc_method_handler(
+        handle,
+        request_deserializer=lambda b: b,  # raw; decoded inside for abort()
+        response_serializer=lambda b: b,
+    )
+
+
+class CodecServer:
+    def __init__(self, port: int = 0, use_tpu: bool | None = None,
+                 max_workers: int = 4):
+        self.backend = CodecBackend(use_tpu)
+        # squares are large: k=128 EDS is 32 MiB — lift the 4 MiB default
+        opts = [
+            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+            ("grpc.max_send_message_length", 64 * 1024 * 1024),
+        ]
+        self.server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=opts,
+        )
+        self.server.add_generic_rpc_handlers((self._service_handler(),))
+        self.port = self.server.add_insecure_port(f"127.0.0.1:{port}")
+
+    def _service_handler(self):
+        b = self.backend
+
+        def encode(req: wire.EncodeRequest) -> bytes:
+            return wire.EdsResponse(b.encode(req.k, req.share_size, req.shares)).marshal()
+
+        def extend_and_root(req: wire.EncodeRequest) -> bytes:
+            rows, cols, dah = b.extend_and_root(req.k, req.share_size, req.shares)
+            return wire.RootsResponse(rows, cols, dah).marshal()
+
+        def roots(req: wire.EdsRequest) -> bytes:
+            rows, cols, dah = b.roots(req.k, req.share_size, req.eds)
+            return wire.RootsResponse(rows, cols, dah).marshal()
+
+        def repair(req: wire.RepairRequest) -> bytes:
+            return wire.EdsResponse(
+                b.repair(req.k, req.share_size, req.eds, req.present)
+            ).marshal()
+
+        handlers = {
+            "Encode": _handler(encode, wire.EncodeRequest, lambda x: x),
+            "ExtendAndRoot": _handler(extend_and_root, wire.EncodeRequest, lambda x: x),
+            "Roots": _handler(roots, wire.EdsRequest, lambda x: x),
+            "Repair": _handler(repair, wire.RepairRequest, lambda x: x),
+        }
+        return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self, grace: float = 0.5) -> None:
+        self.server.stop(grace)
+
+
+class CodecClient:
+    """Python client over the same hand-rolled codecs (a Go client uses
+    protoc-generated stubs from tpu_codec.proto instead)."""
+
+    def __init__(self, target: str):
+        opts = [
+            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+            ("grpc.max_send_message_length", 64 * 1024 * 1024),
+        ]
+        self.channel = grpc.insecure_channel(target, options=opts)
+
+    def _call(self, method: str, request_bytes: bytes) -> bytes:
+        fn = self.channel.unary_unary(
+            f"/{SERVICE_NAME}/{method}",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        return fn(request_bytes)
+
+    def encode(self, shares: np.ndarray) -> np.ndarray:
+        k, _, share_size = shares.shape
+        req = wire.EncodeRequest(k, share_size, np.ascontiguousarray(shares).tobytes())
+        resp = wire.EdsResponse.unmarshal(self._call("Encode", req.marshal()))
+        return np.frombuffer(resp.eds, dtype=np.uint8).reshape(
+            2 * k, 2 * k, share_size
+        )
+
+    def extend_and_root(self, shares: np.ndarray):
+        k, _, share_size = shares.shape
+        req = wire.EncodeRequest(k, share_size, np.ascontiguousarray(shares).tobytes())
+        resp = wire.RootsResponse.unmarshal(
+            self._call("ExtendAndRoot", req.marshal())
+        )
+        return resp.row_roots, resp.col_roots, resp.dah_hash
+
+    def roots(self, eds: np.ndarray):
+        width, _, share_size = eds.shape
+        req = wire.EdsRequest(width // 2, share_size,
+                              np.ascontiguousarray(eds).tobytes())
+        resp = wire.RootsResponse.unmarshal(self._call("Roots", req.marshal()))
+        return resp.row_roots, resp.col_roots, resp.dah_hash
+
+    def repair(self, eds: np.ndarray, present: np.ndarray) -> np.ndarray:
+        width, _, share_size = eds.shape
+        req = wire.RepairRequest(
+            width // 2, share_size,
+            np.ascontiguousarray(eds).tobytes(),
+            np.ascontiguousarray(present.astype(np.uint8)).tobytes(),
+        )
+        resp = wire.EdsResponse.unmarshal(self._call("Repair", req.marshal()))
+        return np.frombuffer(resp.eds, dtype=np.uint8).reshape(
+            width, width, share_size
+        )
+
+    def close(self) -> None:
+        self.channel.close()
+
+
+def main(argv=None):
+    import argparse
+    import time
+
+    parser = argparse.ArgumentParser(prog="tpu-codec-service")
+    parser.add_argument("--port", type=int, default=9090)
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the host backend (no TPU)")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = CodecServer(port=args.port, use_tpu=False if args.cpu else None)
+    server.start()
+    log.info("TpuCodec service listening on 127.0.0.1:%d (tpu=%s)",
+             server.port, server.backend.use_tpu)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,450 @@
+"""IBC core subset — channels, packets, commitments, acknowledgements.
+
+The reference wires ibc-go v6 core (app/app.go:137-157 ModuleBasics,
+transfer stack app/app.go:370-385). This module provides the channel/
+packet substrate that the ICS-20 transfer app (x/transfer.py) and the
+tokenfilter middleware (x/tokenfilter.py) run on:
+
+- channel registry (04-channel subset: OPEN channels with counterparties;
+  the handshake itself is out of scope — test networks open channel pairs
+  directly, the way ibctesting's coordinator does)
+- send path: monotonic per-channel send sequences + packet commitments
+  (sha256 of the packet's deterministic encoding)
+- receive path: packet receipts for replay protection + written
+  acknowledgements
+- ack path: sender-side commitment verification + deletion on
+  acknowledgement, with the ack routed back to the sending application
+
+Light-client header verification is consciously absent (the reference
+gets it from 02-client/tendermint): a relayer here is trusted to carry
+bytes between in-process chains, which is exactly the boundary
+test/util/testnode's ibctesting setup exercises. That trust is ENFORCED,
+not assumed: packet-bearing messages (MsgRecvPacket / MsgAcknowledgement
+/ MsgTimeout) are only accepted from relayer accounts registered in the
+channel keeper (register_relayer) — the stand-in for ibc-go's
+commitment-proof verification, without which any funded account could
+forge packets against the transfer escrow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+
+CHANNEL_PREFIX = b"ibc/channel/"
+NEXT_SEQUENCE_SEND_PREFIX = b"ibc/nextSequenceSend/"
+COMMITMENT_PREFIX = b"ibc/commitment/"
+RECEIPT_PREFIX = b"ibc/receipt/"
+ACK_PREFIX = b"ibc/ack/"
+PACKET_PREFIX = b"ibc/packet/"  # full packet JSON, for relayers/queries
+RELAYER_PREFIX = b"ibc/relayer/"  # authorized relayer accounts
+
+CHANNEL_STATE_OPEN = "OPEN"
+CHANNEL_STATE_CLOSED = "CLOSED"
+
+
+@dataclasses.dataclass
+class Channel:
+    port_id: str
+    channel_id: str
+    counterparty_port_id: str
+    counterparty_channel_id: str
+    state: str = CHANNEL_STATE_OPEN
+
+    def marshal(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True).encode()
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "Channel":
+        return cls(**json.loads(raw))
+
+
+@dataclasses.dataclass
+class Packet:
+    """04-channel Packet. data is the app-level payload (ICS-20 uses the
+    JSON FungibleTokenPacketData encoding)."""
+
+    sequence: int
+    source_port: str
+    source_channel: str
+    destination_port: str
+    destination_channel: str
+    data: bytes
+    timeout_timestamp: float = 0.0  # 0 = no timeout
+
+    def commitment(self) -> bytes:
+        """sha256 over the deterministic encoding (04-channel commits to
+        sha256(timeout ‖ data hash) — same fixpoint: commitment binds the
+        packet content and timeout)."""
+        payload = json.dumps(
+            {
+                "sequence": self.sequence,
+                "source_port": self.source_port,
+                "source_channel": self.source_channel,
+                "destination_port": self.destination_port,
+                "destination_channel": self.destination_channel,
+                "data": self.data.hex(),
+                "timeout_timestamp": self.timeout_timestamp,
+            },
+            sort_keys=True,
+        ).encode()
+        return hashlib.sha256(payload).digest()
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["data"] = self.data.hex()
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Packet":
+        d = dict(d)
+        d["data"] = bytes.fromhex(d["data"])
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class Acknowledgement:
+    """ICS-20 style result/error ack (channeltypes.Acknowledgement)."""
+
+    success: bool
+    result: bytes = b"\x01"
+    error: str = ""
+
+    def marshal(self) -> bytes:
+        if self.success:
+            return json.dumps({"result": self.result.hex()}).encode()
+        return json.dumps({"error": self.error}).encode()
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "Acknowledgement":
+        d = json.loads(raw)
+        if "error" in d:
+            return cls(success=False, error=d["error"])
+        return cls(success=True, result=bytes.fromhex(d.get("result", "01")))
+
+
+URL_MSG_RECV_PACKET = "/ibc.core.channel.v1.MsgRecvPacket"
+URL_MSG_ACKNOWLEDGEMENT = "/ibc.core.channel.v1.MsgAcknowledgement"
+URL_MSG_TIMEOUT = "/ibc.core.channel.v1.MsgTimeout"
+
+
+def _register_packet_msgs():
+    from celestia_tpu.blob import _field_bytes, _parse_fields, _require_wt
+    from celestia_tpu.tx import register_msg
+
+    @register_msg(URL_MSG_RECV_PACKET)
+    @dataclasses.dataclass
+    class MsgRecvPacket:
+        """Relayer-submitted packet delivery (04-channel MsgRecvPacket)."""
+
+        packet: Packet
+        signer: str  # the relayer
+
+        def get_signers(self) -> list[str]:
+            return [self.signer]
+
+        def marshal(self) -> bytes:
+            return _field_bytes(
+                1, json.dumps(self.packet.to_json(), sort_keys=True).encode()
+            ) + _field_bytes(2, self.signer.encode())
+
+        @classmethod
+        def unmarshal(cls, raw: bytes) -> "MsgRecvPacket":
+            packet, signer = None, ""
+            for tag, wt, val in _parse_fields(raw):
+                if tag == 1:
+                    _require_wt(wt, 2, tag)
+                    packet = Packet.from_json(json.loads(bytes(val)))
+                elif tag == 2:
+                    _require_wt(wt, 2, tag)
+                    signer = bytes(val).decode()
+            if packet is None:
+                raise ValueError("MsgRecvPacket without packet")
+            return cls(packet, signer)
+
+        def validate_basic(self) -> None:
+            if not self.signer:
+                raise ValueError("missing relayer signer")
+
+    @register_msg(URL_MSG_ACKNOWLEDGEMENT)
+    @dataclasses.dataclass
+    class MsgAcknowledgement:
+        """Relayer-submitted ack delivery (04-channel MsgAcknowledgement)."""
+
+        packet: Packet
+        acknowledgement: Acknowledgement
+        signer: str
+
+        def get_signers(self) -> list[str]:
+            return [self.signer]
+
+        def marshal(self) -> bytes:
+            return (
+                _field_bytes(
+                    1, json.dumps(self.packet.to_json(), sort_keys=True).encode()
+                )
+                + _field_bytes(2, self.acknowledgement.marshal())
+                + _field_bytes(3, self.signer.encode())
+            )
+
+        @classmethod
+        def unmarshal(cls, raw: bytes) -> "MsgAcknowledgement":
+            packet, ack, signer = None, None, ""
+            for tag, wt, val in _parse_fields(raw):
+                if tag == 1:
+                    _require_wt(wt, 2, tag)
+                    packet = Packet.from_json(json.loads(bytes(val)))
+                elif tag == 2:
+                    _require_wt(wt, 2, tag)
+                    ack = Acknowledgement.unmarshal(bytes(val))
+                elif tag == 3:
+                    _require_wt(wt, 2, tag)
+                    signer = bytes(val).decode()
+            if packet is None or ack is None:
+                raise ValueError("MsgAcknowledgement missing packet/ack")
+            return cls(packet, ack, signer)
+
+        def validate_basic(self) -> None:
+            if not self.signer:
+                raise ValueError("missing relayer signer")
+
+    @register_msg(URL_MSG_TIMEOUT)
+    @dataclasses.dataclass
+    class MsgTimeout:
+        """Relayer-submitted timeout (04-channel MsgTimeout). In ibc-go the
+        relayer proves non-receipt on the counterparty via the light
+        client; under this substrate's trusted-relayer model the sending
+        chain checks only that the timeout has objectively elapsed
+        (its own block time) before refunding."""
+
+        packet: Packet
+        signer: str
+
+        def get_signers(self) -> list[str]:
+            return [self.signer]
+
+        def marshal(self) -> bytes:
+            return _field_bytes(
+                1, json.dumps(self.packet.to_json(), sort_keys=True).encode()
+            ) + _field_bytes(2, self.signer.encode())
+
+        @classmethod
+        def unmarshal(cls, raw: bytes) -> "MsgTimeout":
+            packet, signer = None, ""
+            for tag, wt, val in _parse_fields(raw):
+                if tag == 1:
+                    _require_wt(wt, 2, tag)
+                    packet = Packet.from_json(json.loads(bytes(val)))
+                elif tag == 2:
+                    _require_wt(wt, 2, tag)
+                    signer = bytes(val).decode()
+            if packet is None:
+                raise ValueError("MsgTimeout without packet")
+            return cls(packet, signer)
+
+        def validate_basic(self) -> None:
+            if not self.signer:
+                raise ValueError("missing relayer signer")
+            if not self.packet.timeout_timestamp:
+                raise ValueError("packet has no timeout to elapse")
+
+    return MsgRecvPacket, MsgAcknowledgement, MsgTimeout
+
+
+MsgRecvPacket, MsgAcknowledgement, MsgTimeout = _register_packet_msgs()
+
+
+def _chan_key(prefix: bytes, port_id: str, channel_id: str) -> bytes:
+    return prefix + port_id.encode() + b"/" + channel_id.encode()
+
+
+def _seq_key(prefix: bytes, port_id: str, channel_id: str, seq: int) -> bytes:
+    return _chan_key(prefix, port_id, channel_id) + b"/" + seq.to_bytes(8, "big")
+
+
+class ChannelKeeper:
+    """04-channel keeper subset over the framework store."""
+
+    def __init__(self, store):
+        self.store = store
+
+    # --- channel registry ---
+
+    def set_channel(self, channel: Channel) -> None:
+        self.store.set(
+            _chan_key(CHANNEL_PREFIX, channel.port_id, channel.channel_id),
+            channel.marshal(),
+        )
+
+    def get_channel(self, port_id: str, channel_id: str) -> Channel | None:
+        raw = self.store.get(_chan_key(CHANNEL_PREFIX, port_id, channel_id))
+        return Channel.unmarshal(raw) if raw else None
+
+    def open_channel(
+        self,
+        port_id: str,
+        channel_id: str,
+        counterparty_port_id: str,
+        counterparty_channel_id: str,
+    ) -> Channel:
+        """Direct OPEN (the post-handshake state ibctesting coordinators
+        drive the four-step handshake to)."""
+        ch = Channel(port_id, channel_id, counterparty_port_id, counterparty_channel_id)
+        self.set_channel(ch)
+        return ch
+
+    # --- relayer authorization (stand-in for commitment proofs) ---
+
+    def register_relayer(self, address: str) -> None:
+        self.store.set(RELAYER_PREFIX + address.encode(), b"\x01")
+
+    def is_relayer(self, address: str) -> bool:
+        return self.store.get(RELAYER_PREFIX + address.encode()) is not None
+
+    def require_relayer(self, address: str) -> None:
+        if not self.is_relayer(address):
+            raise ValueError(
+                f"{address} is not a registered relayer: packet messages "
+                "carry no commitment proof in this substrate, so only "
+                "registered relayer accounts may deliver them"
+            )
+
+    # --- send path ---
+
+    def next_sequence_send(self, port_id: str, channel_id: str) -> int:
+        raw = self.store.get(_chan_key(NEXT_SEQUENCE_SEND_PREFIX, port_id, channel_id))
+        return int.from_bytes(raw, "big") if raw else 1
+
+    def send_packet(
+        self,
+        port_id: str,
+        channel_id: str,
+        data: bytes,
+        timeout_timestamp: float = 0.0,
+    ) -> Packet:
+        ch = self.get_channel(port_id, channel_id)
+        if ch is None or ch.state != CHANNEL_STATE_OPEN:
+            raise ValueError(f"channel {port_id}/{channel_id} is not open")
+        seq = self.next_sequence_send(port_id, channel_id)
+        packet = Packet(
+            sequence=seq,
+            source_port=port_id,
+            source_channel=channel_id,
+            destination_port=ch.counterparty_port_id,
+            destination_channel=ch.counterparty_channel_id,
+            data=data,
+            timeout_timestamp=timeout_timestamp,
+        )
+        self.store.set(
+            _chan_key(NEXT_SEQUENCE_SEND_PREFIX, port_id, channel_id),
+            (seq + 1).to_bytes(8, "big"),
+        )
+        self.store.set(
+            _seq_key(COMMITMENT_PREFIX, port_id, channel_id, seq),
+            packet.commitment(),
+        )
+        self.store.set(
+            _seq_key(PACKET_PREFIX, port_id, channel_id, seq),
+            json.dumps(packet.to_json(), sort_keys=True).encode(),
+        )
+        return packet
+
+    def get_packet(self, port_id: str, channel_id: str, seq: int) -> Packet | None:
+        raw = self.store.get(_seq_key(PACKET_PREFIX, port_id, channel_id, seq))
+        return Packet.from_json(json.loads(raw)) if raw else None
+
+    def pending_packets(self, port_id: str, channel_id: str) -> list[Packet]:
+        """Packets sent on this channel whose commitments still stand
+        (i.e. not yet acknowledged) — the relayer work queue."""
+        out = []
+        prefix = _chan_key(COMMITMENT_PREFIX, port_id, channel_id) + b"/"
+        for key, _v in self.store.iter_prefix(prefix):
+            seq = int.from_bytes(key[len(prefix):], "big")
+            packet = self.get_packet(port_id, channel_id, seq)
+            if packet is not None:
+                out.append(packet)
+        return out
+
+    # --- receive path (destination chain) ---
+
+    def recv_packet(self, packet: Packet, block_time: float = 0.0) -> None:
+        """Replay protection + receipt + timeout enforcement (04-channel
+        RecvPacket checks)."""
+        if packet.timeout_timestamp and block_time >= packet.timeout_timestamp:
+            raise ValueError(
+                f"packet timeout elapsed: timeout {packet.timeout_timestamp}, "
+                f"block time {block_time}"
+            )
+        ch = self.get_channel(packet.destination_port, packet.destination_channel)
+        if ch is None or ch.state != CHANNEL_STATE_OPEN:
+            raise ValueError(
+                f"channel {packet.destination_port}/{packet.destination_channel} "
+                "is not open"
+            )
+        if (
+            ch.counterparty_port_id != packet.source_port
+            or ch.counterparty_channel_id != packet.source_channel
+        ):
+            raise ValueError("packet source does not match channel counterparty")
+        receipt_key = _seq_key(
+            RECEIPT_PREFIX,
+            packet.destination_port,
+            packet.destination_channel,
+            packet.sequence,
+        )
+        if self.store.get(receipt_key) is not None:
+            raise ValueError(f"packet sequence {packet.sequence} already received")
+        self.store.set(receipt_key, b"\x01")
+
+    def write_acknowledgement(self, packet: Packet, ack: Acknowledgement) -> None:
+        self.store.set(
+            _seq_key(
+                ACK_PREFIX,
+                packet.destination_port,
+                packet.destination_channel,
+                packet.sequence,
+            ),
+            ack.marshal(),
+        )
+
+    def get_acknowledgement(
+        self, port_id: str, channel_id: str, seq: int
+    ) -> Acknowledgement | None:
+        raw = self.store.get(_seq_key(ACK_PREFIX, port_id, channel_id, seq))
+        return Acknowledgement.unmarshal(raw) if raw else None
+
+    # --- acknowledgement / timeout path (source chain) ---
+
+    def acknowledge_packet(self, packet: Packet) -> None:
+        """Verify the commitment still stands and clear it."""
+        key = _seq_key(
+            COMMITMENT_PREFIX, packet.source_port, packet.source_channel,
+            packet.sequence,
+        )
+        stored = self.store.get(key)
+        if stored is None:
+            raise ValueError(
+                f"packet {packet.sequence} has no commitment (already acked?)"
+            )
+        if stored != packet.commitment():
+            raise ValueError("packet commitment mismatch")
+        self.store.delete(key)
+        self.store.delete(
+            _seq_key(PACKET_PREFIX, packet.source_port, packet.source_channel,
+                     packet.sequence)
+        )
+
+    def timeout_packet(self, packet: Packet, block_time: float) -> None:
+        """04-channel TimeoutPacket: the timeout must have objectively
+        elapsed (the sending chain's clock) before the commitment is
+        cleared for refund. Lives here — not in the msg router — so no
+        keeper-level caller can refund early."""
+        if not packet.timeout_timestamp:
+            raise ValueError("packet has no timeout to elapse")
+        if block_time < packet.timeout_timestamp:
+            raise ValueError(
+                f"packet timeout has not elapsed: timeout "
+                f"{packet.timeout_timestamp}, block time {block_time}"
+            )
+        self.acknowledge_packet(packet)

@@ -1,0 +1,130 @@
+"""Codec service boundary contract tests (VERDICT r1 item 5, SURVEY §7
+P2): byte-identical DAH through the live gRPC service, repair through the
+service, wire-codec round-trips, and the measured round-trip overhead."""
+
+import time
+
+import numpy as np
+import pytest
+
+from celestia_tpu import da
+from celestia_tpu import namespace as ns
+from celestia_tpu.appconsts import SHARE_SIZE
+from celestia_tpu.service import CodecClient, CodecServer
+from celestia_tpu.service import wire
+
+
+def make_shares(k: int, seed: int = 7) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    nsb = ns.new_namespace(0, bytes(18) + b"\x01" * 10).bytes
+    shares = rng.integers(0, 256, size=(k * k, SHARE_SIZE), dtype=np.uint8)
+    for i in range(k * k):
+        shares[i, : len(nsb)] = np.frombuffer(nsb, dtype=np.uint8)
+    return shares.reshape(k, k, SHARE_SIZE)
+
+
+@pytest.fixture(scope="module")
+def service():
+    server = CodecServer(port=0, use_tpu=False)  # host backend on CI mesh
+    server.start()
+    client = CodecClient(f"127.0.0.1:{server.port}")
+    yield client
+    client.close()
+    server.stop()
+
+
+class TestWireCodecs:
+    def test_encode_request_round_trip(self):
+        req = wire.EncodeRequest(4, 512, b"\x01\x02\x03")
+        assert wire.EncodeRequest.unmarshal(req.marshal()) == req
+
+    def test_roots_response_round_trip(self):
+        resp = wire.RootsResponse([b"r" * 90, b"s" * 90], [b"c" * 90], b"d" * 32)
+        assert wire.RootsResponse.unmarshal(resp.marshal()) == resp
+
+    def test_repair_request_round_trip(self):
+        req = wire.RepairRequest(2, 512, b"\xaa" * 16, b"\x01\x00" * 8)
+        assert wire.RepairRequest.unmarshal(req.marshal()) == req
+
+    def test_proto3_zero_scalars_omitted(self):
+        assert wire.EncodeRequest(0, 0, b"").marshal() == b""
+
+    def test_wire_matches_protoc_semantics(self):
+        """Field layout check against hand-computed proto3 bytes."""
+        raw = wire.EncodeRequest(3, 2, b"\xff").marshal()
+        # field1 varint 3: 08 03; field2 varint 2: 10 02; field3 len 1: 1a 01 ff
+        assert raw == bytes([0x08, 0x03, 0x10, 0x02, 0x1A, 0x01, 0xFF])
+
+
+class TestServiceContract:
+    @pytest.mark.parametrize("k", [2, 8])
+    def test_dah_byte_identical_through_service(self, service, k):
+        """The headline contract: DAH computed from service-returned roots
+        equals the in-process reference DAH bit-for-bit."""
+        shares = make_shares(k)
+        rows, cols, dah = service.extend_and_root(shares)
+
+        eds_ref = da.extend_shares(shares.reshape(k * k, SHARE_SIZE))
+        dah_ref = da.new_data_availability_header(eds_ref)
+        assert rows == dah_ref.row_roots
+        assert cols == dah_ref.column_roots
+        assert dah == dah_ref.hash()
+
+    def test_encode_matches_reference_eds(self, service):
+        k = 4
+        shares = make_shares(k)
+        eds = service.encode(shares)
+        eds_ref = da.extend_shares(shares.reshape(k * k, SHARE_SIZE))
+        assert eds.tobytes() == np.asarray(eds_ref.data, dtype=np.uint8).tobytes()
+
+    def test_roots_of_extended_square(self, service):
+        k = 4
+        shares = make_shares(k)
+        eds = service.encode(shares)
+        rows, cols, dah = service.roots(eds)
+        eds_ref = da.extend_shares(shares.reshape(k * k, SHARE_SIZE))
+        assert dah == da.new_data_availability_header(eds_ref).hash()
+        assert rows == eds_ref.row_roots()
+
+    def test_repair_through_service(self, service):
+        """BASELINE config 4 shape: erasures repaired through the boundary."""
+        k = 8
+        shares = make_shares(k)
+        eds = service.encode(shares)
+        rng = np.random.default_rng(3)
+        present = np.ones((2 * k, 2 * k), dtype=bool)
+        erased = rng.choice(4 * k * k, size=k * k, replace=False)  # 25%
+        present.flat[erased] = False
+        corrupted = eds.copy()
+        corrupted[~present] = 0
+        repaired = service.repair(corrupted, present)
+        assert repaired.tobytes() == eds.tobytes()
+
+    def test_invalid_share_buffer_rejected(self, service):
+        import grpc
+
+        with pytest.raises(grpc.RpcError) as exc_info:
+            service.extend_and_root(make_shares(2)[:, :1, :])  # wrong shape
+        assert exc_info.value.code().name == "INVALID_ARGUMENT"
+
+    def test_round_trip_overhead_reported(self, service):
+        """The boundary's latency budget: service call vs in-process call
+        on the same backend. Asserted loosely (the wire cost of a k=8
+        square is ~2 MiB round trip); the precise number lands in bench."""
+        k = 8
+        shares = make_shares(k)
+        service.extend_and_root(shares)  # warm
+        t0 = time.perf_counter()
+        service.extend_and_root(shares)
+        service_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        eds_ref = da.extend_shares(shares.reshape(k * k, SHARE_SIZE))
+        da.new_data_availability_header(eds_ref)
+        inproc_s = time.perf_counter() - t0
+
+        overhead = service_s - inproc_s
+        print(f"\nservice={service_s*1e3:.2f}ms in-process={inproc_s*1e3:.2f}ms "
+              f"overhead={overhead*1e3:.2f}ms")
+        # the boundary must not dominate: allow generous slack for CI noise
+        assert service_s < inproc_s * 3 + 0.5

@@ -1,0 +1,373 @@
+"""IBC substrate + tokenfilter middleware (VERDICT r1 item 7; ref:
+x/tokenfilter/ibc_middleware.go:22-50, transfer stack app/app.go:380-385,
+ibc-go ICS-20 escrow/voucher semantics)."""
+
+import pytest
+
+from celestia_tpu.app import App
+from celestia_tpu.crypto import PrivateKey
+from celestia_tpu.node import Node
+from celestia_tpu.testutil.ibc import Relayer, open_transfer_channel
+from celestia_tpu.user import Signer
+from celestia_tpu.x.ibc import (
+    Acknowledgement,
+    ChannelKeeper,
+    MsgRecvPacket,
+    Packet,
+)
+from celestia_tpu.x.tokenfilter import TokenFilterMiddleware
+from celestia_tpu.x.transfer import (
+    FungibleTokenPacketData,
+    MsgTransfer,
+    PORT_ID_TRANSFER,
+    TransferIBCModule,
+    TransferKeeper,
+    escrow_address,
+    receiver_chain_is_source,
+)
+
+ALICE = PrivateKey.from_secret(b"alice")
+BOB = PrivateKey.from_secret(b"bob")
+RELAYER_A = PrivateKey.from_secret(b"relayer-a")
+RELAYER_B = PrivateKey.from_secret(b"relayer-b")
+
+
+def new_chain(chain_id: str) -> Node:
+    app = App(chain_id=chain_id)
+    app.init_chain(
+        {
+            ALICE.bech32_address(): 1_000_000_000,
+            BOB.bech32_address(): 1_000_000_000,
+            RELAYER_A.bech32_address(): 1_000_000_000,
+            RELAYER_B.bech32_address(): 1_000_000_000,
+        },
+        genesis_time=0.0,
+    )
+    node = Node(app)
+    node.produce_block(15.0)
+    return node
+
+
+def mk_packet(data: FungibleTokenPacketData, seq: int = 1) -> Packet:
+    return Packet(
+        sequence=seq,
+        source_port="transfer",
+        source_channel="channel-0",
+        destination_port="transfer",
+        destination_channel="channel-0",
+        data=data.marshal(),
+    )
+
+
+class TestTokenFilterUnit:
+    """The middleware in isolation (reference's x/tokenfilter unit tests)."""
+
+    class _Recorder:
+        def __init__(self):
+            self.received = []
+
+        def on_recv_packet(self, ctx, packet):
+            self.received.append(packet)
+            return Acknowledgement(success=True)
+
+    def test_native_token_returning_passes_down(self):
+        inner = self._Recorder()
+        mw = TokenFilterMiddleware(inner)
+        pkt = mk_packet(
+            FungibleTokenPacketData("transfer/channel-0/utia", 100, "a", "b")
+        )
+        ack = mw.on_recv_packet(None, pkt)
+        assert ack.success
+        assert len(inner.received) == 1
+
+    def test_foreign_denom_rejected_with_error_ack(self):
+        inner = self._Recorder()
+        mw = TokenFilterMiddleware(inner)
+        pkt = mk_packet(FungibleTokenPacketData("uatom", 100, "a", "b"))
+        ack = mw.on_recv_packet(None, pkt)
+        assert not ack.success
+        assert "only native denom transfers accepted" in ack.error
+        assert inner.received == []  # never reaches the transfer app
+
+    def test_other_channel_voucher_rejected(self):
+        mw = TokenFilterMiddleware(self._Recorder())
+        pkt = mk_packet(
+            FungibleTokenPacketData("transfer/channel-9/utia", 100, "a", "b")
+        )
+        assert not mw.on_recv_packet(None, pkt).success
+
+    def test_undecodable_data_passes_down(self):
+        inner = self._Recorder()
+        mw = TokenFilterMiddleware(inner)
+        pkt = mk_packet(FungibleTokenPacketData("utia", 1, "a", "b"))
+        pkt.data = b"not json"
+        mw.on_recv_packet(None, pkt)
+        assert len(inner.received) == 1  # defensive pass-through
+
+    def test_non_object_json_passes_down(self):
+        """Valid JSON that is not transfer data (array / string / null
+        amount) must also pass down, not raise through the stack."""
+        inner = self._Recorder()
+        mw = TokenFilterMiddleware(inner)
+        for payload in (b"[1,2]", b'"x"', b'{"denom":"utia","amount":null,'
+                        b'"sender":"a","receiver":"b"}'):
+            pkt = mk_packet(FungibleTokenPacketData("utia", 1, "a", "b"))
+            pkt.data = payload
+            mw.on_recv_packet(None, pkt)
+        assert len(inner.received) == 3
+
+    def test_receiver_chain_is_source_predicate(self):
+        assert receiver_chain_is_source("transfer", "channel-0",
+                                        "transfer/channel-0/utia")
+        assert not receiver_chain_is_source("transfer", "channel-0", "utia")
+        assert not receiver_chain_is_source("transfer", "channel-0",
+                                            "transfer/channel-1/utia")
+
+
+class TestChannelKeeper:
+    def test_send_requires_open_channel(self):
+        from celestia_tpu.state import StateStore
+
+        ck = ChannelKeeper(StateStore())
+        with pytest.raises(ValueError, match="not open"):
+            ck.send_packet("transfer", "channel-0", b"{}")
+
+    def test_replay_protection(self):
+        from celestia_tpu.state import StateStore
+
+        store = StateStore()
+        ck = ChannelKeeper(store)
+        ck.open_channel("transfer", "channel-0", "transfer", "channel-0")
+        pkt = mk_packet(FungibleTokenPacketData("utia", 1, "a", "b"))
+        ck.recv_packet(pkt)
+        with pytest.raises(ValueError, match="already received"):
+            ck.recv_packet(pkt)
+
+    def test_ack_clears_commitment_once(self):
+        from celestia_tpu.state import StateStore
+
+        store = StateStore()
+        ck = ChannelKeeper(store)
+        ck.open_channel("transfer", "channel-0", "transfer", "channel-0")
+        pkt = ck.send_packet("transfer", "channel-0", b"{}")
+        assert len(ck.pending_packets("transfer", "channel-0")) == 1
+        ck.acknowledge_packet(pkt)
+        assert ck.pending_packets("transfer", "channel-0") == []
+        with pytest.raises(ValueError, match="no commitment"):
+            ck.acknowledge_packet(pkt)
+
+
+class TestTransferE2E:
+    """Two chains, the full tx pipeline, a relayer in between."""
+
+    def _setup(self):
+        node_a = new_chain("chain-a")
+        node_b = new_chain("chain-b")
+        open_transfer_channel(node_a.app, node_b.app)
+        relayer = Relayer(node_a, node_b, RELAYER_A, RELAYER_B)
+        return node_a, node_b, relayer
+
+    def test_native_round_trip(self):
+        """utia: A --escrow--> B mints voucher; B --burn--> A unescrows.
+        The tokenfilter on each side judges only inbound packets: the
+        voucher arriving on B is FOREIGN there... and is rejected. So the
+        canonical accepted flow on a tokenfilter chain is the reverse:
+        a voucher of OUR token coming home. This test builds that exact
+        state: A's utia escrowed out, then returned."""
+        node_a, node_b, relayer = self._setup()
+        alice, bob = ALICE.bech32_address(), BOB.bech32_address()
+
+        a_signer = Signer.setup_single(ALICE, node_a)
+        res = a_signer.submit_tx(
+            [MsgTransfer("transfer", "channel-0", "utia", 5_000, alice, bob)]
+        )
+        assert res.code == 0, res.log
+        node_a.produce_block(30.0)
+        # escrowed on A
+        esc = escrow_address("transfer", "channel-0")
+        assert node_a.app.bank.get_balance(esc) == 5_000
+
+        # chain B's tokenfilter rejects A's utia (foreign there) with an
+        # error ack; the relayer then delivers the refund to A
+        relayer.relay(45.0, 45.0)
+        assert node_a.app.bank.get_balance(esc) == 0  # refunded
+        assert node_a.app.bank.get_balance(alice) >= 1_000_000_000 - 100_000
+        # nothing minted on B
+        assert node_b.app.bank.get_balance(bob, "transfer/channel-0/utia") == 0
+
+    def test_voucher_coming_home_accepted(self):
+        """The accepted inbound flow: a voucher of A's native token
+        returning to A. Seed B with the voucher state directly (as if it
+        had been minted before tokenfilter was enabled — the reference's
+        'tokens routed through this chain will still be allowed to
+        unwrap' comment), send it home, and watch A unescrow."""
+        node_a, node_b, relayer = self._setup()
+        alice, bob = ALICE.bech32_address(), BOB.bech32_address()
+        esc = escrow_address("transfer", "channel-0")
+
+        # state as if A had escrowed 7k utia against a voucher held on B
+        node_a.app.bank.mint(esc, 7_000, "utia")
+        node_b.app.bank.mint(bob, 7_000, "transfer/channel-0/utia")
+        node_a.app.store.commit_hash_refresh()
+        node_b.app.store.commit_hash_refresh()
+
+        b_signer = Signer.setup_single(BOB, node_b)
+        res = b_signer.submit_tx(
+            [MsgTransfer("transfer", "channel-0", "transfer/channel-0/utia",
+                         7_000, bob, alice)]
+        )
+        assert res.code == 0, res.log
+        node_b.produce_block(30.0)
+        # voucher burned on B
+        assert node_b.app.bank.get_balance(bob, "transfer/channel-0/utia") == 0
+
+        before = node_a.app.bank.get_balance(alice)
+        relayer.relay(45.0, 45.0)
+        # A accepted the returning native token and unescrowed it
+        assert node_a.app.bank.get_balance(esc) == 0
+        assert node_a.app.bank.get_balance(alice) == before + 7_000
+        ack = node_a.app.ibc.get_acknowledgement("transfer", "channel-0", 1)
+        assert ack is not None and ack.success
+
+    def test_recv_packet_replay_rejected_via_tx(self):
+        node_a, node_b, relayer = self._setup()
+        alice, bob = ALICE.bech32_address(), BOB.bech32_address()
+        node_a.app.bank.mint(escrow_address("transfer", "channel-0"), 100, "utia")
+        node_b.app.bank.mint(bob, 100, "transfer/channel-0/utia")
+        node_a.app.store.commit_hash_refresh()
+        node_b.app.store.commit_hash_refresh()
+
+        b_signer = Signer.setup_single(BOB, node_b)
+        b_signer.submit_tx(
+            [MsgTransfer("transfer", "channel-0", "transfer/channel-0/utia",
+                         100, bob, alice)]
+        )
+        node_b.produce_block(30.0)
+        packet = node_b.app.ibc.pending_packets(PORT_ID_TRANSFER, "channel-0")[0]
+
+        a_relayer = Signer.setup_single(RELAYER_A, node_a)
+        assert a_relayer.submit_tx(
+            [MsgRecvPacket(packet, a_relayer.address())]
+        ).code == 0
+        node_a.produce_block(45.0)
+        # second delivery of the same sequence fails at CheckTx... no —
+        # CheckTx runs only the ante; the replay is caught at DeliverTx
+        res = a_relayer.submit_tx([MsgRecvPacket(packet, a_relayer.address())])
+        assert res.code == 0  # admitted to mempool (ante only)
+        block = node_a.produce_block(60.0)
+        assert block.tx_results[0].code != 0
+        assert "already received" in block.tx_results[0].log
+
+    def test_timeout_enforced_on_recv_and_refund_via_msg_timeout(self):
+        """A timed-out packet is rejected by the destination and the
+        sender refunds its escrow through MsgTimeout."""
+        from celestia_tpu.x.ibc import MsgTimeout
+
+        node_a, node_b, _relayer = self._setup()
+        alice, bob = ALICE.bech32_address(), BOB.bech32_address()
+
+        a_signer = Signer.setup_single(ALICE, node_a)
+        res = a_signer.submit_tx(
+            [MsgTransfer("transfer", "channel-0", "utia", 3_000, alice, bob,
+                         timeout_timestamp=40.0)]
+        )
+        assert res.code == 0, res.log
+        node_a.produce_block(30.0)
+        esc = escrow_address("transfer", "channel-0")
+        assert node_a.app.bank.get_balance(esc) == 3_000
+        packet = node_a.app.ibc.pending_packets(PORT_ID_TRANSFER, "channel-0")[0]
+
+        # destination block time is past the timeout: recv must fail
+        b_relayer = Signer.setup_single(RELAYER_B, node_b)
+        b_relayer.submit_tx([MsgRecvPacket(packet, b_relayer.address())])
+        block_b = node_b.produce_block(45.0)
+        assert block_b.tx_results[0].code != 0
+        assert "timeout elapsed" in block_b.tx_results[0].log
+
+        # sender refunds via MsgTimeout once its own clock passes the
+        # timeout; too-early attempts are rejected
+        a_relayer = Signer.setup_single(RELAYER_A, node_a)
+        a_relayer.submit_tx([MsgTimeout(packet, a_relayer.address())])
+        early = node_a.produce_block(35.0)
+        assert early.tx_results[0].code != 0
+        assert "not elapsed" in early.tx_results[0].log
+
+        before = node_a.app.bank.get_balance(alice)
+        a_relayer.submit_tx([MsgTimeout(packet, a_relayer.address())])
+        late = node_a.produce_block(50.0)
+        assert late.tx_results[0].code == 0, late.tx_results[0].log
+        assert node_a.app.bank.get_balance(esc) == 0
+        assert node_a.app.bank.get_balance(alice) == before + 3_000
+        # commitment cleared: a second timeout cannot double-refund
+        a_relayer.submit_tx([MsgTimeout(packet, a_relayer.address())])
+        again = node_a.produce_block(65.0)
+        assert again.tx_results[0].code != 0
+
+    def test_forged_packet_from_non_relayer_rejected(self):
+        """Without commitment proofs, packet messages are relayer-gated:
+        an arbitrary funded account cannot forge a MsgRecvPacket that
+        drains the escrow."""
+        node_a, _node_b, _relayer = self._setup()
+        alice = ALICE.bech32_address()
+        esc = escrow_address("transfer", "channel-0")
+        node_a.app.bank.mint(esc, 50_000, "utia")
+        node_a.app.store.commit_hash_refresh()
+
+        forged = mk_packet(
+            FungibleTokenPacketData("transfer/channel-0/utia", 50_000,
+                                    "attacker", alice),
+            seq=999,
+        )
+        attacker = Signer.setup_single(BOB, node_a)
+        attacker.submit_tx([MsgRecvPacket(forged, attacker.address())])
+        block = node_a.produce_block(60.0)
+        assert block.tx_results[0].code != 0
+        assert "not a registered relayer" in block.tx_results[0].log
+        assert node_a.app.bank.get_balance(esc) == 50_000  # untouched
+
+    def test_keeper_level_timeout_cannot_refund_early(self):
+        """The timeout check lives in the channel layer, not the msg
+        router: a direct keeper call cannot refund before expiry."""
+        from celestia_tpu.app.context import Context, ExecMode
+
+        node_a, _node_b, _relayer = self._setup()
+        alice, bob = ALICE.bech32_address(), BOB.bech32_address()
+        a_signer = Signer.setup_single(ALICE, node_a)
+        a_signer.submit_tx(
+            [MsgTransfer("transfer", "channel-0", "utia", 1_000, alice, bob,
+                         timeout_timestamp=100.0)]
+        )
+        node_a.produce_block(30.0)
+        packet = node_a.app.ibc.pending_packets(PORT_ID_TRANSFER, "channel-0")[0]
+        transfer = TransferKeeper(node_a.app.store, node_a.app.bank)
+        ctx = Context(store=node_a.app.store, chain_id="chain-a",
+                      block_height=3, block_time=50.0,
+                      app_version=1, mode=ExecMode.DELIVER)
+        with pytest.raises(ValueError, match="not elapsed"):
+            transfer.on_timeout_packet(ctx, packet)
+
+    def test_zero_amount_recv_rejected_with_error_ack(self):
+        node_a, _node_b, _relayer = self._setup()
+        transfer = TransferKeeper(node_a.app.store, node_a.app.bank)
+        stack = TokenFilterMiddleware(TransferIBCModule(transfer))
+        pkt = mk_packet(
+            FungibleTokenPacketData("transfer/channel-0/utia", 0, "a", "b")
+        )
+        ack = stack.on_recv_packet(None, pkt)
+        assert not ack.success
+        assert "amount must be positive" in ack.error
+
+    def test_foreign_denom_direct_keeper_paths(self):
+        """Keeper-level checks of mint/escrow bookkeeping."""
+        node_a, _node_b, _ = self._setup()
+        app = node_a.app
+        transfer = TransferKeeper(app.store, app.bank)
+        stack = TokenFilterMiddleware(TransferIBCModule(transfer))
+
+        # inbound foreign denom: rejected, no state change
+        pkt = mk_packet(FungibleTokenPacketData("uosmo", 50, "x",
+                                                ALICE.bech32_address()))
+        supply_before = app.bank.total_supply("transfer/channel-0/uosmo")
+        ack = stack.on_recv_packet(None, pkt)
+        assert not ack.success
+        assert app.bank.total_supply("transfer/channel-0/uosmo") == supply_before
