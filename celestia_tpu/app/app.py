@@ -547,6 +547,9 @@ class App:
                     {"id": p.id, "status": p.status, "log": p.fail_log}
                     for p in finished
                 ]
+            # staking EndBlocker after gov (reference order app/app.go:475-496:
+            # crisis, gov, staking, ...): matured unbonding payouts
+            staking.complete_unbondings(ctx)
             BlobstreamKeeper(store, staking).end_blocker(ctx)
         if self.upgrade.should_upgrade():
             result["app_version"] = self.upgrade.pending_app_version

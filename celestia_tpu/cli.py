@@ -78,9 +78,11 @@ def _build_node(home: pathlib.Path):
 
 
 def cmd_start(args):
+    from celestia_tpu import log as log_mod
     from celestia_tpu.config import load_config
     from celestia_tpu.node.rpc import RpcServer
 
+    log_mod.configure(args.log_level)
     home = _home(args)
     flag_overrides = {}
     if args.block_time is not None:
@@ -204,6 +206,8 @@ def main(argv=None):
     p_start = sub.add_parser("start")
     # None = "flag not passed" so config-file/env values aren't masked
     p_start.add_argument("--block-time", type=float, default=None)
+    p_start.add_argument("--log-level", default="info",
+                         choices=["debug", "info", "warning", "error"])
 
     p_export = sub.add_parser("export")
     p_export.add_argument("--for-zero-height", action="store_true")

@@ -1,0 +1,107 @@
+"""Structured logging — the cosmos-sdk/cometbft logger analogue.
+
+The reference threads a structured key-value logger (cometbft libs/log,
+`logger.Info("committed state", "height", h, "app_hash", hash)`) through
+the node and app. This module provides the same shape over stdlib
+logging: `logger(module)` returns a StructuredLogger whose info/debug/
+error take a message + key-value pairs and emit ONE JSON line per event
+(machine-parseable, the "structured logging story" SURVEY §5 calls for).
+
+Format:  {"ts": ..., "level": "info", "module": "node", "msg": ...,
+          "height": 42, "app_hash": "ab12..."}
+
+Quiet by default (WARNING); `configure(level)` turns it on — the CLI
+start command enables INFO.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+
+_ROOT = "celestia_tpu"
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        payload = {
+            "ts": round(record.created, 3),
+            "level": record.levelname.lower(),
+            "module": record.name.removeprefix(_ROOT + "."),
+            "msg": record.getMessage(),
+        }
+        payload.update(getattr(record, "kv", {}))
+        return json.dumps(payload, sort_keys=False, default=_coerce)
+
+
+def _coerce(value):
+    if isinstance(value, bytes):
+        return value.hex()
+    return str(value)
+
+
+class StructuredLogger:
+    """cometbft-style leveled kv logger: log.info("msg", height=1)."""
+
+    def __init__(self, module: str):
+        self._log = logging.getLogger(f"{_ROOT}.{module}")
+
+    def _emit(self, level: int, msg: str, kv: dict) -> None:
+        if self._log.isEnabledFor(level):
+            self._log.log(level, msg, extra={"kv": kv})
+
+    def debug(self, msg: str, **kv) -> None:
+        self._emit(logging.DEBUG, msg, kv)
+
+    def info(self, msg: str, **kv) -> None:
+        self._emit(logging.INFO, msg, kv)
+
+    def warn(self, msg: str, **kv) -> None:
+        self._emit(logging.WARNING, msg, kv)
+
+    def error(self, msg: str, **kv) -> None:
+        self._emit(logging.ERROR, msg, kv)
+
+    def with_timer(self, msg: str, **kv):
+        """Context manager logging msg with elapsed_ms on exit."""
+        return _LogTimer(self, msg, kv)
+
+
+class _LogTimer:
+    def __init__(self, log: StructuredLogger, msg: str, kv: dict):
+        self.log, self.msg, self.kv = log, msg, kv
+
+    def __enter__(self):
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, *_):
+        elapsed = round((time.perf_counter() - self.start) * 1e3, 3)
+        if exc_type is None:
+            self.log.info(self.msg, elapsed_ms=elapsed, **self.kv)
+        else:
+            self.log.error(self.msg, elapsed_ms=elapsed,
+                           error=exc_type.__name__, **self.kv)
+        return False
+
+
+def logger(module: str) -> StructuredLogger:
+    return StructuredLogger(module)
+
+
+def configure(level: str = "info", stream=None) -> None:
+    """Install the JSON handler on the celestia_tpu logger tree."""
+    root = logging.getLogger(_ROOT)
+    root.handlers.clear()
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(_JsonFormatter())
+    root.addHandler(handler)
+    root.setLevel(getattr(logging, level.upper()))
+    root.propagate = False
+
+
+# quiet unless configured: a WARNING-level null setup so library users
+# aren't spammed (cosmos NewNopLogger default)
+logging.getLogger(_ROOT).addHandler(logging.NullHandler())

@@ -6,10 +6,14 @@ import dataclasses
 import hashlib
 import json
 import pathlib
+import threading
 import time
 
 from celestia_tpu.app import App
 from celestia_tpu.app.app import ProposalBlockData, TxResult
+from celestia_tpu.log import logger
+
+log = logger("node")
 
 MEMPOOL_TTL_BLOCKS = 5  # ref: app/default_overrides.go:237-245 (v1 mempool TTL)
 DEFAULT_MAX_TX_BYTES = 7_897_088  # max-square bytes, DefaultConsensusConfig
@@ -126,27 +130,52 @@ class Node:
         self.home = pathlib.Path(home) if home else None
         if self.home:
             (self.home / "blocks").mkdir(parents=True, exist_ok=True)
+        # The RPC server calls in from handler threads
+        # (ThreadingHTTPServer) while the node thread produces blocks.
+        # State-mutating entries (CheckTx speculation, the block pipeline)
+        # serialize on this lock; read-only queries go lock-free (dict
+        # reads are atomic, committed-store writes only happen under the
+        # lock at Commit, and state proofs pair root+proof under the
+        # store's own SMT lock).
+        self._lock = threading.RLock()
 
     # --- mempool admission ---
 
     def broadcast_tx(self, raw: bytes) -> TxResult:
-        res = self.app.check_tx(raw)
-        if res.code == 0:
-            self.mempool.add(raw, res.priority, self.app.height)
+        with self._lock:
+            res = self.app.check_tx(raw)
+            if res.code == 0:
+                self.mempool.add(raw, res.priority, self.app.height)
         return res
 
     # --- block production (the proposer+validator round) ---
 
     def produce_block(self, block_time: float | None = None) -> Block:
+        with self._lock:
+            return self._produce_block_locked(block_time)
+
+    def _produce_block_locked(self, block_time: float | None) -> Block:
         block_time = block_time if block_time is not None else time.time()
+        t0 = time.perf_counter()
         proposal = self.app.prepare_proposal(self.mempool.reap())
         if not self.app.process_proposal(proposal):
+            log.error("own proposal rejected", height=self.app.height + 1)
             raise RuntimeError("node produced a proposal it cannot accept")
 
         self.app.begin_block(block_time)
         results = [self.app.deliver_tx(t) for t in proposal.txs]
         self.app.end_block()
         app_hash = self.app.commit()
+        log.info(
+            "committed block",
+            height=self.app.height,
+            txs=len(proposal.txs),
+            failed_txs=sum(1 for r in results if r.code != 0),
+            square_size=proposal.square_size,
+            data_hash=proposal.hash,
+            app_hash=app_hash,
+            elapsed_ms=round((time.perf_counter() - t0) * 1e3, 3),
+        )
 
         block = Block(
             height=self.app.height,

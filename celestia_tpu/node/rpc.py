@@ -12,7 +12,10 @@ import http.server
 import json
 import threading
 
+from celestia_tpu.log import logger
 from celestia_tpu.node.node import Node
+
+log = logger("rpc")
 
 
 def _share_proof_json(proof) -> dict:
@@ -121,9 +124,9 @@ def _handler_for(node: Node):
                     # against the committed app hash (IAVL store-proof
                     # analogue; ref: baseapp "store" query with prove=true)
                     key = bytes.fromhex(parts[2])
-                    store = node.app.store
-                    value = store.get(key)
-                    root, proof = store.prove_with_root(key)
+                    # atomic triple: the value is the one this proof
+                    # proves against this root, even under racing commits
+                    value, root, proof = node.app.store.query_with_proof(key)
                     self._reply(
                         {
                             "key": key.hex(),
@@ -248,6 +251,7 @@ def _handler_for(node: Node):
                 else:
                     self._reply({"error": "unknown route"}, 404)
             except Exception as e:  # noqa: BLE001
+                log.error("query failed", path=self.path, error=str(e))
                 self._reply({"error": str(e)}, 500)
 
         def do_POST(self):
@@ -267,6 +271,7 @@ def _handler_for(node: Node):
                 else:
                     self._reply({"error": "unknown route"}, 404)
             except Exception as e:  # noqa: BLE001
+                log.error("broadcast failed", path=self.path, error=str(e))
                 self._reply({"error": str(e)}, 500)
 
     return Handler

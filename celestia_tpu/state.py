@@ -46,12 +46,19 @@ class CacheStore:
         return CacheStore(self)
 
     def write(self) -> None:
-        """Flush this overlay into the parent."""
-        for k, v in self._writes.items():
-            if v is None:
-                self.parent.delete(k)
-            else:
-                self.parent.set(k, v)
+        """Flush this overlay into the parent. When the parent is the
+        committed StateStore the whole batch lands atomically (one lock
+        hold) so concurrent proof queries can never observe a
+        half-applied block."""
+        write_batch = getattr(self.parent, "write_batch", None)
+        if write_batch is not None:
+            write_batch(self._writes)
+        else:
+            for k, v in self._writes.items():
+                if v is None:
+                    self.parent.delete(k)
+                else:
+                    self.parent.set(k, v)
         self._writes.clear()
 
     def iter_prefix(self, prefix: bytes):
@@ -87,12 +94,32 @@ class StateStore:
     def set(self, key: bytes, value: bytes) -> None:
         if not isinstance(key, bytes) or not isinstance(value, bytes):
             raise TypeError("store keys/values must be bytes")
-        self._data[key] = value
-        self._dirty.add(key)
+        # Writes take the SMT lock so a concurrent query_with_proof can
+        # never observe a value newer than the root it pairs with (and so
+        # _fold_dirty never iterates a mutating set).
+        with self._smt_lock:
+            self._data[key] = value
+            self._dirty.add(key)
 
     def delete(self, key: bytes) -> None:
-        self._data.pop(key, None)
-        self._dirty.add(key)
+        with self._smt_lock:
+            self._data.pop(key, None)
+            self._dirty.add(key)
+
+    def write_batch(self, writes: dict[bytes, bytes | None]) -> None:
+        """Apply a block's worth of writes atomically: one lock hold, so
+        query_with_proof sees either none or all of them (never a bank
+        send with only the debit applied). Values of None delete."""
+        for k, v in writes.items():
+            if not isinstance(k, bytes) or not (v is None or isinstance(v, bytes)):
+                raise TypeError("store keys/values must be bytes")
+        with self._smt_lock:
+            for k, v in writes.items():
+                if v is None:
+                    self._data.pop(k, None)
+                else:
+                    self._data[k] = v
+                self._dirty.add(k)
 
     def branch(self) -> CacheStore:
         return CacheStore(self)
@@ -152,9 +179,23 @@ class StateStore:
     def prove_with_root(self, key: bytes) -> tuple[bytes, smt_mod.Proof]:
         """Atomically return (root, proof) so the advertised root always
         matches the proof even if a commit races on another thread."""
+        return self.query_with_proof(key)[1:]
+
+    def query_with_proof(
+        self, key: bytes
+    ) -> tuple[bytes | None, bytes, smt_mod.Proof]:
+        """Atomic (value, root, proof): the returned value is exactly the
+        one the proof proves against the returned root — the triple a
+        verifying RPC client needs (IAVL "store" query with prove=true).
+        Writers also hold the SMT lock, so no interleaved set() can skew
+        value vs root."""
         with self._smt_lock:
             self._fold_dirty()
-            return self._smt.root, self._smt.prove(smt_mod.key_hash(key))
+            return (
+                self._data.get(key),
+                self._smt.root,
+                self._smt.prove(smt_mod.key_hash(key)),
+            )
 
     @staticmethod
     def verify_proof(

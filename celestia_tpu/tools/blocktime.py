@@ -1,0 +1,63 @@
+"""blocktime — block interval statistics over a height range.
+
+Reference semantics: tools/blocktime/main.go — query the node RPC for the
+last N block headers and report average / min / max / stddev intervals
+(the operator's check that the chain is hitting GoalBlockTime).
+
+Run:  python -m celestia_tpu.tools.blocktime http://127.0.0.1:26657 [range]
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+import urllib.request
+
+
+def analyze_block_times(times: list[float]) -> dict:
+    """ref: tools/blocktime/main.go analyzeBlockTimes."""
+    if len(times) < 2:
+        raise ValueError("need at least two blocks to measure intervals")
+    intervals = [b - a for a, b in zip(times, times[1:])]
+    avg = sum(intervals) / len(intervals)
+    var = sum((x - avg) ** 2 for x in intervals) / len(intervals)
+    return {
+        "blocks": len(times),
+        "avg_s": round(avg, 3),
+        "min_s": round(min(intervals), 3),
+        "max_s": round(max(intervals), 3),
+        "stddev_s": round(math.sqrt(var), 3),
+    }
+
+
+def _get(base: str, path: str) -> dict:
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def run(rpc_url: str, query_range: int = 100) -> dict:
+    status = _get(rpc_url, "/status")
+    last = status["height"]
+    first = max(last - query_range + 1, 1)
+    times = []
+    for height in range(first, last + 1):
+        times.append(_get(rpc_url, f"/block/{height}")["time"])
+    stats = analyze_block_times(times)
+    stats.update(chain_id=status["chain_id"], from_height=first, to_height=last)
+    return stats
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print(f"Usage: {sys.argv[0]} <node_rpc> [query_range]")
+        return 1
+    query_range = int(argv[1]) if len(argv) > 1 else 100
+    stats = run(argv[0].rstrip("/"), query_range)
+    print(json.dumps(stats, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

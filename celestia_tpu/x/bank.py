@@ -19,6 +19,7 @@ SUPPLY_KEY = b"bank/supply/"
 FEE_COLLECTOR = "fee_collector"
 MINT_MODULE = "mint"
 BONDED_POOL = "bonded_tokens_pool"
+NOT_BONDED_POOL = "not_bonded_tokens_pool"
 
 
 def _balance_key(address: str, denom: str) -> bytes:

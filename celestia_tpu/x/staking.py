@@ -14,16 +14,35 @@ import json
 
 from celestia_tpu.blob import _field_bytes, _parse_fields, _require_wt
 from celestia_tpu.tx import register_msg
-from celestia_tpu.x.bank import BONDED_POOL
+from celestia_tpu.x.bank import BONDED_POOL, NOT_BONDED_POOL
 
 VALIDATOR_PREFIX = b"staking/validator/"
 DELEGATION_PREFIX = b"staking/delegation/"
+UNBONDING_PREFIX = b"staking/unbonding/"
+# schedule index: [ [completion_time, delegator, validator], ... ] — the
+# sdk UnbondingQueue analogue, so the per-block EndBlocker never scans
+# the whole state for matured entries
+UNBONDING_QUEUE_KEY = b"staking/unbondingQueue"
 LAST_UNBONDING_HEIGHT_KEY = b"staking/lastUnbondingHeight"
+UNBONDING_TIME_KEY = b"staking/params/unbondingTime"
 POWER_REDUCTION = 1_000_000  # utia per unit of consensus power
 
 
 def _delegation_key(delegator: str, validator: str) -> bytes:
     return DELEGATION_PREFIX + delegator.encode() + b"/" + validator.encode()
+
+
+def _unbonding_key(delegator: str, validator: str) -> bytes:
+    return UNBONDING_PREFIX + delegator.encode() + b"/" + validator.encode()
+
+
+@dataclasses.dataclass
+class UnbondingEntry:
+    """One undelegation awaiting maturity (sdk UnbondingDelegationEntry)."""
+
+    creation_height: int
+    completion_time: float
+    balance: int
 
 
 @dataclasses.dataclass
@@ -92,7 +111,71 @@ class StakingKeeper:
             self.get_delegation(delegator, validator_operator) + amount,
         )
 
+    # --- unbonding (sdk Undelegate -> UnbondingDelegation -> completion) ---
+
+    @property
+    def unbonding_time(self) -> float:
+        """Seconds until an undelegation matures (ref: appconsts
+        DefaultUnbondingTime = 3 weeks; governance-settable)."""
+        raw = self.store.get(UNBONDING_TIME_KEY)
+        if raw is None:
+            from celestia_tpu.appconsts import DEFAULT_UNBONDING_TIME_SECONDS
+
+            return float(DEFAULT_UNBONDING_TIME_SECONDS)
+        return float(json.loads(raw))
+
+    @unbonding_time.setter
+    def unbonding_time(self, seconds: float) -> None:
+        self.store.set(UNBONDING_TIME_KEY, json.dumps(float(seconds)).encode())
+
+    def unbonding_entries(self, delegator: str, validator: str) -> list[UnbondingEntry]:
+        raw = self.store.get(_unbonding_key(delegator, validator))
+        if not raw:
+            return []
+        return [UnbondingEntry(**e) for e in json.loads(raw)]
+
+    def _set_unbonding_entries(
+        self, delegator: str, validator: str, entries: list[UnbondingEntry]
+    ) -> None:
+        key = _unbonding_key(delegator, validator)
+        if entries:
+            self.store.set(
+                key,
+                json.dumps([dataclasses.asdict(e) for e in entries],
+                           sort_keys=True).encode(),
+            )
+        else:
+            self.store.delete(key)
+
+    def _unbonding_queue(self) -> list[list]:
+        raw = self.store.get(UNBONDING_QUEUE_KEY)
+        return json.loads(raw) if raw else []
+
+    def _set_unbonding_queue(self, queue: list[list]) -> None:
+        if queue:
+            self.store.set(
+                UNBONDING_QUEUE_KEY, json.dumps(queue, sort_keys=True).encode()
+            )
+        else:
+            self.store.delete(UNBONDING_QUEUE_KEY)
+
+    def _iter_unbondings(self):
+        """Yield (delegator, validator, entries) for every pair with
+        outstanding unbonding entries, via the queue index (no full-state
+        prefix scan)."""
+        seen = set()
+        for _time, delegator, validator in self._unbonding_queue():
+            if (delegator, validator) in seen:
+                continue
+            seen.add((delegator, validator))
+            entries = self.unbonding_entries(delegator, validator)
+            if entries:
+                yield delegator, validator, entries
+
     def undelegate(self, ctx, delegator: str, validator_operator: str, amount: int) -> None:
+        """Voting power drops immediately; tokens move to the not-bonded
+        pool and pay out only after the unbonding period (sdk
+        Keeper.Undelegate + UnbondingDelegation semantics)."""
         # Per-delegator accounting (SDK Delegation records): a delegator can
         # only withdraw its own bonded stake, never other delegators'.
         held = self.get_delegation(delegator, validator_operator)
@@ -107,12 +190,56 @@ class StakingKeeper:
         self._set_delegation(delegator, validator_operator, held - amount)
         v.tokens -= amount
         self.set_validator(v)
-        self.bank.send(BONDED_POOL, delegator, amount)
+        self.bank.send(BONDED_POOL, NOT_BONDED_POOL, amount)
+        completion = ctx.block_time + self.unbonding_time
+        entries = self.unbonding_entries(delegator, validator_operator)
+        entries.append(
+            UnbondingEntry(
+                creation_height=ctx.block_height,
+                completion_time=completion,
+                balance=amount,
+            )
+        )
+        self._set_unbonding_entries(delegator, validator_operator, entries)
+        queue = self._unbonding_queue()
+        queue.append([completion, delegator, validator_operator])
+        queue.sort()
+        self._set_unbonding_queue(queue)
         self.store.set(
             LAST_UNBONDING_HEIGHT_KEY, ctx.block_height.to_bytes(8, "big")
         )
         for hook in self.hooks:
             hook.after_validator_bond_change(ctx)
+
+    def complete_unbondings(self, ctx) -> int:
+        """EndBlocker: pay out matured unbonding entries from the
+        not-bonded pool (sdk DequeueAllMatureUBDQueue). The queue index is
+        sorted by completion time, so a block with nothing matured costs
+        one key read. Returns the number of completed entries."""
+        queue = self._unbonding_queue()
+        if not queue or queue[0][0] > ctx.block_time:
+            return 0
+        completed = 0
+        matured_pairs = set()
+        remaining = []
+        for item in queue:
+            if item[0] <= ctx.block_time:
+                matured_pairs.add((item[1], item[2]))
+            else:
+                remaining.append(item)
+        for delegator, validator in sorted(matured_pairs):
+            entries = self.unbonding_entries(delegator, validator)
+            keep: list[UnbondingEntry] = []
+            for e in entries:
+                if e.completion_time <= ctx.block_time:
+                    if e.balance > 0:
+                        self.bank.send(NOT_BONDED_POOL, delegator, e.balance)
+                    completed += 1
+                else:
+                    keep.append(e)
+            self._set_unbonding_entries(delegator, validator, keep)
+        self._set_unbonding_queue(remaining)
+        return completed
 
     def last_unbonding_height(self) -> int:
         raw = self.store.get(LAST_UNBONDING_HEIGHT_KEY)
@@ -148,9 +275,17 @@ class StakingKeeper:
         if v is None or fraction_dec <= 0:
             return 0
         one = 10**18
+        # Unbonding entries are slashed even when bonded stake is zero —
+        # otherwise fully-undelegating before evidence lands would let the
+        # whole stake mature un-slashed (sdk Slash covers unbonding
+        # delegations unconditionally).
+        unbonding_burned = self._slash_unbondings(validator_operator, fraction_dec)
         burn_total = v.tokens * fraction_dec // one
         if burn_total <= 0:
-            return 0
+            if unbonding_burned:
+                for hook in self.hooks:
+                    hook.after_validator_bond_change(ctx)
+            return unbonding_burned
         # Per-delegation floor cuts first, then distribute the rounding
         # remainder (deterministically, sorted order) so the invariant
         # sum(delegations) == v.tokens survives the slash — otherwise the
@@ -178,7 +313,26 @@ class StakingKeeper:
         self.bank.burn(BONDED_POOL, burn_total)
         for hook in self.hooks:
             hook.after_validator_bond_change(ctx)
-        return burn_total
+        return burn_total + unbonding_burned
+
+    def _slash_unbondings(self, validator_operator: str, fraction_dec: int) -> int:
+        """Slash all outstanding unbonding entries of the validator at the
+        same fraction (sdk slashes entries created after the infraction;
+        applying it to all entries is strictly no more lenient). Returns
+        the burned amount."""
+        one = 10**18
+        burned = 0
+        for delegator, validator, entries in self._iter_unbondings():
+            if validator != validator_operator:
+                continue
+            for e in entries:
+                cut = e.balance * fraction_dec // one
+                if cut > 0:
+                    e.balance -= cut
+                    self.bank.burn(NOT_BONDED_POOL, cut)
+                    burned += cut
+            self._set_unbonding_entries(delegator, validator_operator, entries)
+        return burned
 
     def jail(self, ctx, validator_operator: str) -> None:
         v = self.get_validator(validator_operator)

@@ -78,6 +78,84 @@ class TestStaking:
         assert app.staking.get_validator(val_addr).power == 400
         assert app.staking.last_unbonding_height() > 0
 
+    def test_unbonding_period_lifecycle(self):
+        """sdk UnbondingDelegation semantics: power drops now, funds pay
+        out only after the unbonding period elapses (ref: appconsts
+        DefaultUnbondingTime; staking EndBlocker completion)."""
+        from celestia_tpu.x.bank import NOT_BONDED_POOL
+
+        app = fresh_app()
+        val = VALIDATOR.bech32_address()
+        app.staking.unbonding_time = 100.0  # shrink 3 weeks for the test
+        app.store.commit_hash_refresh()
+        run_block(app, [make_tx(app, VALIDATOR,
+                                [MsgDelegate(val, val, 500_000_000)])])
+        balance_bonded = app.bank.get_balance(val)
+
+        run_block(app, [make_tx(app, VALIDATOR,
+                                [MsgUndelegate(val, val, 200_000_000)])])
+        # power dropped, but no payout yet: funds sit in the not-bonded pool
+        assert app.staking.get_validator(val).power == 300
+        assert app.bank.get_balance(NOT_BONDED_POOL) == 200_000_000
+        assert app.bank.get_balance(val) < balance_bonded  # only fees moved
+        entries = app.staking.unbonding_entries(val, val)
+        assert len(entries) == 1 and entries[0].balance == 200_000_000
+
+        # a block before maturity: still pending
+        run_block(app, [])
+        assert app.staking.unbonding_entries(val, val)
+
+        # jump past the completion time: EndBlocker pays out
+        app.begin_block(app.block_time + 200.0)
+        app.end_block()
+        app.commit()
+        assert app.staking.unbonding_entries(val, val) == []
+        assert app.bank.get_balance(NOT_BONDED_POOL) == 0
+        # payout arrived (modulo the undelegate tx's own fee)
+        assert app.bank.get_balance(val) >= balance_bonded + 200_000_000 - 400_000
+
+    def test_slash_reaches_fully_unbonded_stake(self):
+        """Undelegating everything before evidence lands must NOT shield
+        the stake: unbonding entries are slashed even at zero bonded."""
+        from celestia_tpu.app.context import Context, ExecMode
+        from celestia_tpu.x.bank import BankKeeper, NOT_BONDED_POOL
+
+        app = fresh_app()
+        val = VALIDATOR.bech32_address()
+        run_block(app, [make_tx(app, VALIDATOR,
+                                [MsgDelegate(val, val, 100_000_000)])])
+        run_block(app, [make_tx(app, VALIDATOR,
+                                [MsgUndelegate(val, val, 100_000_000)])])
+        staking = StakingKeeper(app.store, BankKeeper(app.store))
+        assert staking.get_validator(val).tokens == 0
+        ctx = Context(store=app.store, chain_id=app.chain_id, block_height=9,
+                      block_time=app.block_time, app_version=1,
+                      mode=ExecMode.DELIVER)
+        burned = staking.slash(ctx, val, 50 * 10**16)  # 50%
+        assert burned == 50_000_000
+        assert staking.unbonding_entries(val, val)[0].balance == 50_000_000
+        assert app.bank.get_balance(NOT_BONDED_POOL) == 50_000_000
+
+    def test_slash_cuts_unbonding_entries(self):
+        from celestia_tpu.app.context import Context, ExecMode
+        from celestia_tpu.x.bank import BankKeeper, NOT_BONDED_POOL
+
+        app = fresh_app()
+        val = VALIDATOR.bech32_address()
+        run_block(app, [make_tx(app, VALIDATOR,
+                                [MsgDelegate(val, val, 100_000_000)])])
+        run_block(app, [make_tx(app, VALIDATOR,
+                                [MsgUndelegate(val, val, 40_000_000)])])
+        ctx = Context(store=app.store, chain_id=app.chain_id, block_height=9,
+                      block_time=app.block_time, app_version=1,
+                      mode=ExecMode.DELIVER)
+        staking = StakingKeeper(app.store, BankKeeper(app.store))
+        staking.slash(ctx, val, 50 * 10**16)  # 50%
+        assert staking.get_validator(val).tokens == 30_000_000
+        entries = staking.unbonding_entries(val, val)
+        assert entries[0].balance == 20_000_000  # unbonding slashed too
+        assert app.bank.get_balance(NOT_BONDED_POOL) == 20_000_000
+
 
 class TestBlobstream:
     def _bonded_app(self):

@@ -1,0 +1,248 @@
+"""Concurrency tests — the `make test-race` analogue (SURVEY §5).
+
+The node RPC serves from ThreadingHTTPServer handler threads while the
+node thread produces blocks; these tests hammer the live RPC surface
+(queries, broadcasts, state proofs) concurrently with block production
+and assert no errors, no lost txs, and proof/root consistency under
+racing commits."""
+
+import concurrent.futures
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from celestia_tpu import blob as blob_pkg
+from celestia_tpu import namespace as ns
+from celestia_tpu.app import App
+from celestia_tpu.crypto import PrivateKey
+from celestia_tpu.node import Node
+from celestia_tpu.node.rpc import RpcServer
+from celestia_tpu.state import StateStore
+from celestia_tpu.user import Signer
+from celestia_tpu.x.bank import MsgSend
+
+VALIDATOR = PrivateKey.from_secret(b"validator")
+ALICE = PrivateKey.from_secret(b"alice")
+BOB = PrivateKey.from_secret(b"bob")
+
+
+def new_node() -> Node:
+    app = App()
+    app.init_chain(
+        {
+            VALIDATOR.bech32_address(): 1_000_000_000_000,
+            ALICE.bech32_address(): 50_000_000_000,
+            BOB.bech32_address(): 50_000_000_000,
+        },
+        genesis_time=0.0,
+    )
+    node = Node(app)
+    node.produce_block(15.0)
+    return node
+
+
+class TestBlocktimeTool:
+    def test_analyze(self):
+        from celestia_tpu.tools.blocktime import analyze_block_times
+
+        stats = analyze_block_times([0.0, 15.0, 30.0, 46.0])
+        assert stats["blocks"] == 4
+        assert stats["avg_s"] == pytest.approx(46.0 / 3, abs=0.01)
+        assert stats["min_s"] == 15.0 and stats["max_s"] == 16.0
+
+    def test_against_live_rpc(self):
+        from celestia_tpu.tools.blocktime import run as blocktime_run
+
+        node = new_node()
+        for i in range(4):
+            node.produce_block(30.0 + 15.0 * i)
+        srv = RpcServer(node, port=0)
+        srv.start()
+        try:
+            stats = blocktime_run(f"http://127.0.0.1:{srv.port}", 5)
+            assert stats["blocks"] == 5
+            assert stats["avg_s"] == pytest.approx(15.0)
+            assert stats["chain_id"] == node.app.chain_id
+        finally:
+            srv.stop()
+
+
+class TestStructuredLogging:
+    def test_json_lines_emitted(self, capsys):
+        import io
+
+        from celestia_tpu import log as log_mod
+
+        buf = io.StringIO()
+        log_mod.configure("info", stream=buf)
+        try:
+            node = new_node()  # produce_block logs "committed block"
+            lines = [l for l in buf.getvalue().splitlines() if l.strip()]
+            events = [json.loads(l) for l in lines]
+            committed = [e for e in events if e["msg"] == "committed block"]
+            assert committed, events
+            e = committed[-1]
+            assert e["module"] == "node"
+            assert e["level"] == "info"
+            assert e["height"] == 1
+            assert isinstance(e["app_hash"], str) and len(e["app_hash"]) == 64
+            assert e["elapsed_ms"] > 0
+        finally:
+            log_mod.configure("warning")  # back to quiet
+
+    def test_quiet_by_default_for_library_users(self):
+        import logging
+
+        from celestia_tpu import log as log_mod
+
+        log_mod.configure("warning")
+        assert not logging.getLogger("celestia_tpu").isEnabledFor(logging.INFO)
+
+
+class TestRpcRaces:
+    def _get(self, base, path):
+        with urllib.request.urlopen(base + path, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    def test_queries_race_block_production(self):
+        """GET storms (status/account/balance/state-proof) while blocks
+        commit: every response must be well-formed, never a 500."""
+        node = new_node()
+        srv = RpcServer(node, port=0)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        stop = threading.Event()
+        errors: list[str] = []
+
+        def producer():
+            t = 30.0
+            while not stop.is_set():
+                node.produce_block(t)
+                t += 15.0
+
+        def hammer(path, check):
+            while not stop.is_set():
+                try:
+                    check(self._get(base, path))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"{path}: {e}")
+                    return
+
+        alice = ALICE.bech32_address()
+        paths = [
+            ("/status", lambda d: d["height"] >= 1),
+            (f"/account/{alice}", lambda d: d["balance"] > 0),
+            (f"/balance/{alice}/utia", lambda d: d["balance"] > 0),
+            ("/proof/state/" + b"auth/globalAccountNumber".hex(),
+             lambda d: d["app_hash"]),
+        ]
+        prod = threading.Thread(target=producer)
+        prod.start()
+        try:
+            with concurrent.futures.ThreadPoolExecutor(8) as pool:
+                futs = [pool.submit(hammer, p, c) for p, c in paths * 2]
+                import time
+
+                time.sleep(2.0)
+                stop.set()
+                concurrent.futures.wait(futs, timeout=15)
+        finally:
+            stop.set()
+            prod.join(timeout=15)
+            srv.stop()
+        assert not errors, errors[:3]
+        assert node.app.height > 1  # blocks actually raced the queries
+
+    def test_concurrent_broadcasts_with_production(self):
+        """Many threads broadcasting from distinct accounts while blocks
+        commit: every accepted tx must land in exactly one block."""
+        node = new_node()
+        keys = [PrivateKey.from_secret(f"racer-{i}".encode()) for i in range(6)]
+        for key in keys:
+            node.app.accounts.get_or_create(key.bech32_address())
+            node.app.bank.mint(key.bech32_address(), 1_000_000_000)
+        node.app.store.commit_hash_refresh()
+
+        stop = threading.Event()
+        accepted: list[bytes] = []
+        acc_lock = threading.Lock()
+        errors: list[str] = []
+
+        def producer():
+            t = 30.0
+            while not stop.is_set():
+                node.produce_block(t)
+                t += 15.0
+
+        def submitter(key):
+            try:
+                signer = Signer.setup_single(key, node)
+                for i in range(10):
+                    b = blob_pkg.new_blob(ns.new_v0(b"racetest"), bytes([i]) * 256, 0)
+                    res = signer.submit_pay_for_blob([b])
+                    if res.code == 0:
+                        with acc_lock:
+                            accepted.append(res.raw)
+            except Exception as e:  # noqa: BLE001
+                errors.append(str(e))
+
+        prod = threading.Thread(target=producer)
+        prod.start()
+        try:
+            with concurrent.futures.ThreadPoolExecutor(6) as pool:
+                concurrent.futures.wait(
+                    [pool.submit(submitter, k) for k in keys], timeout=60
+                )
+        finally:
+            stop.set()
+            prod.join(timeout=30)
+        assert not errors, errors[:3]
+        # drain whatever is still pending
+        while len(node.mempool):
+            node.produce_block(node.app.block_time + 15.0)
+        from celestia_tpu.node.node import tx_hash
+
+        assert len(accepted) == 60
+        seen = set()
+        for raw in accepted:
+            loc = node.tx_index.get(tx_hash(raw))
+            assert loc is not None, "accepted tx never landed in a block"
+            assert loc not in seen  # exactly once
+            seen.add(loc)
+
+    def test_state_proof_root_pairing_under_commits(self):
+        """prove_with_root must never pair a proof with a root from a
+        different version while commits race (the SMT lock contract)."""
+        store = StateStore()
+        stop = threading.Event()
+        errors: list[str] = []
+
+        def committer():
+            i = 0
+            while not stop.is_set():
+                store.set(f"k{i % 50}".encode(), f"v{i}".encode())
+                store.commit()
+                i += 1
+
+        def prover():
+            while not stop.is_set():
+                key = b"k7"
+                value, root, proof = store.query_with_proof(key)
+                if not StateStore.verify_proof(root, key, value, proof):
+                    errors.append("value/root/proof triple failed verification")
+                    return
+
+        threads = [threading.Thread(target=committer)] + [
+            threading.Thread(target=prover) for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        import time
+
+        time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors, errors[:3]
