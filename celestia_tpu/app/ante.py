@@ -116,15 +116,31 @@ class AnteHandler:
                 )
         if tx.fee.amount > 0:
             payer = self._fee_payer(tx)
-            # The fee payer must be one of the tx signers (the SDK derives
-            # signers from GetSigners ∪ FeePayer) — otherwise anyone could
-            # drain a third party's balance into the fee collector.
+            # The fee payer must be one of the tx signers in BOTH branches
+            # (the SDK derives signers from GetSigners ∪ FeePayer) —
+            # without it anyone could drain a third party's balance, or
+            # burn a third party's fee allowance, fee-free.
             from celestia_tpu.crypto import bech32_address
 
             signers = {bech32_address(si.public_key) for si in tx.signer_infos}
             if payer not in signers:
                 raise ValueError(f"fee payer {payer} is not a tx signer")
-            self.bank.send(payer, FEE_COLLECTOR, tx.fee.amount, tx.fee.denom)
+            if tx.fee.granter:
+                # feegrant path: the granter pays, against an allowance
+                # granted to the (signing) fee payer — sdk
+                # DeductFeeDecorator with the feegrant keeper. The granter
+                # does NOT sign this tx.
+                from celestia_tpu.x.feegrant import FeegrantKeeper
+
+                FeegrantKeeper(ctx.store, self.bank).use_granted_fees(
+                    ctx, tx.fee.granter, payer, tx.fee.amount, tx.fee.denom,
+                    tx.msgs,
+                )
+                self.bank.send(
+                    tx.fee.granter, FEE_COLLECTOR, tx.fee.amount, tx.fee.denom
+                )
+            else:
+                self.bank.send(payer, FEE_COLLECTOR, tx.fee.amount, tx.fee.denom)
         if tx.fee.gas_limit > 0:
             ctx.priority = tx.fee.amount * 1_000_000 // tx.fee.gas_limit
 

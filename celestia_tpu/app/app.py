@@ -23,7 +23,14 @@ from celestia_tpu.shares import to_bytes
 from celestia_tpu.state import StateStore
 from celestia_tpu.tx import Tx, decode_tx
 from celestia_tpu.x.auth import AccountKeeper
+from celestia_tpu.x.authz import AuthzKeeper, MsgExec, MsgGrant, MsgRevoke
 from celestia_tpu.x.bank import BankKeeper, MsgSend
+from celestia_tpu.x.crisis import CrisisKeeper
+from celestia_tpu.x.feegrant import (
+    FeegrantKeeper,
+    MsgGrantAllowance,
+    MsgRevokeAllowance,
+)
 from celestia_tpu.x.blob import BlobKeeper, MsgPayForBlobs, validate_blob_tx
 from celestia_tpu.x.blob.types import pfb_blob_sizes
 from celestia_tpu.x.blobstream import BlobstreamKeeper, MsgRegisterEVMAddress
@@ -128,8 +135,14 @@ class App:
     # genesis
 
     def init_chain(self, genesis_accounts: dict[str, int] | None = None,
-                   genesis_time: float = 0.0) -> None:
-        """ref: app/app.go InitChainer + default_overrides genesis"""
+                   genesis_time: float = 0.0,
+                   genesis_validators: dict[str, int] | None = None) -> None:
+        """ref: app/app.go InitChainer + default_overrides genesis.
+
+        genesis_validators maps operator address -> self-bonded tokens
+        (the genutil gentx flow: DeliverGenTxs creates the validators
+        before the first block — app/app.go:498-499 notes genutil must
+        run after staking so pools fund from genesis accounts)."""
         from celestia_tpu.x.blob.keeper import Params
 
         self.blob.set_params(Params())
@@ -137,8 +150,22 @@ class App:
         for address, amount in (genesis_accounts or {}).items():
             self.accounts.get_or_create(address)
             self.bank.mint(address, amount)
+        for operator, tokens in (genesis_validators or {}).items():
+            if self.bank.get_balance(operator) < tokens:
+                raise ValueError(
+                    f"genesis validator {operator} self-bond {tokens} exceeds "
+                    "its genesis balance"
+                )
+            self.accounts.get_or_create(operator)
+            # the normal delegation path, so genesis bonding can never
+            # diverge from tx-time bonding bookkeeping
+            self.staking.delegate(None, operator, operator, tokens)
         self.store.commit()
         self.height = 0
+
+    def assert_invariants(self) -> None:
+        """ref: crisis AssertInvariants (app/export.go:69)."""
+        CrisisKeeper(self.store).assert_invariants()
 
     # ------------------------------------------------------------------ #
     # helpers
@@ -486,6 +513,24 @@ class App:
             staking = StakingKeeper(ctx.store, bank)
             staking.hooks.append(BlobstreamKeeper(ctx.store, staking))
             SlashingKeeper(ctx.store, staking).unjail(ctx, msg.validator_address)
+        elif isinstance(msg, MsgGrantAllowance):
+            FeegrantKeeper(ctx.store, BankKeeper(ctx.store)).grant_allowance(
+                msg.to_allowance()
+            )
+        elif isinstance(msg, MsgRevokeAllowance):
+            FeegrantKeeper(ctx.store, BankKeeper(ctx.store)).revoke_allowance(
+                msg.granter, msg.grantee
+            )
+        elif isinstance(msg, MsgGrant):
+            AuthzKeeper(ctx.store).grant(msg.to_grant())
+        elif isinstance(msg, MsgRevoke):
+            AuthzKeeper(ctx.store).revoke(
+                msg.granter, msg.grantee, msg.msg_type_url
+            )
+        elif isinstance(msg, MsgExec):
+            AuthzKeeper(ctx.store).dispatch_exec(
+                ctx, msg.grantee, msg.msgs, self._route_msg
+            )
         elif isinstance(msg, MsgTransfer):
             TransferKeeper(ctx.store, BankKeeper(ctx.store)).send_transfer(
                 ctx, msg.source_port, msg.source_channel, msg.denom,

@@ -150,6 +150,9 @@ def _prep_for_zero_height_genesis(app) -> None:
     from celestia_tpu.x.staking import StakingKeeper
 
     store = app.store
+    # "Just to be safe, assert the invariants on current state"
+    # (app/export.go:68-69)
+    app.assert_invariants()
     ctx = Context(
         store=store,
         chain_id=app.chain_id,

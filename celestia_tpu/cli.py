@@ -49,6 +49,9 @@ def cmd_init(args):
         "chain_id": args.chain_id,
         "genesis_time": time.time(),
         "accounts": {key.bech32_address(): 1_000_000_000_000},
+        # the gentx flow: this node's key is a genesis validator with a
+        # self-bond (genutil DeliverGenTxs analogue)
+        "validators": {key.bech32_address(): 100_000_000_000},
     }
     (home / "genesis.json").write_text(json.dumps(genesis, indent=2))
     # layered config files (ref: app/default_overrides.go:230-271 written by
@@ -73,7 +76,11 @@ def _build_node(home: pathlib.Path):
         app = import_genesis(genesis)
         return Node(app, home=str(home))
     app = App(chain_id=genesis["chain_id"])
-    app.init_chain(genesis["accounts"], genesis_time=genesis["genesis_time"])
+    app.init_chain(
+        genesis["accounts"],
+        genesis_time=genesis["genesis_time"],
+        genesis_validators=genesis.get("validators"),
+    )
     return Node(app, home=str(home))
 
 

@@ -1,0 +1,351 @@
+"""feegrant, authz, crisis invariants, genesis validators — the stock SDK
+module tier completion (VERDICT r1 coverage item 17; ref: app/app.go:137-157
+ModuleBasics, feegrant/authz keepers, crisis AssertInvariants)."""
+
+import pytest
+
+from celestia_tpu.app import App
+from celestia_tpu.crypto import PrivateKey
+from celestia_tpu.node import Node
+from celestia_tpu.tx import Fee
+from celestia_tpu.user import Signer
+from celestia_tpu.x.authz import MsgExec, MsgGrant, MsgRevoke
+from celestia_tpu.x.bank import MsgSend
+from celestia_tpu.x.crisis import CrisisKeeper, InvariantBrokenError
+from celestia_tpu.x.feegrant import MsgGrantAllowance, MsgRevokeAllowance
+from celestia_tpu.x.staking import MsgDelegate
+
+VALIDATOR = PrivateKey.from_secret(b"validator")
+ALICE = PrivateKey.from_secret(b"alice")
+BOB = PrivateKey.from_secret(b"bob")
+CAROL = PrivateKey.from_secret(b"carol")
+
+
+def new_node(**app_kwargs) -> Node:
+    app = App(**app_kwargs)
+    app.init_chain(
+        {
+            VALIDATOR.bech32_address(): 1_000_000_000_000,
+            ALICE.bech32_address(): 50_000_000_000,
+            BOB.bech32_address(): 50_000_000_000,
+            CAROL.bech32_address(): 5_000_000,
+        },
+        genesis_time=0.0,
+    )
+    node = Node(app)
+    node.produce_block(15.0)
+    return node
+
+
+class TestFeegrant:
+    def test_granted_fee_charged_to_granter(self):
+        node = new_node()
+        alice, carol = ALICE.bech32_address(), CAROL.bech32_address()
+        a = Signer.setup_single(ALICE, node)
+        assert a.submit_tx(
+            [MsgGrantAllowance(alice, carol, spend_limit=1_000_000)]
+        ).code == 0
+        node.produce_block(30.0)
+
+        alice_before = node.app.bank.get_balance(alice)
+        carol_before = node.app.bank.get_balance(carol)
+        c = Signer.setup_single(CAROL, node)
+        res = c.submit_tx(
+            [MsgSend(carol, BOB.bech32_address(), 100)],
+            fee=Fee(amount=50_000, gas_limit=200_000, granter=alice),
+        )
+        assert res.code == 0, res.log
+        node.produce_block(45.0)
+        # the granter paid the fee; carol paid only the 100 send
+        assert node.app.bank.get_balance(alice) == alice_before - 50_000
+        assert node.app.bank.get_balance(carol) == carol_before - 100
+        # allowance decremented
+        allowance = node.app.store  # read through the keeper
+        from celestia_tpu.x.feegrant import FeegrantKeeper
+
+        g = FeegrantKeeper(node.app.store, node.app.bank).get_allowance(alice, carol)
+        assert g.spend_limit == 1_000_000 - 50_000
+
+    def test_fee_over_limit_rejected(self):
+        node = new_node()
+        alice, carol = ALICE.bech32_address(), CAROL.bech32_address()
+        a = Signer.setup_single(ALICE, node)
+        a.submit_tx([MsgGrantAllowance(alice, carol, spend_limit=10_000)])
+        node.produce_block(30.0)
+        c = Signer.setup_single(CAROL, node)
+        res = c.submit_tx(
+            [MsgSend(carol, BOB.bech32_address(), 1)],
+            fee=Fee(amount=50_000, gas_limit=200_000, granter=alice),
+        )
+        assert res.code != 0
+        assert "exceeds the allowance spend limit" in res.log
+
+    def test_no_allowance_rejected(self):
+        node = new_node()
+        c = Signer.setup_single(CAROL, node)
+        res = c.submit_tx(
+            [MsgSend(CAROL.bech32_address(), BOB.bech32_address(), 1)],
+            fee=Fee(amount=50_000, gas_limit=200_000,
+                    granter=ALICE.bech32_address()),
+        )
+        assert res.code != 0
+        assert "no fee allowance" in res.log
+
+    def test_msg_filter_enforced(self):
+        node = new_node()
+        alice, carol = ALICE.bech32_address(), CAROL.bech32_address()
+        a = Signer.setup_single(ALICE, node)
+        a.submit_tx(
+            [MsgGrantAllowance(alice, carol, spend_limit=1_000_000,
+                               allowed_msgs=[MsgDelegate.TYPE_URL])]
+        )
+        node.produce_block(30.0)
+        c = Signer.setup_single(CAROL, node)
+        res = c.submit_tx(
+            [MsgSend(carol, BOB.bech32_address(), 1)],
+            fee=Fee(amount=10_000, gas_limit=200_000, granter=alice),
+        )
+        assert res.code != 0
+        assert "not allowed by the fee allowance" in res.log
+
+    def test_expired_allowance_rejected_and_pruned(self):
+        node = new_node()
+        alice, carol = ALICE.bech32_address(), CAROL.bech32_address()
+        a = Signer.setup_single(ALICE, node)
+        a.submit_tx(
+            [MsgGrantAllowance(alice, carol, spend_limit=1_000_000,
+                               expiration=20.0)]
+        )
+        node.produce_block(30.0)  # past the expiration already
+        c = Signer.setup_single(CAROL, node)
+        res = c.submit_tx(
+            [MsgSend(carol, BOB.bech32_address(), 1)],
+            fee=Fee(amount=10_000, gas_limit=200_000, granter=alice),
+        )
+        assert res.code != 0
+        assert "expired" in res.log
+
+    def test_third_party_cannot_burn_someone_elses_allowance(self):
+        """Mallory names Bob as payer + Alice as granter on her own tx:
+        the payer-must-sign rule applies on the feegrant path too."""
+        node = new_node()
+        alice, bob = ALICE.bech32_address(), BOB.bech32_address()
+        a = Signer.setup_single(ALICE, node)
+        a.submit_tx([MsgGrantAllowance(alice, bob, spend_limit=10**9)])
+        node.produce_block(30.0)
+        alice_before = node.app.bank.get_balance(alice)
+        mallory = Signer.setup_single(CAROL, node)
+        res = mallory.submit_tx(
+            [MsgSend(CAROL.bech32_address(), bob, 1)],
+            fee=Fee(amount=50_000, gas_limit=200_000, payer=bob, granter=alice),
+        )
+        assert res.code != 0
+        assert "not a tx signer" in res.log
+        assert node.app.bank.get_balance(alice) == alice_before
+
+    def test_foreign_denom_fee_not_covered(self):
+        node = new_node()
+        alice, carol = ALICE.bech32_address(), CAROL.bech32_address()
+        a = Signer.setup_single(ALICE, node)
+        a.submit_tx([MsgGrantAllowance(alice, carol, spend_limit=10**9)])
+        node.produce_block(30.0)
+        c = Signer.setup_single(CAROL, node)
+        res = c.submit_tx(
+            [MsgSend(carol, BOB.bech32_address(), 1)],
+            fee=Fee(amount=1_000, gas_limit=200_000, granter=alice,
+                    denom="transfer/channel-0/uatom"),
+        )
+        assert res.code != 0
+        assert "only cover utia" in res.log
+
+    def test_revoke(self):
+        node = new_node()
+        alice, carol = ALICE.bech32_address(), CAROL.bech32_address()
+        a = Signer.setup_single(ALICE, node)
+        a.submit_tx([MsgGrantAllowance(alice, carol, spend_limit=1_000_000)])
+        node.produce_block(30.0)
+        assert a.submit_tx([MsgRevokeAllowance(alice, carol)]).code == 0
+        node.produce_block(45.0)
+        from celestia_tpu.x.feegrant import FeegrantKeeper
+
+        assert FeegrantKeeper(node.app.store, node.app.bank).get_allowance(
+            alice, carol
+        ) is None
+
+
+class TestAuthz:
+    def test_exec_send_on_behalf(self):
+        node = new_node()
+        alice, bob, carol = (ALICE.bech32_address(), BOB.bech32_address(),
+                             CAROL.bech32_address())
+        a = Signer.setup_single(ALICE, node)
+        assert a.submit_tx(
+            [MsgGrant(alice, bob, MsgSend.TYPE_URL, spend_limit=10_000)]
+        ).code == 0
+        node.produce_block(30.0)
+
+        alice_before = node.app.bank.get_balance(alice)
+        b = Signer.setup_single(BOB, node)
+        res = b.submit_tx([MsgExec(bob, [MsgSend(alice, carol, 4_000)])])
+        assert res.code == 0, res.log
+        block = node.produce_block(45.0)
+        assert block.tx_results[0].code == 0, block.tx_results[0].log
+        assert node.app.bank.get_balance(alice) == alice_before - 4_000
+        # spend limit decremented
+        from celestia_tpu.x.authz import AuthzKeeper
+
+        g = AuthzKeeper(node.app.store).get_grant(alice, bob, MsgSend.TYPE_URL)
+        assert g.spend_limit == 6_000
+
+    def test_exec_without_grant_fails(self):
+        node = new_node()
+        alice, bob, carol = (ALICE.bech32_address(), BOB.bech32_address(),
+                             CAROL.bech32_address())
+        b = Signer.setup_single(BOB, node)
+        b.submit_tx([MsgExec(bob, [MsgSend(alice, carol, 4_000)])])
+        block = node.produce_block(30.0)
+        assert block.tx_results[0].code != 0
+        assert "no authorization" in block.tx_results[0].log
+        # alice untouched
+        assert node.app.bank.get_balance(alice) == 50_000_000_000
+
+    def test_exec_over_spend_limit_fails(self):
+        node = new_node()
+        alice, bob, carol = (ALICE.bech32_address(), BOB.bech32_address(),
+                             CAROL.bech32_address())
+        a = Signer.setup_single(ALICE, node)
+        a.submit_tx([MsgGrant(alice, bob, MsgSend.TYPE_URL, spend_limit=1_000)])
+        node.produce_block(30.0)
+        b = Signer.setup_single(BOB, node)
+        b.submit_tx([MsgExec(bob, [MsgSend(alice, carol, 4_000)])])
+        block = node.produce_block(45.0)
+        assert block.tx_results[0].code != 0
+        assert "exceeds the authorization spend limit" in block.tx_results[0].log
+
+    def test_generic_grant_for_delegate(self):
+        node = new_node()
+        alice, bob = ALICE.bech32_address(), BOB.bech32_address()
+        val = VALIDATOR.bech32_address()
+        vs = Signer.setup_single(VALIDATOR, node)
+        vs.submit_tx([MsgDelegate(val, val, 5_000_000)])
+        node.produce_block(30.0)
+        a = Signer.setup_single(ALICE, node)
+        a.submit_tx([MsgGrant(alice, bob, MsgDelegate.TYPE_URL)])
+        node.produce_block(45.0)
+        b = Signer.setup_single(BOB, node)
+        b.submit_tx([MsgExec(bob, [MsgDelegate(alice, val, 2_000_000)])])
+        block = node.produce_block(60.0)
+        assert block.tx_results[0].code == 0, block.tx_results[0].log
+        assert node.app.staking.get_delegation(alice, val) == 2_000_000
+
+    def test_revoke_stops_exec(self):
+        node = new_node()
+        alice, bob, carol = (ALICE.bech32_address(), BOB.bech32_address(),
+                             CAROL.bech32_address())
+        a = Signer.setup_single(ALICE, node)
+        a.submit_tx([MsgGrant(alice, bob, MsgSend.TYPE_URL)])
+        node.produce_block(30.0)
+        a.submit_tx([MsgRevoke(alice, bob, MsgSend.TYPE_URL)])
+        node.produce_block(45.0)
+        b = Signer.setup_single(BOB, node)
+        b.submit_tx([MsgExec(bob, [MsgSend(alice, carol, 1)])])
+        block = node.produce_block(60.0)
+        assert block.tx_results[0].code != 0
+
+    def test_nested_exec_rejected(self):
+        inner = MsgExec("x", [MsgSend("a", "b", 1)])
+        with pytest.raises(ValueError, match="nested"):
+            MsgExec("y", [inner]).validate_basic()
+
+    def test_nested_pfb_rejected(self):
+        """A PFB's blobs ride the top-level BlobTx envelope; authz-nesting
+        one would emit a commitment with no blob in the square. Rejected
+        at validate_basic AND at dispatch (defense in depth)."""
+        from celestia_tpu.x.authz import AuthzKeeper
+        from celestia_tpu.x.blob.types import MsgPayForBlobs
+
+        pfb = MsgPayForBlobs(
+            signer=ALICE.bech32_address(), namespaces=[b"\x00" * 29],
+            blob_sizes=[10], share_commitments=[b"\x00" * 32],
+            share_versions=[0],
+        )
+        with pytest.raises(ValueError, match="cannot be nested"):
+            MsgExec(BOB.bech32_address(), [pfb]).validate_basic()
+        node = new_node()
+        with pytest.raises(ValueError, match="cannot be executed"):
+            AuthzKeeper(node.app.store).dispatch_exec(
+                None, BOB.bech32_address(), [pfb], lambda c, m: None
+            )
+
+    def test_exec_wire_round_trip(self):
+        msg = MsgExec(BOB.bech32_address(),
+                      [MsgSend(ALICE.bech32_address(),
+                               CAROL.bech32_address(), 42)])
+        again = MsgExec.unmarshal(msg.marshal())
+        assert again.grantee == msg.grantee
+        assert again.msgs[0].amount == 42
+
+
+class TestCrisisInvariants:
+    def test_clean_chain_passes(self):
+        node = new_node()
+        vs = Signer.setup_single(VALIDATOR, node)
+        vs.submit_tx([MsgDelegate(VALIDATOR.bech32_address(),
+                                  VALIDATOR.bech32_address(), 5_000_000)])
+        node.produce_block(30.0)
+        node.app.assert_invariants()  # must not raise
+
+    def test_supply_corruption_detected(self):
+        node = new_node()
+        # corrupt: credit a balance without minting supply
+        from celestia_tpu.x.bank import _balance_key
+
+        node.app.store.set(
+            _balance_key("celestia1corrupt", "utia"), (10**9).to_bytes(16, "big")
+        )
+        with pytest.raises(InvariantBrokenError, match="bank/total-supply"):
+            node.app.assert_invariants()
+
+    def test_delegation_corruption_detected(self):
+        node = new_node()
+        vs = Signer.setup_single(VALIDATOR, node)
+        vs.submit_tx([MsgDelegate(VALIDATOR.bech32_address(),
+                                  VALIDATOR.bech32_address(), 5_000_000)])
+        node.produce_block(30.0)
+        v = node.app.staking.get_validator(VALIDATOR.bech32_address())
+        v.tokens += 777  # tokens no longer match delegations
+        node.app.staking.set_validator(v)
+        with pytest.raises(InvariantBrokenError, match="delegator-shares"):
+            node.app.assert_invariants()
+
+    def test_unknown_route_rejected(self):
+        with pytest.raises(ValueError, match="unknown invariant"):
+            CrisisKeeper(new_node().app.store).check_invariant("nope")
+
+
+class TestGenesisValidators:
+    def test_genesis_validator_bonded_at_block_one(self):
+        app = App()
+        val = VALIDATOR.bech32_address()
+        app.init_chain(
+            {val: 1_000_000_000_000},
+            genesis_time=0.0,
+            genesis_validators={val: 100_000_000_000},
+        )
+        assert app.staking.get_validator(val).power == 100_000
+        assert app.staking.get_delegation(val, val) == 100_000_000_000
+        app.assert_invariants()
+        node = Node(app)
+        node.produce_block(15.0)
+        node.produce_block(30.0)
+        # the genesis validator signs valsets from the very first blocks
+        assert app.blobstream.latest_valset() is not None
+
+    def test_overbonded_genesis_rejected(self):
+        app = App()
+        val = VALIDATOR.bech32_address()
+        with pytest.raises(ValueError, match="exceeds its genesis balance"):
+            app.init_chain(
+                {val: 100},
+                genesis_validators={val: 200},
+            )
