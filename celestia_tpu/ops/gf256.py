@@ -141,15 +141,10 @@ def log_walsh() -> np.ndarray:
 
 
 def _fwht(data: np.ndarray, m: int) -> None:
-    """In-place fast Walsh-Hadamard transform over Z/255 (mod-255 add/sub)."""
-    dist = 1
-    while dist < m:
-        for i in range(0, m, dist * 2):
-            for j in range(i, i + dist):
-                a, b = int(data[j]), int(data[j + dist])
-                data[j] = (a + b) % K_MODULUS
-                data[j + dist] = (a - b) % K_MODULUS
-        dist *= 2
+    """In-place fast Walsh-Hadamard transform over Z/255 (mod-255 add/sub).
+    Single point of truth is the batched form (slice-views keep the
+    mutation in place)."""
+    _fwht_batch(data[:m][None])
 
 
 def _mul_bytes(y: np.ndarray, log_m: int) -> np.ndarray:
@@ -212,6 +207,142 @@ def leopard_encode(data: np.ndarray) -> np.ndarray:
         dist >>= 1
 
     return work
+
+
+def _level_logs(n: int, dist: int, offset: int) -> np.ndarray:
+    skew = fft_skew()
+    r = np.arange(0, n, dist * 2)
+    return skew[offset + r + dist - 1]
+
+
+def _fwht_batch(data: np.ndarray) -> None:
+    """In-place FWHT over the LAST axis of (A, m), vectorized per level."""
+    m = data.shape[-1]
+    dist = 1
+    while dist < m:
+        v = data.reshape(data.shape[0], -1, 2, dist)
+        a = v[:, :, 0].copy()
+        b = v[:, :, 1]
+        v[:, :, 0] = (a + b) % K_MODULUS
+        v[:, :, 1] = (a - b) % K_MODULUS
+        dist *= 2
+
+
+def _error_locator_logs_batch(erased: np.ndarray) -> np.ndarray:
+    """log of each axis's erasure-locator polynomial evaluated at every
+    field point, via the FWHT trick (Leopard's ErrorBitfield path): FWHT
+    the 0/1 erasure indicator, pointwise mod-255 multiply with the
+    precomputed FWHT of the log table, FWHT back.
+    erased (A, n) 0/1 -> (A, K_ORDER) logs."""
+    a = erased.shape[0]
+    err = np.zeros((a, K_ORDER), dtype=np.int64)
+    err[:, : erased.shape[1]] = erased
+    _fwht_batch(err)
+    err = (err * log_walsh()[None, :]) % K_MODULUS
+    _fwht_batch(err)
+    return err % K_MODULUS
+
+
+def _mul_bytes_batch(rows: np.ndarray, log_ms: np.ndarray) -> np.ndarray:
+    """rows (A, R, ...) uint8, log_ms (A, R) or (R,): per-(batch, row)
+    constant multiply via 256-entry LUT rows (log 255 -> zero row)."""
+    _log, exp = _tables()
+    log_ms = np.broadcast_to(log_ms, rows.shape[:2])
+    consts = np.where(log_ms == K_MODULUS, 0, exp[log_ms]).astype(np.uint8)
+    luts = mul_table()[consts]  # (A, R, 256)
+    a_idx = np.arange(rows.shape[0]).reshape(-1, *((1,) * (rows.ndim - 1)))
+    r_idx = np.arange(rows.shape[1]).reshape(1, -1, *((1,) * (rows.ndim - 2)))
+    return luts[a_idx, r_idx, rows]
+
+
+def leopard_decode_batch(
+    cells: np.ndarray, present: np.ndarray, k: int
+) -> np.ndarray:
+    """Batched O(n log n) Leopard erasure decode.
+
+    cells: (A, 2k, B) uint8 — A independent axes, each with positions
+    [0, k) original data shards and [k, 2k) recovery (parity) shards from
+    leopard_encode. present: (A, 2k) bool, each row with >= k present.
+    Returns the repaired (A, 2k, B) array.
+
+    Follows the published LCH/Leopard erasure-decode recipe: scale the
+    received symbols by the error locator (evaluated via FWHT), full-
+    length IFFT, formal derivative, FFT, then unscale at the erased
+    positions. The transforms' twiddles depend only on (n, level), not on
+    the erasure pattern, so ALL axes ride one vectorized butterfly
+    sequence; only the locator scaling differs per axis. Codeword layout:
+    recovery at FFT positions [0, m), original data at [m, 2m).
+    """
+    a_count = cells.shape[0]
+    m = k
+    n = 2 * k
+    if (present.sum(axis=1) < k).any():
+        raise ValueError("not enough shards to decode")
+    if k == 1:
+        out = np.array(cells, copy=True)
+        need0 = ~present[:, 0]
+        out[need0, 0] = cells[need0, 1]
+        need1 = ~present[:, 1]
+        out[need1, 1] = out[need1, 0]
+        return out
+
+    # erasure indicators in codeword order: [recovery(=parity) | original]
+    erased = np.zeros((a_count, n), dtype=np.int64)
+    erased[:, :m] = ~present[:, k:]
+    erased[:, m:] = ~present[:, :k]
+    loc = _error_locator_logs_batch(erased)
+
+    codeword = np.concatenate([cells[:, k:], cells[:, :k]], axis=1)
+    scale_logs = np.where(erased == 0, loc[:, :n], K_MODULUS)
+    # the transforms and derivative never touch past row n (max formal-
+    # derivative reach is i + width == n), so n rows suffice
+    work = _mul_bytes_batch(codeword, scale_logs)
+
+    # transforms batched over axis 0; per-level twiddles are SHARED across
+    # the batch (they depend on (n, level) only), so the LUT is one
+    # (blocks, 256) table broadcast over A — not materialized per axis
+    def _mul_shared(v_half: np.ndarray, log_ms: np.ndarray) -> np.ndarray:
+        _l, exp = _tables()
+        consts = np.where(log_ms == K_MODULUS, 0, exp[log_ms]).astype(np.uint8)
+        luts = mul_table()[consts]  # (blocks, 256)
+        b_idx = np.arange(len(log_ms)).reshape(
+            1, -1, *((1,) * (v_half.ndim - 2))
+        )
+        return luts[b_idx, v_half]
+
+    dist = 1
+    while dist < n:
+        log_ms = _level_logs(n, dist, 0)
+        v = work[:, :n].reshape(a_count, -1, 2, dist, *work.shape[2:])
+        v[:, :, 1] ^= v[:, :, 0]
+        v[:, :, 0] ^= _mul_shared(v[:, :, 1], log_ms)
+        dist *= 2
+    for i in range(1, n):
+        width = ((i ^ (i - 1)) + 1) >> 1
+        work[:, i - width : i] ^= work[:, i : i + width]
+    dist = n >> 1
+    while dist >= 1:
+        log_ms = _level_logs(n, dist, 0)
+        v = work[:, :n].reshape(a_count, -1, 2, dist, *work.shape[2:])
+        v[:, :, 0] ^= _mul_shared(v[:, :, 1], log_ms)
+        v[:, :, 1] ^= v[:, :, 0]
+        dist >>= 1
+
+    unscale_logs = np.where(
+        erased == 1, (K_MODULUS - loc[:, :n]) % K_MODULUS, K_MODULUS
+    )
+    recovered = _mul_bytes_batch(work[:, :n], unscale_logs)
+    recovered = np.concatenate([recovered[:, m:], recovered[:, :m]], axis=1)
+    out = np.array(cells, copy=True)
+    out[~present] = recovered[~present]
+    return out
+
+
+def leopard_decode(
+    cells: np.ndarray, present: np.ndarray, k: int
+) -> np.ndarray:
+    """Single-axis erasure decode (batch-of-1 leopard_decode_batch)."""
+    return leopard_decode_batch(cells[None], present[None], k)[0]
 
 
 def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:

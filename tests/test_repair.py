@@ -17,6 +17,74 @@ def make_eds(k, seed=0):
     return da.extend_shares(sq)
 
 
+class TestLeopardDecode:
+    """The O(n log n) erasure decode (FWHT locator + IFFT/derivative/FFT)
+    against leopard_encode ground truth and the independent dense solver."""
+
+    def test_randomized_patterns_all_k(self):
+        rng = np.random.default_rng(0)
+        for k in (2, 4, 8, 16, 32, 64):
+            for _ in range(4):
+                data = rng.integers(0, 256, size=(k, 24), dtype=np.uint8)
+                cells = np.concatenate([data, gf256.leopard_encode(data)], axis=0)
+                n_erase = int(rng.integers(1, k + 1))
+                erase = rng.choice(2 * k, size=n_erase, replace=False)
+                present = np.ones(2 * k, dtype=bool)
+                present[erase] = False
+                got = gf256.leopard_decode(
+                    np.where(present[:, None], cells, 0), present, k
+                )
+                assert np.array_equal(got, cells), (k, sorted(erase.tolist()))
+
+    def test_matches_dense_solver(self):
+        from celestia_tpu.da.repair import _solve_axis_dense
+
+        rng = np.random.default_rng(5)
+        k = 16
+        data = rng.integers(0, 256, size=(k, 512), dtype=np.uint8)
+        cells = np.concatenate([data, gf256.leopard_encode(data)], axis=0)
+        present = np.ones(2 * k, dtype=bool)
+        present[rng.choice(2 * k, size=k, replace=False)] = False
+        erased_cells = np.where(present[:, None], cells, 0)
+        fast = gf256.leopard_decode(erased_cells, present, k)
+        dense = _solve_axis_dense(erased_cells, present, k)
+        assert np.array_equal(fast, dense)
+        assert np.array_equal(fast, cells)
+
+    def test_batched_equals_single(self):
+        rng = np.random.default_rng(9)
+        k = 8
+        batch, presents = [], []
+        for _ in range(5):
+            data = rng.integers(0, 256, size=(k, 32), dtype=np.uint8)
+            cells = np.concatenate([data, gf256.leopard_encode(data)], axis=0)
+            present = np.ones(2 * k, dtype=bool)
+            present[rng.choice(2 * k, size=int(rng.integers(1, k + 1)),
+                               replace=False)] = False
+            batch.append(np.where(present[:, None], cells, 0))
+            presents.append(present)
+        batch_arr = np.stack(batch)
+        presents_arr = np.stack(presents)
+        got = gf256.leopard_decode_batch(batch_arr, presents_arr, k)
+        for i in range(5):
+            single = gf256.leopard_decode(batch[i], presents[i], k)
+            assert np.array_equal(got[i], single)
+
+    def test_too_many_erasures_rejected(self):
+        k = 4
+        cells = np.zeros((2 * k, 8), dtype=np.uint8)
+        present = np.zeros(2 * k, dtype=bool)
+        present[: k - 1] = True
+        with pytest.raises(ValueError, match="not enough"):
+            gf256.leopard_decode(cells, present, k)
+
+    def test_k1_trivial_code(self):
+        cells = np.array([[7, 7], [7, 7]], dtype=np.uint8)
+        present = np.array([False, True])
+        got = gf256.leopard_decode(cells, present, 1)
+        assert np.array_equal(got[0], cells[1])
+
+
 class TestGfAlgebra:
     def test_inverse_roundtrip(self):
         rng = np.random.default_rng(1)
