@@ -52,6 +52,7 @@ from celestia_tpu.x.transfer import (
     TransferKeeper,
 )
 from celestia_tpu.x.upgrade import MsgVersionChange, UpgradeKeeper
+from celestia_tpu.x.vesting import MsgCreateVestingAccount, VestingKeeper
 
 from .ante import AnteHandler
 from .context import Context, ExecMode, GasMeter
@@ -143,9 +144,11 @@ class App:
         (the genutil gentx flow: DeliverGenTxs creates the validators
         before the first block — app/app.go:498-499 notes genutil must
         run after staking so pools fund from genesis accounts)."""
+        from celestia_tpu.x.bank import BLOCK_TIME_KEY
         from celestia_tpu.x.blob.keeper import Params
 
         self.blob.set_params(Params())
+        self.store.set(BLOCK_TIME_KEY, repr(float(genesis_time)).encode())
         self.mint.init_genesis(genesis_time)
         for address, amount in (genesis_accounts or {}).items():
             self.accounts.get_or_create(address)
@@ -402,6 +405,10 @@ class App:
         self.block_time = block_time if block_time is not None else self.block_time + 15.0
         self._deliver_store = self.store.branch()
         self._deliver_ctx = self._new_ctx(self._deliver_store, ExecMode.DELIVER)
+        # record consensus time for time-dependent bank checks (vesting)
+        from celestia_tpu.x.bank import BLOCK_TIME_KEY
+
+        self._deliver_store.set(BLOCK_TIME_KEY, repr(float(self.block_time)).encode())
         # BeginBlock state effects go through the deliver branch — they must
         # only reach committed state at Commit (crash-replay determinism).
         store = self._deliver_store
@@ -475,6 +482,8 @@ class App:
             blob_keeper = BlobKeeper(ctx.store)
             blob_keeper.pay_for_blobs(ctx, msg)
         elif isinstance(msg, MsgSend):
+            # the vesting gate lives inside BankKeeper.send (every
+            # outbound path is covered, not just this route)
             BankKeeper(ctx.store).send(
                 msg.from_address, msg.to_address, msg.amount, msg.denom
             )
@@ -513,6 +522,11 @@ class App:
             staking = StakingKeeper(ctx.store, bank)
             staking.hooks.append(BlobstreamKeeper(ctx.store, staking))
             SlashingKeeper(ctx.store, staking).unjail(ctx, msg.validator_address)
+        elif isinstance(msg, MsgCreateVestingAccount):
+            VestingKeeper(ctx.store, BankKeeper(ctx.store)).create_vesting_account(
+                ctx, msg.from_address, msg.to_address, msg.amount,
+                msg.end_time, msg.delayed,
+            )
         elif isinstance(msg, MsgGrantAllowance):
             FeegrantKeeper(ctx.store, BankKeeper(ctx.store)).grant_allowance(
                 msg.to_allowance()

@@ -175,6 +175,53 @@ def _handler_for(node: Node):
                     )
                     proof.validate(block.data_hash)
                     self._reply(_share_proof_json(proof))
+                elif len(parts) == 3 and parts[0] == "namespace_data":
+                    # /namespace_data/<height>/<ns-hex> — the blobs of one
+                    # namespace in a block, each with its share range and
+                    # an inclusion proof (celestia's namespaced-shares
+                    # query surface over pkg/proof)
+                    block = node.get_block(int(parts[1]))
+                    if block is None:
+                        self._reply({"error": "block not found"}, 404)
+                        return
+                    from celestia_tpu import appconsts, square as square_pkg
+                    import celestia_tpu.namespace as ns_mod
+                    from celestia_tpu.proof import new_share_inclusion_proof
+                    from celestia_tpu.shares.parse import parse_blobs
+                    from celestia_tpu.shares.splitters import Range
+
+                    target = ns_mod.from_bytes(bytes.fromhex(parts[2]))
+                    sq = square_pkg.construct(
+                        block.txs, node.app.app_version,
+                        appconsts.square_size_upper_bound(node.app.app_version),
+                    )
+                    ranges = []
+                    start = None
+                    for i, share in enumerate(sq):
+                        if share.namespace() == target and not share.is_padding():
+                            if start is None:
+                                start = i
+                        elif start is not None:
+                            ranges.append(Range(start, i))
+                            start = None
+                    if start is not None:
+                        ranges.append(Range(start, len(sq)))
+                    out = []
+                    for rng in ranges:
+                        proof = new_share_inclusion_proof(sq, target, rng)
+                        proof.validate(block.data_hash)
+                        blobs = parse_blobs(sq[rng.start : rng.end])
+                        out.append(
+                            {
+                                "start": rng.start,
+                                "end": rng.end,
+                                "blobs": [b.data.hex() for b in blobs],
+                                "proof": _share_proof_json(proof),
+                            }
+                        )
+                    self._reply(
+                        {"namespace": target.bytes.hex(), "ranges": out}
+                    )
                 elif parts == ["blobstream", "nonces"]:
                     # ref: LatestAttestationNonce + EarliestAttestationNonce
                     self._reply(

@@ -15,6 +15,9 @@ from celestia_tpu.tx import register_msg
 
 BALANCE_PREFIX = b"bank/balance/"
 SUPPLY_KEY = b"bank/supply/"
+# consensus block time, written by InitChain/BeginBlock — lets the bank
+# evaluate vesting locks without threading a ctx through every call
+BLOCK_TIME_KEY = b"ctx/blockTime"
 
 FEE_COLLECTOR = "fee_collector"
 MINT_MODULE = "mint"
@@ -47,8 +50,26 @@ class BankKeeper:
             raise ValueError(
                 f"insufficient funds: {from_addr} has {bal}{denom}, needs {amount}"
             )
+        # Vesting gate AT the bank boundary (sdk SubUnlockedCoins): every
+        # outbound path — transfers, fees, deposits, IBC escrow — may only
+        # touch the vested portion. The one sdk exemption is delegation
+        # (sends to the bonded pool): staking locked coins is allowed.
+        if denom == BOND_DENOM and to_addr != BONDED_POOL:
+            self._assert_spendable(from_addr, amount)
         self.set_balance(from_addr, bal - amount, denom)
         self.set_balance(to_addr, self.get_balance(to_addr, denom) + amount, denom)
+
+    def _assert_spendable(self, from_addr: str, amount: int) -> None:
+        from celestia_tpu.x.vesting import VestingKeeper
+
+        vk = VestingKeeper(self.store, self)
+        if vk.get_schedule(from_addr) is None:
+            return  # fast path: not a vesting account
+        raw = self.store.get(BLOCK_TIME_KEY)
+        # no recorded consensus time (shouldn't happen post-genesis):
+        # treat everything as still locked — fail closed
+        now = float(raw.decode()) if raw else 0.0
+        vk.assert_spendable(from_addr, amount, now)
 
     def mint(self, to_addr: str, amount: int, denom: str = BOND_DENOM) -> None:
         self.set_balance(to_addr, self.get_balance(to_addr, denom) + amount, denom)

@@ -323,6 +323,154 @@ class TestCrisisInvariants:
             CrisisKeeper(new_node().app.store).check_invariant("nope")
 
 
+class TestVesting:
+    def test_continuous_vesting_lifecycle(self):
+        from celestia_tpu.x.vesting import MsgCreateVestingAccount, VestingKeeper
+
+        node = new_node()
+        alice = ALICE.bech32_address()
+        beneficiary = PrivateKey.from_secret(b"vester")
+        ben = beneficiary.bech32_address()
+        a = Signer.setup_single(ALICE, node)
+        # vest 10M linearly from now (t=30) to t=230
+        res = a.submit_tx(
+            [MsgCreateVestingAccount(alice, ben, 10_000_000, end_time=230.0)]
+        )
+        assert res.code == 0, res.log
+        node.produce_block(30.0)
+
+        vk = VestingKeeper(node.app.store, node.app.bank)
+        assert node.app.bank.get_balance(ben) == 10_000_000
+        assert vk.locked_coins(ben, 30.0) == 10_000_000
+
+        # fund gas separately so fee deduction isn't the blocker
+        a.submit_tx([MsgSend(alice, ben, 1_000_000)])
+        node.produce_block(45.0)
+
+        # at t=130 half has vested (30 -> 230 window)
+        locked = vk.locked_coins(ben, 130.0)
+        assert abs(locked - 5_000_000) <= 10_000
+
+        # sending more than the vested portion fails
+        b_signer = Signer.setup_single(beneficiary, node)
+        b_signer.submit_tx([MsgSend(ben, alice, 9_000_000)])
+        block = node.produce_block(130.0)
+        assert block.tx_results[0].code != 0
+        assert "still vesting" in block.tx_results[0].log
+
+        # sending within the vested portion succeeds
+        b_signer.resync_sequence(node)
+        b_signer.submit_tx([MsgSend(ben, alice, 2_000_000)])
+        block = node.produce_block(145.0)
+        assert block.tx_results[0].code == 0, block.tx_results[0].log
+
+        # after end_time everything is spendable
+        assert vk.locked_coins(ben, 231.0) == 0
+
+    def _vesting_node(self, locked=10_000_000, gas_money=1_000_000):
+        """Node + a beneficiary whose `locked` utia vest far in the future,
+        plus some freely spendable gas money."""
+        from celestia_tpu.x.vesting import MsgCreateVestingAccount
+
+        node = new_node()
+        alice = ALICE.bech32_address()
+        beneficiary = PrivateKey.from_secret(b"vester")
+        a = Signer.setup_single(ALICE, node)
+        a.submit_tx(
+            [MsgCreateVestingAccount(alice, beneficiary.bech32_address(),
+                                     locked, end_time=1e9)]
+        )
+        node.produce_block(30.0)
+        if gas_money:
+            a.submit_tx([MsgSend(alice, beneficiary.bech32_address(), gas_money)])
+            node.produce_block(45.0)
+        return node, beneficiary
+
+    def test_locked_coins_cannot_pay_fees(self):
+        """sdk: fees come only from spendable coins (the gate lives in
+        BankKeeper.send, so the ante's fee deduction is covered)."""
+        node, beneficiary = self._vesting_node(gas_money=0)
+        b = Signer.setup_single(beneficiary, node)
+        res = b.submit_tx(
+            [MsgSend(beneficiary.bech32_address(), ALICE.bech32_address(), 1)],
+            fee=Fee(amount=50_000, gas_limit=200_000),
+        )
+        assert res.code != 0
+        assert "still vesting" in res.log
+
+    def test_locked_coins_cannot_exit_via_ibc(self):
+        from celestia_tpu.testutil.ibc import open_transfer_channel
+        from celestia_tpu.x.transfer import MsgTransfer, escrow_address
+
+        node, beneficiary = self._vesting_node()
+        node_b = new_node()
+        open_transfer_channel(node.app, node_b.app)
+        b = Signer.setup_single(beneficiary, node)
+        b.submit_tx(
+            [MsgTransfer("transfer", "channel-0", "utia", 5_000_000,
+                         beneficiary.bech32_address(), ALICE.bech32_address())]
+        )
+        block = node.produce_block(60.0)
+        assert block.tx_results[0].code != 0
+        assert "still vesting" in block.tx_results[0].log
+        assert node.app.bank.get_balance(escrow_address("transfer", "channel-0")) == 0
+
+    def test_locked_coins_cannot_fund_new_vesting_account(self):
+        """Laundering defense: re-vesting locked coins into a fresh
+        account with an immediate end_time must fail at the bank gate."""
+        from celestia_tpu.x.vesting import MsgCreateVestingAccount
+
+        node, beneficiary = self._vesting_node()
+        fresh = PrivateKey.from_secret(b"launder").bech32_address()
+        b = Signer.setup_single(beneficiary, node)
+        b.submit_tx(
+            [MsgCreateVestingAccount(beneficiary.bech32_address(), fresh,
+                                     5_000_000, end_time=61.0)]
+        )
+        block = node.produce_block(60.0)
+        assert block.tx_results[0].code != 0
+        assert "still vesting" in block.tx_results[0].log
+        assert node.app.bank.get_balance(fresh) == 0
+
+    def test_locked_coins_can_be_delegated(self):
+        """The one sdk exemption: staking locked coins is allowed."""
+        node, beneficiary = self._vesting_node()
+        val = VALIDATOR.bech32_address()
+        vs = Signer.setup_single(VALIDATOR, node)
+        vs.submit_tx([MsgDelegate(val, val, 5_000_000)])
+        node.produce_block(60.0)
+        b = Signer.setup_single(beneficiary, node)
+        b.submit_tx(
+            [MsgDelegate(beneficiary.bech32_address(), val, 8_000_000)]
+        )
+        block = node.produce_block(75.0)
+        assert block.tx_results[0].code == 0, block.tx_results[0].log
+        assert node.app.staking.get_delegation(
+            beneficiary.bech32_address(), val
+        ) == 8_000_000
+
+    def test_delayed_vesting_all_locked_until_end(self):
+        from celestia_tpu.x.vesting import VestingSchedule
+
+        s = VestingSchedule("addr", 100, start_time=0.0, end_time=50.0,
+                            delayed=True)
+        assert s.locked(49.9) == 100
+        assert s.locked(50.0) == 0
+
+    def test_cannot_overwrite_existing_account(self):
+        from celestia_tpu.x.vesting import MsgCreateVestingAccount
+
+        node = new_node()
+        alice, bob = ALICE.bech32_address(), BOB.bech32_address()
+        a = Signer.setup_single(ALICE, node)
+        a.submit_tx(
+            [MsgCreateVestingAccount(alice, bob, 1_000, end_time=500.0)]
+        )
+        block = node.produce_block(30.0)
+        assert block.tx_results[0].code != 0
+        assert "already exists" in block.tx_results[0].log
+
+
 class TestGenesisValidators:
     def test_genesis_validator_bonded_at_block_one(self):
         app = App()

@@ -170,6 +170,27 @@ class TestRpc:
             )
             assert proof["row_proof"]["row_roots"]
             assert proof["share_proofs"]
+
+            # namespace data query: the blob comes back with its range
+            # and a server-validated inclusion proof
+            nshex = ns.new_v0(b"rpc-test").bytes.hex()
+            nd = json.loads(
+                urllib.request.urlopen(
+                    f"{base}/namespace_data/{block['height']}/{nshex}"
+                ).read()
+            )
+            assert nd["namespace"] == nshex
+            assert len(nd["ranges"]) == 1
+            assert bytes.fromhex(nd["ranges"][0]["blobs"][0]) == b"\x33" * 100
+            assert nd["ranges"][0]["proof"]["share_proofs"]
+            # absent namespace -> empty ranges
+            other = ns.new_v0(b"absent-ns").bytes.hex()
+            nd2 = json.loads(
+                urllib.request.urlopen(
+                    f"{base}/namespace_data/{block['height']}/{other}"
+                ).read()
+            )
+            assert nd2["ranges"] == []
         finally:
             server.stop()
 
