@@ -69,6 +69,12 @@ def _build_node(home: pathlib.Path):
     genesis = json.loads((home / "genesis.json").read_text())
     if (home / "meta.json").exists():
         return Node.load(str(home))
+    if (home / "blocks").exists() and any((home / "blocks").glob("*.json")):
+        raise RuntimeError(
+            f"{home} has persisted blocks but no state snapshot "
+            "(meta.json) — refusing to re-initialize from genesis over an "
+            "existing chain. Restore meta.json/state.json or clear blocks/."
+        )
     if "app_state" in genesis:
         # genesis produced by `export` — rebuild the full module state
         from celestia_tpu.app.export import import_genesis
@@ -104,11 +110,20 @@ def cmd_start(args):
     print(f"node started: chain {node.app.chain_id} height {node.latest_height()} "
           f"rpc http://127.0.0.1:{server.port} "
           f"min-gas-price {cfg.app.min_gas_price}")
+    # an initial snapshot so a hard crash before the first interval never
+    # leaves blocks-without-meta (which _build_node refuses to re-init)
+    node.save_snapshot()
+    # SDK semantics: snapshot-interval 0 disables periodic snapshots
+    # (crash recovery then replays the whole block store)
+    snapshot_interval = cfg.app.state_sync.snapshot_interval
     try:
         while True:
             time.sleep(cfg.consensus.goal_block_time_seconds)
             block = node.produce_block()
-            node.save_snapshot()
+            # disk snapshots on the configured StateSync cadence; the
+            # block store itself is persisted per block by produce_block
+            if snapshot_interval and block.height % snapshot_interval == 0:
+                node.save_snapshot()
             print(f"height {block.height} txs {len(block.txs)} "
                   f"square {block.square_size} data {block.data_hash.hex()[:16]}")
     except KeyboardInterrupt:

@@ -216,31 +216,87 @@ class Node:
     def latest_height(self) -> int:
         return self.app.height
 
+    # --- state sync (serve + bootstrap) ---
+
+    def snapshot_payload(self) -> dict:
+        """The state-sync snapshot a peer can bootstrap from (SDK
+        snapshot store analogue, served at GET /snapshot): committed
+        state + the metadata needed to verify and resume."""
+        with self._lock:
+            # under the node lock no block can commit mid-assembly, so the
+            # advertised app_hash and the state dump are one snapshot
+            return {
+                **self._meta(),
+                "app_hash": self.app.store.app_hashes.get(
+                    self.app.store.version, b""
+                ).hex(),
+                "state": self.app.store.snapshot().hex(),
+            }
+
+    def _meta(self) -> dict:
+        return {
+            "height": self.app.height,
+            "chain_id": self.app.chain_id,
+            "app_version": self.app.app_version,
+            "block_time": self.app.block_time,
+        }
+
+    @staticmethod
+    def _restore_app(meta: dict, state_bytes: bytes, **app_kwargs) -> App:
+        """Shared restore path for disk resume and state sync: App +
+        restored store + every keeper rebound + resume position."""
+        from celestia_tpu.state import StateStore
+
+        app = App(chain_id=meta["chain_id"], app_version=meta["app_version"],
+                  **app_kwargs)
+        app.rebind_store(StateStore.restore(state_bytes))
+        app.height = meta["height"]
+        app.block_time = meta["block_time"]
+        return app
+
+    @classmethod
+    def state_sync_from(cls, payload: dict, home: str | None = None,
+                        trusted_app_hash: bytes | str | None = None,
+                        **app_kwargs) -> "Node":
+        """Bootstrap a fresh node from a peer's snapshot payload.
+
+        Pass `trusted_app_hash` (from a source you already trust — a
+        verified header, a checkpoint) to authenticate the snapshot the
+        way real state sync does. Without it, the payload's own app_hash
+        is checked, which only detects transport corruption — a
+        malicious peer controls both fields."""
+        app = cls._restore_app(payload, bytes.fromhex(payload["state"]),
+                               **app_kwargs)
+        computed = app.store.app_hashes[app.store.version]
+        expected = trusted_app_hash if trusted_app_hash is not None \
+            else payload["app_hash"]
+        if isinstance(expected, bytes):
+            expected = expected.hex()
+        if computed.hex() != expected:
+            raise ValueError(
+                "snapshot app hash mismatch: expected "
+                f"{expected}, state restores to {computed.hex()}"
+            )
+        log.info("state synced", height=app.height, app_hash=computed,
+                 authenticated=trusted_app_hash is not None)
+        return cls(app, home=home)
+
     # --- checkpoint / resume ---
 
     def save_snapshot(self) -> None:
         if not self.home:
             raise ValueError("node has no home directory")
-        (self.home / "state.json").write_bytes(self.app.store.snapshot())
-        meta = {
-            "height": self.app.height,
-            "block_time": self.app.block_time,
-            "app_version": self.app.app_version,
-            "chain_id": self.app.chain_id,
-        }
-        (self.home / "meta.json").write_text(json.dumps(meta))
+        with self._lock:
+            (self.home / "state.json").write_bytes(self.app.store.snapshot())
+            (self.home / "meta.json").write_text(json.dumps(self._meta()))
 
     @classmethod
     def load(cls, home: str, **app_kwargs) -> "Node":
-        from celestia_tpu.state import StateStore
-
         home_path = pathlib.Path(home)
         meta = json.loads((home_path / "meta.json").read_text())
-        app = App(chain_id=meta["chain_id"], app_version=meta["app_version"],
-                  **app_kwargs)
-        app.rebind_store(StateStore.restore((home_path / "state.json").read_bytes()))
-        app.height = meta["height"]
-        app.block_time = meta["block_time"]
+        app = cls._restore_app(
+            meta, (home_path / "state.json").read_bytes(), **app_kwargs
+        )
         node = cls(app, home=home)
         for path in sorted((home_path / "blocks").glob("*.json"),
                            key=lambda p: int(p.stem)):
@@ -248,4 +304,23 @@ class Node:
             node.blocks[block.height] = block
             for i, raw in enumerate(block.txs):
                 node.tx_index[tx_hash(raw)] = (block.height, i)
+        # Crash recovery: snapshots are taken on the StateSync cadence,
+        # so the persisted block store can be AHEAD of the state
+        # snapshot — replay the newer blocks through the app (the WAL
+        # replay the reference gets from cometbft), verifying each
+        # replayed commit against the stored app hash.
+        for height in sorted(h for h in node.blocks if h > app.height):
+            block = node.blocks[height]
+            app.begin_block(block.time)
+            for raw in block.txs:
+                app.deliver_tx(raw)
+            app.end_block()
+            app_hash = app.commit()
+            if app_hash != block.app_hash:
+                raise ValueError(
+                    f"replayed block {height} commits app hash "
+                    f"{app_hash.hex()}, stored block has "
+                    f"{block.app_hash.hex()} — state corruption"
+                )
+            log.info("replayed block", height=height, app_hash=app_hash)
         return node

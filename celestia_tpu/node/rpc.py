@@ -175,6 +175,10 @@ def _handler_for(node: Node):
                     )
                     proof.validate(block.data_hash)
                     self._reply(_share_proof_json(proof))
+                elif parts == ["snapshot"]:
+                    # state-sync snapshot serving (SDK snapshot store /
+                    # StateSync config — app/default_overrides.go:265)
+                    self._reply(node.snapshot_payload())
                 elif len(parts) == 3 and parts[0] == "namespace_data":
                     # /namespace_data/<height>/<ns-hex> — the blobs of one
                     # namespace in a block, each with its share range and
