@@ -17,6 +17,7 @@ from celestia_tpu.crypto import PrivateKey
 from celestia_tpu.tx import Fee
 from celestia_tpu.user import Signer
 from celestia_tpu.x.bank import MsgSend
+from celestia_tpu.x.staking import MsgDelegate, MsgUndelegate
 
 
 class Sequence:
@@ -60,6 +61,37 @@ class SendSequence(Sequence):
         return self.signer.submit_tx(
             [MsgSend(self.signer.address(), to, self.amount)],
             Fee(amount=200_000, gas_limit=200_000),
+        )
+
+
+@dataclasses.dataclass
+class StakeSequence(Sequence):
+    """Staking op stream: delegate, then randomly undelegate portions —
+    exercising valset/blobstream churn. ref: test/txsim/stake.go
+
+    The undelegatable amount is read from COMMITTED chain state rather
+    than tracked from CheckTx results: a tx can pass CheckTx and still
+    be dropped from a full square or fail at DeliverTx, so client-side
+    counters drift."""
+
+    validator: str = ""
+    initial_stake: int = 5_000_000
+
+    def next_tx(self):
+        fee = Fee(amount=200_000, gas_limit=200_000)
+        delegated = self.signer.transport.app.staking.get_delegation(
+            self.signer.address(), self.validator
+        )
+        if delegated == 0 or self.rng.random() < 0.7:
+            return self.signer.submit_tx(
+                [MsgDelegate(self.signer.address(), self.validator,
+                             self.initial_stake)],
+                fee,
+            )
+        amount = int(self.rng.integers(1, delegated + 1))
+        return self.signer.submit_tx(
+            [MsgUndelegate(self.signer.address(), self.validator, amount)],
+            fee,
         )
 
 

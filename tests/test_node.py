@@ -89,16 +89,26 @@ class TestNode:
         assert len(node.mempool) == 0
 
     def test_txsim(self):
+        from celestia_tpu.txsim import StakeSequence
+        from celestia_tpu.x.staking import MsgDelegate
+
         node = new_node()
+        val = VALIDATOR.bech32_address()
+        vs = Signer.setup_single(VALIDATOR, node)
+        vs.submit_tx([MsgDelegate(val, val, 5_000_000)])
+        node.produce_block()
         stats = txsim_run(
             node,
             VALIDATOR,
-            [BlobSequence(size_min=100, size_max=2000), SendSequence(amount=5)],
+            [BlobSequence(size_min=100, size_max=2000), SendSequence(amount=5),
+             StakeSequence(validator=val)],
             rounds=3,
         )
-        assert stats["accepted"] == 6
+        assert stats["accepted"] == 9
         assert stats["rejected"] == 0
-        assert node.latest_height() >= 4
+        assert node.latest_height() >= 5
+        # the stake churn reached the validator set
+        assert node.app.staking.get_validator(val).tokens > 5_000_000
 
     def test_checkpoint_resume(self, tmp_path):
         node = new_node(tmp_path)
