@@ -175,6 +175,49 @@ def _handler_for(node: Node):
                     )
                     proof.validate(block.data_hash)
                     self._reply(_share_proof_json(proof))
+                elif len(parts) == 2 and parts[0] == "params":
+                    # module param queries (grpc-gateway Params analogue)
+                    module = parts[1]
+                    if module == "blob":
+                        p = node.app.blob.get_params()
+                        self._reply(
+                            {
+                                "gas_per_blob_byte": p.gas_per_blob_byte,
+                                "gov_max_square_size": p.gov_max_square_size,
+                            }
+                        )
+                    elif module == "blobstream":
+                        self._reply(
+                            {
+                                "data_commitment_window":
+                                    node.app.blobstream.data_commitment_window,
+                            }
+                        )
+                    elif module == "staking":
+                        from celestia_tpu.appconsts import BOND_DENOM
+
+                        self._reply(
+                            {
+                                "bond_denom": BOND_DENOM,
+                                "unbonding_time_seconds":
+                                    node.app.staking.unbonding_time,
+                            }
+                        )
+                    elif module == "gov":
+                        from celestia_tpu.x import gov as gov_mod
+
+                        self._reply(
+                            {
+                                "min_deposit": gov_mod.MIN_DEPOSIT,
+                                "voting_period_seconds": gov_mod.VOTING_PERIOD,
+                                "quorum": gov_mod.QUORUM / gov_mod.ONE,
+                                "threshold": gov_mod.THRESHOLD / gov_mod.ONE,
+                                "veto_threshold":
+                                    gov_mod.VETO_THRESHOLD / gov_mod.ONE,
+                            }
+                        )
+                    else:
+                        self._reply({"error": f"unknown module {module}"}, 404)
                 elif parts == ["snapshot"]:
                     # state-sync snapshot serving (SDK snapshot store /
                     # StateSync config — app/default_overrides.go:265)

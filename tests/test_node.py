@@ -289,6 +289,22 @@ class TestRpc:
                 ).read()
             )
             assert nd2["ranges"] == []
+
+            # module param queries
+            bp = json.loads(urllib.request.urlopen(f"{base}/params/blob").read())
+            assert bp["gas_per_blob_byte"] == 8
+            assert bp["gov_max_square_size"] == 64
+            sp = json.loads(urllib.request.urlopen(f"{base}/params/staking").read())
+            assert sp["bond_denom"] == "utia"
+            assert sp["unbonding_time_seconds"] == 3 * 7 * 24 * 3600
+            gp = json.loads(urllib.request.urlopen(f"{base}/params/gov").read())
+            assert gp["voting_period_seconds"] == 7 * 24 * 3600
+            bsp = json.loads(
+                urllib.request.urlopen(f"{base}/params/blobstream").read()
+            )
+            assert bsp["data_commitment_window"] == 400
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"{base}/params/nope")
         finally:
             server.stop()
 
