@@ -240,8 +240,9 @@ class App:
                 inner_raw = raw_tx
             else:
                 if not recheck:
-                    validate_blob_tx(btx)
-                tx = Tx.unmarshal(btx.tx)
+                    tx = validate_blob_tx(btx)  # returns the decoded tx
+                else:
+                    tx = Tx.unmarshal(btx.tx)
                 inner_raw = btx.tx
 
             if self._check_store is None:
@@ -314,7 +315,14 @@ class App:
         )
 
     def filter_txs(self, ctx: Context, txs: list[bytes]) -> list[bytes]:
-        """Drop ante-failing txs. ref: app/validate_txs.go:30-35"""
+        """Drop ante-failing txs. ref: app/validate_txs.go:30-35.
+
+        Unlike the reference (which trusts that CheckTx already ran
+        ValidateBlobTx on everything in the mempool), blob txs are
+        re-validated here too: a proposer handed an unchecked tx with a
+        tampered blob would otherwise build a proposal its own
+        ProcessProposal rejects — a liveness footgun for zero safety
+        benefit. The recompute is cheap next to the square extend."""
         ante = self._ante()
         kept_normal: list[bytes] = []
         kept_blob: list[bytes] = []
@@ -322,7 +330,7 @@ class App:
             btx, is_blob = blob_pkg.unmarshal_blob_tx(raw)
             inner = btx.tx if is_blob else raw
             try:
-                tx = decode_tx(inner)
+                tx = validate_blob_tx(btx) if is_blob else decode_tx(inner)
                 ante(ctx, tx, len(inner))
             except Exception:  # noqa: BLE001
                 continue
@@ -375,7 +383,7 @@ class App:
                 ante(ctx, tx, len(inner))
                 continue
 
-            validate_blob_tx(btx)
+            validate_blob_tx(btx, sdk_tx=tx)
             ante(ctx, tx, len(inner))
 
         data_square = square_pkg.construct(

@@ -160,12 +160,16 @@ def new_msg_pay_for_blobs(signer: str, *blobs: blob_pkg.Blob) -> MsgPayForBlobs:
     return msg
 
 
-def validate_blob_tx(btx: blob_pkg.BlobTx) -> MsgPayForBlobs:
+def validate_blob_tx(btx: blob_pkg.BlobTx, sdk_tx=None):
     """Stateless BlobTx<->PFB consistency + commitment recompute.
-    Returns the validated PFB msg. ref: x/blob/types/blob_tx.go:36-103"""
+
+    Accepts (and returns) the decoded inner Tx so hot-path callers that
+    already decoded it don't pay a second protobuf parse.
+    ref: x/blob/types/blob_tx.go:36-103"""
     from celestia_tpu.tx import Tx
 
-    sdk_tx = Tx.unmarshal(btx.tx)
+    if sdk_tx is None:
+        sdk_tx = Tx.unmarshal(btx.tx)
     msgs = sdk_tx.msgs
     if len(msgs) != 1:
         raise ValueError("multiple msgs in blob tx not supported")
@@ -191,7 +195,7 @@ def validate_blob_tx(btx: blob_pkg.BlobTx) -> MsgPayForBlobs:
         calculated = inclusion.create_commitment(btx.blobs[i])
         if calculated != commitment:
             raise ValueError("invalid share commitment")
-    return msg
+    return sdk_tx
 
 
 def pfb_blob_sizes(inner_tx: bytes) -> list[int]:
