@@ -217,6 +217,46 @@ def bench_repair(k: int, erase_frac: float = 0.25):
     return {"host_ms": round(best * 1e3, 3), "recovered": bool(ok)}
 
 
+def bench_batched_throughput(k: int, batch: int = 8):
+    """Supplementary: multi-square throughput (state sync / replay / many
+    proposals), vmapped batch on one chip. The HEADLINE stays the
+    unbatched single-call number. Measured honestly both ways: batching
+    amortizes dispatch for small squares (k=32: ~0.65 vs 0.76 ms/square)
+    but HURTS at k=128 (batch x 32 MB EDS working set pressures HBM:
+    ~7.7 vs ~5 ms/square) — the per-block path is already the fast one."""
+    import jax
+    import jax.numpy as jnp
+
+    from celestia_tpu.ops import extend_tpu, rs_tpu
+
+    m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
+
+    @jax.jit
+    def run(batched):
+        return extend_tpu.extend_and_root_batched(batched, m2)
+
+    import numpy as _np
+
+    devs = [
+        jax.device_put(
+            _np.stack([build_square(k, seed=100 + 17 * b + i) for i in range(batch)])
+        )
+        for b in range(4)
+    ]
+
+    def fetch(r):
+        return _np.asarray(r[3])
+
+    per_batch_ms = _slope(lambda i: run(devs[i % 4]), fetch, n1=4, n2=24)
+    if per_batch_ms <= 0:
+        return {"batch": batch, "note": "below tunnel measurement noise"}
+    return {
+        "batch": batch,
+        "tpu_ms_per_batch": round(per_batch_ms, 3),
+        "tpu_ms_per_square": round(per_batch_ms / batch, 3),
+    }
+
+
 def bench_codec_service(k: int = 32):
     """Codec service boundary (SURVEY P2): round-trip overhead of the
     gRPC sidecar vs the same backend called in-process, measured on
@@ -285,6 +325,9 @@ def main():
     configs["4_repair_k128_25pct"] = bench_repair(128)
     configs["5_nmt_only_k128"] = bench_nmt_only(128)
     configs["6_codec_service_k32"] = bench_codec_service(32)
+    configs["7a_batched_throughput_k32"] = bench_batched_throughput(32)
+    configs[f"7b_batched_throughput_k{headline_k}"] = \
+        bench_batched_throughput(headline_k)
 
     for name, cfg in configs.items():
         if "parity" in cfg:

@@ -331,6 +331,10 @@ class App:
             inner = btx.tx if is_blob else raw
             try:
                 tx = validate_blob_tx(btx) if is_blob else decode_tx(inner)
+                if not is_blob and any(
+                    isinstance(m, MsgPayForBlobs) for m in tx.msgs
+                ):
+                    continue  # bare PFB: ProcessProposal would reject it
                 ante(ctx, tx, len(inner))
             except Exception:  # noqa: BLE001
                 continue
@@ -362,29 +366,36 @@ class App:
 
         for idx, raw_tx in enumerate(block_data.txs):
             btx, is_blob = blob_pkg.unmarshal_blob_tx(raw_tx)
-            inner = btx.tx if is_blob else raw_tx
-            try:
-                tx = decode_tx(inner)
-            except Exception:  # noqa: BLE001 — undecodable txs are not a
-                continue  # block validity rule
-
-            if not is_blob:
-                if any(isinstance(m, MsgPayForBlobs) for m in tx.msgs):
-                    return False  # non-blob tx carrying a PFB
-                version = MsgVersionChange.from_msgs(tx.msgs)
-                if version is not None:
-                    if idx != 0:
-                        return False  # upgrade msg must be the first tx
-                    if version not in self.SUPPORTED_VERSIONS:
-                        return False
-                    if version <= self.app_version:
-                        return False
-                    continue
-                ante(ctx, tx, len(inner))
+            if is_blob:
+                # STRICT decode of the inner tx (Tx.unmarshal, never the
+                # IndexWrapper-tolerant decode_tx): a BlobTx whose inner
+                # tx is index-wrapped is invalid here, and accepting it
+                # would widen the consensus validity rule and break block
+                # deconstruction downstream.
+                try:
+                    tx = Tx.unmarshal(btx.tx)
+                except Exception:  # noqa: BLE001 — undecodable txs are
+                    continue  # not a block validity rule
+                validate_blob_tx(btx, sdk_tx=tx)
+                ante(ctx, tx, len(btx.tx))
                 continue
 
-            validate_blob_tx(btx, sdk_tx=tx)
-            ante(ctx, tx, len(inner))
+            try:
+                tx = decode_tx(raw_tx)
+            except Exception:  # noqa: BLE001
+                continue
+            if any(isinstance(m, MsgPayForBlobs) for m in tx.msgs):
+                return False  # non-blob tx carrying a PFB
+            version = MsgVersionChange.from_msgs(tx.msgs)
+            if version is not None:
+                if idx != 0:
+                    return False  # upgrade msg must be the first tx
+                if version not in self.SUPPORTED_VERSIONS:
+                    return False
+                if version <= self.app_version:
+                    return False
+                continue
+            ante(ctx, tx, len(raw_tx))
 
         data_square = square_pkg.construct(
             block_data.txs, self.app_version, self.gov_square_size_upper_bound()

@@ -127,6 +127,14 @@ class Builder:
         return False
 
     def append_blob_tx(self, blob_tx: blob_pkg.BlobTx) -> bool:
+        # The inner tx must not already be index-wrapped: the builder adds
+        # the (single) IndexWrapper layer itself, and a double-wrapped tx
+        # would crash deconstruction and diverge from what any honest
+        # proposer can produce. Treated as invalid input (build drops it,
+        # construct rejects the whole square).
+        _iw, already_wrapped = blob_pkg.unmarshal_index_wrapper(blob_tx.tx)
+        if already_wrapped:
+            raise ValueError("blob tx inner is already index-wrapped")
         iw = blob_pkg.IndexWrapper(
             tx=blob_tx.tx,
             share_indexes=_worst_case_share_indexes(len(blob_tx.blobs), self.app_version),
@@ -301,7 +309,11 @@ def build(txs: list[bytes], app_version: int, max_square_size: int) -> tuple[Squ
     for tx in txs:
         blob_tx, is_blob_tx = blob_pkg.unmarshal_blob_tx(tx)
         if is_blob_tx:
-            if builder.append_blob_tx(blob_tx):
+            try:
+                appended = builder.append_blob_tx(blob_tx)
+            except ValueError:
+                continue  # invalid blob tx (e.g. double-wrapped inner): drop
+            if appended:
                 blob_txs.append(tx)
         else:
             if builder.append_tx(tx):

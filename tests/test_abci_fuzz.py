@@ -130,6 +130,55 @@ class TestAbciFuzz:
         # the good tx survived the filter
         assert len(proposal.txs) >= 1
 
+    def test_index_wrapped_inner_blob_tx_rejected(self):
+        """A BlobTx whose inner tx is IndexWrapper-wrapped must be treated
+        as invalid (skipped by the strict inner decode), NOT accepted via
+        the wrapper-tolerant decoder — accepting it would widen the
+        consensus validity rule and break block deconstruction."""
+        from celestia_tpu.blob import (
+            marshal_blob_tx,
+            marshal_index_wrapper,
+            unmarshal_blob_tx,
+        )
+
+        node = new_node()
+        raw = valid_blob_tx(node)
+        btx, is_blob = unmarshal_blob_tx(raw)
+        assert is_blob
+        evil = marshal_blob_tx(marshal_index_wrapper(btx.tx, [5]), btx.blobs)
+        # CheckTx refuses it
+        assert node.app.check_tx(evil).code != 0
+        # the proposer path drops it
+        good = valid_blob_tx(node)
+        proposal = node.app.prepare_proposal([evil, good])
+        assert node.app.process_proposal(proposal)
+        assert evil not in proposal.txs
+        # and a BYZANTINE hand-built block containing it is rejected
+        # outright: the square builder refuses double-wrapped inners, so
+        # construct (and therefore the data hash) can never match
+        from celestia_tpu.app.app import ProposalBlockData
+
+        fake = ProposalBlockData(txs=[evil], square_size=2, hash=b"\x00" * 32)
+        assert node.app.process_proposal(fake) is False
+
+    def test_bare_pfb_dropped_by_filter_not_proposed(self):
+        """A PFB submitted WITHOUT the BlobTx envelope must never reach a
+        proposal (ProcessProposal rejects blocks carrying one): the
+        filter drops it, keeping the proposer live."""
+        from celestia_tpu.tx import Fee, sign_tx
+        from celestia_tpu.x.blob.types import estimate_gas, new_msg_pay_for_blobs
+
+        node = new_node()
+        signer = Signer.setup_single(ALICE, node)
+        b = blob_pkg.new_blob(ns.new_v0(b"bare-pfb"), b"\x01" * 300, 0)
+        msg = new_msg_pay_for_blobs(signer.address(), b)
+        gas = estimate_gas([300])
+        bare = sign_tx(ALICE, [msg], node.app.chain_id, signer.account_number,
+                       signer.sequence, Fee(amount=gas, gas_limit=gas)).marshal()
+        proposal = node.app.prepare_proposal([bare])
+        assert bare not in proposal.txs
+        assert node.app.process_proposal(proposal)  # own proposal accepted
+
     def test_envelope_malleability_is_consensus_safe(self):
         """Known, reference-faithful behavior: the BlobTx ENVELOPE is not
         signed, and protobuf parsing tolerates unknown trailing fields —
