@@ -36,6 +36,7 @@ class TxOptions:
     fee: int = 0  # utia; 0 = derive from gas_price * gas_limit
     gas_price: float = appconsts.DEFAULT_MIN_GAS_PRICE
     fee_payer: str = ""  # optional explicit payer (must co-sign)
+    fee_granter: str = ""  # x/feegrant: this account's allowance pays
 
     def resolve_fee(self, gas_limit: int) -> int:
         if self.fee:
@@ -97,10 +98,9 @@ class Signer:
                 new_price = apperrors.parse_insufficient_min_gas_price(
                     last.log, old_price, fee.gas_limit
                 )
-                fee = Fee(
+                fee = dataclasses.replace(
+                    fee,
                     amount=apperrors.fee_for_gas_price(new_price, fee.gas_limit),
-                    gas_limit=fee.gas_limit,
-                    payer=fee.payer,
                 )
                 continue
             return last  # not a retryable failure
@@ -114,7 +114,7 @@ class Signer:
             self._check_fee_payer(opts)
             gas = opts.gas_limit or DEFAULT_GAS_LIMIT
             fee = Fee(amount=opts.resolve_fee(gas), gas_limit=gas,
-                      payer=opts.fee_payer)
+                      payer=opts.fee_payer, granter=opts.fee_granter)
         return self._broadcast_with_recovery(msgs, fee)
 
     def submit_pay_for_blob(self, blobs: list[blob_pkg.Blob],
@@ -127,7 +127,7 @@ class Signer:
             self._check_fee_payer(opts)
             gas = opts.gas_limit or estimate_gas([len(b.data) for b in blobs])
             fee = Fee(amount=opts.resolve_fee(gas), gas_limit=gas,
-                      payer=opts.fee_payer)
+                      payer=opts.fee_payer, granter=opts.fee_granter)
         return self._broadcast_with_recovery([msg], fee, wrap_blobs=blobs)
 
     def _check_fee_payer(self, opts: TxOptions) -> None:

@@ -158,6 +158,31 @@ class TestFeegrant:
         assert res.code != 0
         assert "only cover utia" in res.log
 
+    def test_signer_fee_granter_option(self):
+        """The client surface: a near-empty account transacts via
+        TxOptions(fee_granter=...) against an allowance."""
+        from celestia_tpu.user import TxOptions
+
+        node = new_node()
+        alice = ALICE.bech32_address()
+        poor = PrivateKey.from_secret(b"poor-account")
+        a = Signer.setup_single(ALICE, node)
+        a.submit_tx([MsgSend(alice, poor.bech32_address(), 50)])
+        a.submit_tx([MsgGrantAllowance(alice, poor.bech32_address(),
+                                       spend_limit=1_000_000)])
+        node.produce_block(30.0)
+        p = Signer.setup_single(poor, node)
+        res = p.submit_tx(
+            [MsgSend(poor.bech32_address(), alice, 10)],
+            opts=TxOptions(gas_limit=200_000,
+                           fee_granter=alice),
+        )
+        assert res.code == 0, res.log
+        block = node.produce_block(45.0)
+        assert block.tx_results[0].code == 0
+        # the poor account paid only the send, never the fee
+        assert node.app.bank.get_balance(poor.bech32_address()) == 40
+
     def test_revoke(self):
         node = new_node()
         alice, carol = ALICE.bech32_address(), CAROL.bech32_address()
