@@ -64,10 +64,14 @@ class ExtendedDataSquare:
         return [_axis_root(self.col(j), j, self.original_width) for j in range(self.width)]
 
 
-def _axis_root(cells: list[bytes], axis_index: int, k: int) -> bytes:
-    """NMT root of one row/column, with the wrapper's quadrant namespace rule
-    (pkg/wrapper/nmt_wrapper.go:93-114): leaf = ns ‖ share where ns is the
-    share's own namespace in Q0 and the parity namespace otherwise."""
+def erasured_axis_leaves(
+    cells: list[bytes], axis_index: int, k: int
+) -> list[bytes]:
+    """Namespaced NMT leaves of one row/column with the wrapper's quadrant
+    rule (pkg/wrapper/nmt_wrapper.go:93-114): leaf = ns ‖ share where ns is
+    the share's own namespace in Q0 and the parity namespace otherwise.
+    The single source of the rule — roots, range proofs and absence proofs
+    all consume it."""
     leaves = []
     for share_index, cell in enumerate(cells):
         if axis_index < k and share_index < k:
@@ -75,7 +79,11 @@ def _axis_root(cells: list[bytes], axis_index: int, k: int) -> bytes:
         else:
             nid = PARITY_NS
         leaves.append(nid + cell)
-    return nmt_root(leaves)
+    return leaves
+
+
+def _axis_root(cells: list[bytes], axis_index: int, k: int) -> bytes:
+    return nmt_root(erasured_axis_leaves(cells, axis_index, k))
 
 
 def extend_shares(shares: list[bytes] | np.ndarray) -> ExtendedDataSquare:

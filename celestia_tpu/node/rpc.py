@@ -266,9 +266,70 @@ def _handler_for(node: Node):
                                 "proof": _share_proof_json(proof),
                             }
                         )
-                    self._reply(
-                        {"namespace": target.bytes.hex(), "ranges": out}
-                    )
+                    reply = {"namespace": target.bytes.hex(), "ranges": out}
+                    if not out:
+                        if (
+                            target.is_parity_shares()
+                            or target.is_tail_padding()
+                            or target.is_primary_reserved_padding()
+                        ):
+                            # padding/parity namespaces carry no user data
+                            # by construction and their leaves DO appear in
+                            # rows, so "absence" is not a meaningful query
+                            self._reply(
+                                {"error": "reserved padding/parity "
+                                          "namespace holds no user data"},
+                                400,
+                            )
+                            return
+                        # nmt absence proofs for every DAH row whose root
+                        # range covers the namespace; each row root is
+                        # authenticated to the block's data root with a
+                        # merkle proof (same trust chain as inclusion).
+                        # Rows not covering prove absence by the ordered
+                        # root ranges alone. Parity rows (i >= k) have
+                        # min == max == the parity namespace and never
+                        # cover a user namespace.
+                        from celestia_tpu import da as da_mod
+                        from celestia_tpu.proof import (
+                            merkle_proofs,
+                            nmt_prove_absence,
+                        )
+                        from celestia_tpu.shares import to_bytes as to_raw
+
+                        eds = da_mod.extend_shares(to_raw(sq))
+                        k = eds.original_width
+                        nsb = target.bytes
+                        all_roots = eds.row_roots() + eds.col_roots()
+                        data_root, root_proofs = merkle_proofs(all_roots)
+                        assert data_root == block.data_hash
+                        absence = []
+                        for i in range(k):
+                            leaves = da_mod.erasured_axis_leaves(
+                                eds.row(i), i, k
+                            )
+                            root = all_roots[i]
+                            if nsb < root[: appconsts.NAMESPACE_SIZE] or \
+                                    nsb > root[appconsts.NAMESPACE_SIZE:
+                                               2 * appconsts.NAMESPACE_SIZE]:
+                                continue
+                            proof = nmt_prove_absence(leaves, nsb)
+                            rp = root_proofs[i]
+                            absence.append(
+                                {
+                                    "row": i,
+                                    "row_root": root.hex(),
+                                    "proof": proof.to_json(),
+                                    "root_proof": {
+                                        "total": rp.total,
+                                        "index": rp.index,
+                                        "leaf_hash": rp.leaf_hash.hex(),
+                                        "aunts": [a.hex() for a in rp.aunts],
+                                    },
+                                }
+                            )
+                        reply["absence"] = absence
+                    self._reply(reply)
                 elif parts == ["blobstream", "nonces"]:
                     # ref: LatestAttestationNonce + EarliestAttestationNonce
                     self._reply(

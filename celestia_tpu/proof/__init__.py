@@ -183,6 +183,124 @@ def _subtree_root(leaves: list[bytes], lo: int, hi: int) -> bytes:
 
 
 # ---------------------------------------------------------------------- #
+# NMT namespace ABSENCE proofs (nmt v0.20 ProveNamespace / VerifyNamespace
+# for a namespace inside the root's [min, max] range with no leaves)
+
+
+@dataclasses.dataclass
+class NmtAbsenceProof:
+    """Proof that a namespace has NO leaves in a tree whose root range
+    covers it: the witness is the first leaf whose namespace is GREATER
+    than the target, plus its merkle path. Verification checks the
+    witness's namespace bound and completeness (every left sibling's max
+    namespace is below the target, every right sibling's min above), so
+    no position where the target could hide survives.
+    ref: nmt proof.go VerifyNamespace absence branch."""
+
+    position: int  # index of the witness leaf
+    leaf_node: bytes  # its full 90-byte NMT node
+    nodes: list[bytes]  # sibling subtree roots, traversal order
+    tree_size: int
+
+    def verify(self, root: bytes, namespace: bytes) -> None:
+        ns_len = NAMESPACE_SIZE
+        if len(self.leaf_node) != 2 * ns_len + 32:
+            raise ValueError("malformed witness leaf node")
+        witness_min = self.leaf_node[:ns_len]
+        if witness_min <= namespace:
+            raise ValueError(
+                "witness leaf namespace does not exceed the target"
+            )
+        if not (0 <= self.position < self.tree_size):
+            raise ValueError("witness position out of range")
+        nodes_iter = iter(self.nodes)
+
+        def rec(lo: int, hi: int) -> bytes:
+            if hi <= self.position or lo > self.position:
+                node = next(nodes_iter)
+                if len(node) != 2 * ns_len + 32:
+                    raise ValueError("malformed sibling node")
+                if hi <= self.position:  # left sibling: strictly before
+                    if node[ns_len : 2 * ns_len] >= namespace:
+                        raise ValueError(
+                            "left sibling max namespace reaches the target "
+                            "(incomplete absence proof)"
+                        )
+                else:  # right sibling: strictly after the witness
+                    if node[:ns_len] <= namespace:
+                        raise ValueError(
+                            "right sibling min namespace reaches the target"
+                        )
+                return node
+            if hi - lo == 1:
+                return self.leaf_node
+            split = _split_point(hi - lo)
+            return hash_node(rec(lo, lo + split), rec(lo + split, hi))
+
+        computed = rec(0, self.tree_size)
+        if next(nodes_iter, None) is not None:
+            raise ValueError("unconsumed proof nodes")
+        if computed != root:
+            raise ValueError("absence proof root mismatch")
+
+    def to_json(self) -> dict:
+        return {
+            "position": self.position,
+            "leaf_node": self.leaf_node.hex(),
+            "nodes": [n.hex() for n in self.nodes],
+            "tree_size": self.tree_size,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "NmtAbsenceProof":
+        return cls(
+            position=d["position"],
+            leaf_node=bytes.fromhex(d["leaf_node"]),
+            nodes=[bytes.fromhex(n) for n in d["nodes"]],
+            tree_size=d["tree_size"],
+        )
+
+
+def nmt_prove_absence(leaves: list[bytes], namespace: bytes) -> NmtAbsenceProof:
+    """Absence proof for a namespace within the tree's range.
+    leaves: full namespaced leaves (29-byte ns ‖ data), non-decreasing."""
+    ns_len = NAMESPACE_SIZE
+    leaf_ns = [leaf[:ns_len] for leaf in leaves]
+    if any(n == namespace for n in leaf_ns):
+        raise ValueError("namespace is present; absence cannot be proven")
+    if not leaves or namespace < leaf_ns[0] or namespace > leaf_ns[-1]:
+        raise ValueError(
+            "namespace is outside the root's range: absence follows from "
+            "the root's min/max, no proof needed"
+        )
+    position = next(i for i, n in enumerate(leaf_ns) if n > namespace)
+    range_proof = nmt_prove_range(leaves, position, position + 1)
+    return NmtAbsenceProof(
+        position=position,
+        leaf_node=hash_leaf(leaves[position]),
+        nodes=range_proof.nodes,
+        tree_size=len(leaves),
+    )
+
+
+def verify_namespace_absent(
+    root: bytes, namespace: bytes, proof: NmtAbsenceProof | None
+) -> None:
+    """Full absence check against a 90-byte NMT root: outside the root's
+    [min, max] no proof is needed; inside it the witness proof must
+    verify. Raises on failure."""
+    ns_len = NAMESPACE_SIZE
+    root_min, root_max = root[:ns_len], root[ns_len : 2 * ns_len]
+    if namespace < root_min or namespace > root_max:
+        return  # absent by root range
+    if proof is None:
+        raise ValueError(
+            "namespace is inside the root's range: an absence proof is required"
+        )
+    proof.verify(root, namespace)
+
+
+# ---------------------------------------------------------------------- #
 # Share / tx inclusion proofs
 
 

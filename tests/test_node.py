@@ -290,6 +290,54 @@ class TestRpc:
             )
             assert nd2["ranges"] == []
 
+            # absent namespace: verifiable nmt absence proofs per
+            # covering row (or pure root-range absence)
+            from celestia_tpu.proof import (
+                NmtAbsenceProof,
+                verify_namespace_absent,
+            )
+
+            # "absent-ns" sorts into the GAP between row 0's max (the
+            # blob) and row 1's min (tail padding): no row covers it, so
+            # absence follows from the ordered row-root ranges alone
+            nd3 = json.loads(
+                urllib.request.urlopen(
+                    f"{base}/namespace_data/{block['height']}/{other}"
+                ).read()
+            )
+            assert nd3["ranges"] == [] and nd3["absence"] == []
+            # "absent" sorts BETWEEN the PFB and blob namespaces inside
+            # row 0's range: a witness-leaf absence proof is served and
+            # verifies against the row root
+            inside = ns.new_v0(b"absent").bytes.hex()
+            nd4 = json.loads(
+                urllib.request.urlopen(
+                    f"{base}/namespace_data/{block['height']}/{inside}"
+                ).read()
+            )
+            assert nd4["ranges"] == []
+            assert nd4["absence"], nd4
+            from celestia_tpu.proof import MerkleProof
+
+            for item in nd4["absence"]:
+                root = bytes.fromhex(item["row_root"])
+                proof = NmtAbsenceProof.from_json(item["proof"])
+                verify_namespace_absent(root, bytes.fromhex(inside), proof)
+                # the row root itself authenticates to the block data root
+                rp = item["root_proof"]
+                MerkleProof(
+                    total=rp["total"], index=rp["index"],
+                    leaf_hash=bytes.fromhex(rp["leaf_hash"]),
+                    aunts=[bytes.fromhex(a) for a in rp["aunts"]],
+                ).verify(bytes.fromhex(block["data_hash"]), root)
+
+            # padding/parity namespaces are rejected as meaningless queries
+            tailpad = ns.TAIL_PADDING_NAMESPACE.bytes.hex()
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"{base}/namespace_data/{block['height']}/{tailpad}"
+                )
+
             # module param queries
             bp = json.loads(urllib.request.urlopen(f"{base}/params/blob").read())
             assert bp["gas_per_blob_byte"] == 8

@@ -136,3 +136,105 @@ class TestCommitmentFromSquare:
             blob_len = sparse_shares_needed(len(b.data))
             commitment = get_commitment(cacher, start, blob_len, threshold)
             assert commitment == inclusion.create_commitment(b, threshold)
+
+
+class TestNamespaceAbsence:
+    """nmt v0.20 absence proofs: a namespace inside the root's range with
+    no leaves is proven absent via the first-greater witness leaf."""
+
+    def _leaves(self, ns_bytes_list, payload=b"\x07" * 16):
+        return [n + payload for n in ns_bytes_list]
+
+    def _ns(self, b):
+        return bytes(28) + bytes([b]) + b""  # 29-byte ns ending in b
+
+    def test_absent_namespace_verifies(self):
+        from celestia_tpu.proof import nmt_prove_absence, verify_namespace_absent
+
+        present = [self._ns(b) for b in (2, 4, 4, 8, 9)]
+        leaves = self._leaves(present)
+        root = nmt_root(leaves)
+        for missing in (3, 5, 6, 7):
+            target = self._ns(missing)
+            proof = nmt_prove_absence(leaves, target)
+            verify_namespace_absent(root, target, proof)  # must not raise
+
+    def test_out_of_range_needs_no_proof(self):
+        from celestia_tpu.proof import verify_namespace_absent
+
+        leaves = self._leaves([self._ns(b) for b in (5, 6, 7, 8)])
+        root = nmt_root(leaves)
+        verify_namespace_absent(root, self._ns(1), None)
+        verify_namespace_absent(root, self._ns(200), None)
+        with pytest.raises(ValueError, match="absence proof is required"):
+            verify_namespace_absent(root, self._ns(6), None)
+
+    def test_present_namespace_cannot_prove_absence(self):
+        from celestia_tpu.proof import nmt_prove_absence
+
+        leaves = self._leaves([self._ns(b) for b in (2, 4, 8)])
+        with pytest.raises(ValueError, match="present"):
+            nmt_prove_absence(leaves, self._ns(4))
+
+    def test_forged_witness_rejected(self):
+        from celestia_tpu.proof import nmt_prove_absence
+
+        leaves = self._leaves([self._ns(b) for b in (2, 4, 8, 9)])
+        root = nmt_root(leaves)
+        target = self._ns(5)
+        proof = nmt_prove_absence(leaves, target)
+        # 1. wrong witness position
+        import dataclasses as dc
+
+        bad = dc.replace(proof, position=proof.position - 1)
+        with pytest.raises(ValueError):
+            bad.verify(root, target)
+        # 2. tampered leaf node
+        bad = dc.replace(proof, leaf_node=b"\xff" * 90)
+        with pytest.raises(ValueError):
+            bad.verify(root, target)
+        # 3. witness namespace not above the target
+        with pytest.raises(ValueError, match="does not exceed"):
+            proof.verify(root, self._ns(9))
+
+    def test_completeness_checked(self):
+        """A proof against a DIFFERENT tree that actually contains the
+        namespace must not verify (left-sibling max reaches the target)."""
+        from celestia_tpu.proof import nmt_prove_absence
+
+        target = self._ns(5)
+        with_target = self._leaves([self._ns(b) for b in (2, 5, 8, 9)])
+        root_with = nmt_root(with_target)
+        without = self._leaves([self._ns(b) for b in (2, 4, 8, 9)])
+        proof = nmt_prove_absence(without, target)
+        with pytest.raises(ValueError):
+            proof.verify(root_with, target)
+
+    def test_erasured_row_absence(self):
+        """Absence in a real erasured row tree (parity namespace tail),
+        the shape served by /namespace_data."""
+        from celestia_tpu.proof import nmt_prove_absence, verify_namespace_absent
+
+        k = 4
+        rng = np.random.default_rng(5)
+        nsb = ns.new_v0(b"aaaabsent").bytes  # ns to prove absent
+        present_ns = [ns.new_v0(bytes([200 + i]) * 10).bytes for i in range(k)]
+        shares = []
+        for n in sorted(present_ns):
+            s = bytearray(rng.integers(0, 256, appconsts.SHARE_SIZE, np.uint8))
+            s[: appconsts.NAMESPACE_SIZE] = n
+            shares.append(bytes(s))
+        eds = da.extend_shares(shares * k)
+        row = eds.row(0)
+        leaves = [
+            (c[: appconsts.NAMESPACE_SIZE] if j < k else
+             ns.PARITY_SHARES_NAMESPACE.bytes) + c
+            for j, c in enumerate(row)
+        ]
+        root = nmt_root(leaves)
+        if root[: appconsts.NAMESPACE_SIZE] <= nsb <= \
+                root[appconsts.NAMESPACE_SIZE : 2 * appconsts.NAMESPACE_SIZE]:
+            proof = nmt_prove_absence(leaves, nsb)
+            verify_namespace_absent(root, nsb, proof)
+        else:
+            verify_namespace_absent(root, nsb, None)
