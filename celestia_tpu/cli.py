@@ -45,8 +45,9 @@ def cmd_init(args):
         keys["validator"] = secret.hex()
         _save_keys(home, keys)
     key = PrivateKey.from_secret(bytes.fromhex(keys["validator"]))
+    chain_id = args.chain_id or "celestia-tpu-1"
     genesis = {
-        "chain_id": args.chain_id,
+        "chain_id": chain_id,
         "genesis_time": time.time(),
         "accounts": {key.bech32_address(): 1_000_000_000_000},
         # the gentx flow: this node's key is a genesis validator with a
@@ -57,7 +58,7 @@ def cmd_init(args):
     # layered config files (ref: app/default_overrides.go:230-271 written by
     # celestia-appd init; start layers defaults < files < env < flags)
     write_default_configs(home)
-    print(f"initialized chain {args.chain_id} at {home}")
+    print(f"initialized chain {chain_id} at {home}")
     print(f"validator address: {key.bech32_address()}")
     print(f"wrote {home}/config/config.toml and {home}/config/app.toml")
 
@@ -181,36 +182,44 @@ def _rpc(args, method, path, body=None):
 
 
 def cmd_tx(args):
+    """Submit through the full Signer stack over the RPC client, so the
+    CLI gets nonce-race recovery and min-gas-price bumping for free."""
     from celestia_tpu import blob as blob_pkg
     from celestia_tpu import namespace as ns
     from celestia_tpu.crypto import PrivateKey
-    from celestia_tpu.tx import Fee, sign_tx
+    from celestia_tpu.node.client import RpcClient
+    from celestia_tpu.user import Signer
     from celestia_tpu.x.bank import MsgSend
-    from celestia_tpu.x.blob.types import estimate_gas, new_msg_pay_for_blobs
 
     home = _home(args)
     keys = _load_keys(home)
     key = PrivateKey.from_secret(bytes.fromhex(keys[args.from_key]))
-    account = _rpc(args, "GET", f"/account/{key.bech32_address()}")
-    if "error" in account:
-        print(account["error"], file=sys.stderr)
+    client = RpcClient(f"http://127.0.0.1:{args.port}")
+    try:
+        signer = Signer.setup_single(key, client)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        sys.exit(1)
+    if args.chain_id is not None and args.chain_id != signer.chain_id:
+        print(
+            f"--chain-id {args.chain_id} disagrees with the node's chain "
+            f"{signer.chain_id}",
+            file=sys.stderr,
+        )
         sys.exit(1)
 
     if args.tx_cmd == "pfb":
         data = pathlib.Path(args.file).read_bytes() if args.file else os.urandom(args.size)
         b = blob_pkg.new_blob(ns.new_v0(bytes.fromhex(args.namespace)), data, 0)
-        msg = new_msg_pay_for_blobs(key.bech32_address(), b)
-        gas = estimate_gas([len(data)])
-        tx = sign_tx(key, [msg], args.chain_id, account["account_number"],
-                     account["sequence"], Fee(amount=gas, gas_limit=gas))
-        raw = blob_pkg.marshal_blob_tx(tx.marshal(), [b])
+        res = signer.submit_pay_for_blob([b])
     elif args.tx_cmd == "send":
-        msg = MsgSend(key.bech32_address(), args.to, args.amount)
-        tx = sign_tx(key, [msg], args.chain_id, account["account_number"],
-                     account["sequence"], Fee(amount=200_000, gas_limit=200_000))
-        raw = tx.marshal()
-    result = _rpc(args, "POST", "/broadcast_tx", {"tx": raw.hex()})
-    print(json.dumps(result))
+        res = signer.submit_tx(
+            [MsgSend(key.bech32_address(), args.to, args.amount)]
+        )
+    from celestia_tpu.node.node import tx_hash
+
+    print(json.dumps({"code": res.code, "log": res.log,
+                      "hash": tx_hash(res.raw).hex()}))
 
 
 def cmd_query(args):
@@ -221,7 +230,9 @@ def main(argv=None):
     parser = argparse.ArgumentParser(prog="celestia-tpu")
     parser.add_argument("--home", default=DEFAULT_HOME)
     parser.add_argument("--port", type=int, default=26657)
-    parser.add_argument("--chain-id", default="celestia-tpu-1")
+    # None = not passed: init falls back to the default chain id; tx
+    # verifies a passed value against the node's actual chain
+    parser.add_argument("--chain-id", default=None)
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     sub.add_parser("init")

@@ -1,0 +1,102 @@
+"""RPC client — the remote transport for Signer and tools.
+
+The reference's clients speak gRPC to a node (pkg/user dials a grpc
+conn, signer.go:83); this is the same role over the node's JSON/HTTP
+RPC: an object with the transport surface Signer expects
+(broadcast_tx / get_tx / account), plus the common queries. With it the
+full client stack — tx options, nonce-race recovery, min-gas-price
+bumping — works against a node on the other end of a socket exactly as
+it does in-process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import urllib.error
+import urllib.request
+
+
+@dataclasses.dataclass
+class BroadcastResult:
+    code: int
+    log: str = ""
+    priority: int = 0
+
+
+class RpcClient:
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # --- plumbing ---
+
+    def _get(self, path: str):
+        try:
+            with urllib.request.urlopen(
+                self.base_url + path, timeout=self.timeout
+            ) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def _post(self, path: str, body: dict):
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=json.dumps(body).encode(),
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            # the server wraps handler exceptions as {"error": ...} with a
+            # 5xx status; surface that as a result the caller can inspect,
+            # like the in-process transport's caught ValueError
+            try:
+                return json.loads(e.read())
+            except ValueError:
+                return {"error": f"HTTP {e.code}"}
+
+    # --- the Signer transport surface ---
+
+    def broadcast_tx(self, raw: bytes) -> BroadcastResult:
+        res = self._post("/broadcast_tx", {"tx": raw.hex()})
+        if "error" in res:
+            return BroadcastResult(code=1, log=res["error"])
+        return BroadcastResult(
+            code=res.get("code", 1),
+            log=res.get("log", ""),
+            priority=res.get("priority", 0),
+        )
+
+    def get_tx(self, key: bytes):
+        """Committed-tx lookup by hash; None until included in a block."""
+        return self._get(f"/tx/{key.hex()}")
+
+    def account(self, address: str):
+        """Account state for Signer.setup_single: dict with
+        account_number/sequence/balance, or None."""
+        return self._get(f"/account/{address}")
+
+    # --- common queries ---
+
+    def status(self) -> dict:
+        return self._get("/status")
+
+    def block(self, height: int):
+        return self._get(f"/block/{height}")
+
+    def balance(self, address: str, denom: str = "utia") -> int:
+        return self._get(f"/balance/{address}/{denom}")["balance"]
+
+    def params(self, module: str):
+        return self._get(f"/params/{module}")
+
+    def namespace_data(self, height: int, namespace: bytes):
+        return self._get(f"/namespace_data/{height}/{namespace.hex()}")
+
+    def snapshot(self) -> dict:
+        return self._get("/snapshot")

@@ -203,6 +203,28 @@ class Node:
 
     # --- queries ---
 
+    def status(self) -> dict:
+        """Same shape as the RPC /status route — Node and RpcClient share
+        the Signer transport surface."""
+        return {
+            "chain_id": self.app.chain_id,
+            "height": self.latest_height(),
+            "app_version": self.app.app_version,
+            "mempool_size": len(self.mempool),
+        }
+
+    def account(self, address: str) -> dict | None:
+        """Same shape as the RPC /account route."""
+        acc = self.app.accounts.get_account(address)
+        if acc is None:
+            return None
+        return {
+            "address": acc.address,
+            "account_number": acc.account_number,
+            "sequence": acc.sequence,
+            "balance": self.app.bank.get_balance(acc.address),
+        }
+
     def get_block(self, height: int) -> Block | None:
         return self.blocks.get(height)
 

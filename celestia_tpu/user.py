@@ -54,12 +54,17 @@ class Signer:
         self.sequence = sequence
 
     @classmethod
-    def setup_single(cls, key: PrivateKey, node) -> "Signer":
-        """ref: pkg/user/signer.go SetupSingleSigner — query account state."""
-        acc = node.app.accounts.get_account(key.bech32_address())
+    def setup_single(cls, key: PrivateKey, transport) -> "Signer":
+        """ref: pkg/user/signer.go SetupSingleSigner — query account state.
+
+        transport: anything exposing the transport surface — account(),
+        status(), broadcast_tx(), get_tx(). Both the in-process Node and
+        node.client.RpcClient implement it."""
+        acc = transport.account(key.bech32_address())
         if acc is None:
             raise ValueError("account does not exist on chain")
-        return cls(key, node, node.app.chain_id, acc.account_number, acc.sequence)
+        return cls(key, transport, transport.status()["chain_id"],
+                   acc["account_number"], acc["sequence"])
 
     def address(self) -> str:
         return self.key.bech32_address()
@@ -140,17 +145,17 @@ class Signer:
                 f"({self.address()}); co-signed fee granting is not supported"
             )
 
-    def resync_sequence(self, node) -> int:
+    def resync_sequence(self, transport=None) -> int:
         """Re-query the on-chain sequence (after a confirmed failure)."""
-        acc = node.app.accounts.get_account(self.address())
+        transport = transport if transport is not None else self.transport
+        acc = transport.account(self.address())
         if acc is not None:
-            self.sequence = acc.sequence
+            self.sequence = acc["sequence"]
         return self.sequence
 
     def confirm_tx(self, raw: bytes):
         """Poll the transport until the tx is committed.
         ref: pkg/user/signer.go:212 ConfirmTx"""
-        import hashlib
+        from celestia_tpu.node.node import tx_hash
 
-        key = hashlib.sha256(raw).digest()
-        return self.transport.get_tx(key)
+        return self.transport.get_tx(tx_hash(raw))

@@ -130,6 +130,58 @@ class TestNode:
         assert resumed.latest_height() == node.latest_height() + 1
 
 
+class TestRpcClient:
+    """The remote transport: the full Signer stack (tx options, nonce
+    recovery) over HTTP instead of an in-process Node."""
+
+    def test_signer_over_rpc_client(self):
+        from celestia_tpu.node.client import RpcClient
+
+        node = new_node()
+        srv = RpcServer(node, port=0)
+        srv.start()
+        try:
+            client = RpcClient(f"http://127.0.0.1:{srv.port}")
+            assert client.status()["chain_id"] == node.app.chain_id
+            signer = Signer.setup_single(ALICE, client)
+            b = blob_pkg.new_blob(ns.new_v0(b"remote"), b"\x21" * 400, 0)
+            res = signer.submit_pay_for_blob([b])
+            assert res.code == 0, res.log
+            node.produce_block(30.0)
+            found = client.get_tx(tx_hash(res.raw))
+            assert found is not None and found["result"]["code"] == 0
+            assert client.balance(ALICE.bech32_address()) > 0
+            assert client.params("blob")["gas_per_blob_byte"] == 8
+        finally:
+            srv.stop()
+
+    def test_nonce_recovery_over_rpc(self):
+        """Two remote signers racing one account: the stale one recovers
+        from the CheckTx error text through the HTTP boundary."""
+        from celestia_tpu.node.client import RpcClient
+        from celestia_tpu.x.bank import MsgSend
+
+        node = new_node()
+        srv = RpcServer(node, port=0)
+        srv.start()
+        try:
+            client = RpcClient(f"http://127.0.0.1:{srv.port}")
+            s1 = Signer.setup_single(ALICE, client)
+            s2 = Signer.setup_single(ALICE, client)  # same sequence
+            assert s1.submit_tx(
+                [MsgSend(ALICE.bech32_address(), VALIDATOR.bech32_address(), 5)]
+            ).code == 0
+            res = s2.submit_tx(
+                [MsgSend(ALICE.bech32_address(), VALIDATOR.bech32_address(), 7)]
+            )
+            assert res.code == 0, res.log  # auto re-signed at expected seq
+            block = node.produce_block(30.0)
+            assert [r.code for r in block.tx_results] == [0, 0]
+            assert s2.resync_sequence() == 2
+        finally:
+            srv.stop()
+
+
 class TestStateSync:
     def test_bootstrap_from_live_peer(self):
         """A fresh node state-syncs over the live RPC snapshot endpoint
