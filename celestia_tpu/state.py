@@ -16,6 +16,7 @@ provides the same capabilities in a self-contained form:
 
 from __future__ import annotations
 
+import bisect
 import json
 import threading
 
@@ -62,8 +63,9 @@ class CacheStore:
         self._writes.clear()
 
     def iter_prefix(self, prefix: bytes):
-        # Sorted merged view so branch and committed iteration agree —
-        # order-sensitive consumers must not diverge across commit.
+        """Sorted merged (key, value) list so branch and committed
+        iteration agree — order-sensitive consumers must not diverge
+        across commit, and both stores return a mutation-safe snapshot."""
         merged: dict[bytes, bytes] = dict(self.parent.iter_prefix(prefix))
         for k, v in self._writes.items():
             if k.startswith(prefix):
@@ -71,8 +73,7 @@ class CacheStore:
                     merged.pop(k, None)
                 else:
                     merged[k] = v
-        for k in sorted(merged):
-            yield k, merged[k]
+        return [(k, merged[k]) for k in sorted(merged)]
 
 
 class StateStore:
@@ -80,6 +81,10 @@ class StateStore:
 
     def __init__(self):
         self._data: dict[bytes, bytes] = {}
+        # sorted key index so prefix iteration is O(log n + match) instead
+        # of sorting the whole key set per call (EndBlock scans validators
+        # and proposals every block; full-state sorts grow with the chain)
+        self._keys: list[bytes] = []
         self.version = 0
         self.app_hashes: dict[int, bytes] = {}
         self._smt = smt_mod.SparseMerkleTree()
@@ -91,6 +96,19 @@ class StateStore:
     def get(self, key: bytes) -> bytes | None:
         return self._data.get(key)
 
+    def _set_locked(self, key: bytes, value: bytes) -> None:
+        if key not in self._data:
+            bisect.insort(self._keys, key)
+        self._data[key] = value
+        self._dirty.add(key)
+
+    def _delete_locked(self, key: bytes) -> None:
+        if key in self._data:
+            del self._data[key]
+            idx = bisect.bisect_left(self._keys, key)
+            del self._keys[idx]
+        self._dirty.add(key)
+
     def set(self, key: bytes, value: bytes) -> None:
         if not isinstance(key, bytes) or not isinstance(value, bytes):
             raise TypeError("store keys/values must be bytes")
@@ -98,36 +116,62 @@ class StateStore:
         # never observe a value newer than the root it pairs with (and so
         # _fold_dirty never iterates a mutating set).
         with self._smt_lock:
-            self._data[key] = value
-            self._dirty.add(key)
+            self._set_locked(key, value)
 
     def delete(self, key: bytes) -> None:
         with self._smt_lock:
-            self._data.pop(key, None)
-            self._dirty.add(key)
+            self._delete_locked(key)
 
     def write_batch(self, writes: dict[bytes, bytes | None]) -> None:
         """Apply a block's worth of writes atomically: one lock hold, so
         query_with_proof sees either none or all of them (never a bank
-        send with only the debit applied). Values of None delete."""
+        send with only the debit applied). Values of None delete.
+
+        The key index updates by a single sorted merge (O(n + b log b))
+        rather than per-key insort — a bulk import of b new keys must not
+        pay b list memmoves."""
+        import heapq
+
         for k, v in writes.items():
             if not isinstance(k, bytes) or not (v is None or isinstance(v, bytes)):
                 raise TypeError("store keys/values must be bytes")
         with self._smt_lock:
+            added: set[bytes] = set()
+            removed: set[bytes] = set()
             for k, v in writes.items():
                 if v is None:
-                    self._data.pop(k, None)
+                    if k in self._data:
+                        del self._data[k]
+                        removed.add(k)
                 else:
+                    if k not in self._data:
+                        added.add(k)
                     self._data[k] = v
                 self._dirty.add(k)
+            # delete-then-set (or set-then-delete) within one batch nets
+            # out: the index entry is unchanged (or never existed)
+            both = added & removed
+            added -= both
+            removed -= both
+            if removed or added:
+                survivors = (k for k in self._keys if k not in removed)
+                self._keys = list(heapq.merge(survivors, sorted(added)))
 
     def branch(self) -> CacheStore:
         return CacheStore(self)
 
     def iter_prefix(self, prefix: bytes):
-        for k in sorted(self._data):
-            if k.startswith(prefix):
-                yield k, self._data[k]
+        """Sorted (key, value) pairs under prefix — a consistent snapshot
+        taken under the lock (callers may mutate while consuming)."""
+        with self._smt_lock:
+            lo = bisect.bisect_left(self._keys, prefix)
+            out = []
+            for i in range(lo, len(self._keys)):
+                k = self._keys[i]
+                if not k.startswith(prefix):
+                    break
+                out.append((k, self._data[k]))
+        return out
 
     def commit(self) -> bytes:
         """Advance one version and return the deterministic app hash."""
@@ -152,6 +196,7 @@ class StateStore:
         store._data = {
             bytes.fromhex(k): bytes.fromhex(v) for k, v in payload["data"].items()
         }
+        store._keys = sorted(store._data)
         store._dirty = set(store._data)  # rebuild the SMT from scratch
         store.commit_hash_refresh()
         return store
