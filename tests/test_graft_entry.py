@@ -1,0 +1,86 @@
+"""Isolation contract of the driver entry module.
+
+dryrun_multichip is scored by the driver in an environment we don't
+control (jax possibly pre-initialized on a broken TPU client,
+JAX_PLATFORMS mutated late). The contract: importing __graft_entry__
+never imports jax, and dryrun_multichip always re-execs into a scrubbed
+CPU-only child regardless of the parent's platform state.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(code: str, env_overrides: dict) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env.update(env_overrides)
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+
+
+def test_import_does_not_import_jax():
+    # sitecustomize may import jax at interpreter startup (axon.register);
+    # the contract is that *our* import adds no jax module.
+    proc = _run(
+        "import sys; before = 'jax' in sys.modules; "
+        "import __graft_entry__; "
+        "assert ('jax' in sys.modules) == before, 'module-level jax import'; "
+        "print('ok')",
+        {},
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "ok" in proc.stdout
+
+
+def test_respawn_env_is_scrubbed():
+    """The child env must drop every axon/TPU trigger and pin cpu."""
+    import __graft_entry__ as g
+
+    poisoned = {
+        "PALLAS_AXON_POOL_IPS": "10.0.0.1",
+        "AXON_POOL_SVC_OVERRIDE": "127.0.0.1",
+        "TPU_WORKER_HOSTNAMES": "localhost",
+        "LIBTPU_INIT_ARGS": "--x",
+        "JAX_PLATFORMS": "axon",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    }
+    old = {k: os.environ.get(k) for k in poisoned}
+    os.environ.update(poisoned)
+    try:
+        env = g._scrubbed_env(8)
+        for k in poisoned:
+            if k in ("JAX_PLATFORMS", "XLA_FLAGS"):
+                continue  # re-pinned below, not dropped
+            assert k not in env, f"{k} survived the scrub"
+        assert env["JAX_PLATFORMS"] == "cpu"
+        assert env["XLA_FLAGS"] == "--xla_force_host_platform_device_count=8"
+        assert env[g._CHILD_SENTINEL] == "1"
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_with_poisoned_parent():
+    """The exact driver failure mode: JAX_PLATFORMS=cpu set but the parent
+    process's jax state is irrelevant because the child is always fresh."""
+    proc = _run(
+        "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')",
+        {"JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "ok" in proc.stdout
