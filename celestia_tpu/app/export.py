@@ -30,7 +30,12 @@ import json
 from celestia_tpu import appconsts
 from celestia_tpu.state import StateStore
 from celestia_tpu.x.auth import ACCOUNT_PREFIX, GLOBAL_ACCOUNT_NUMBER_KEY
-from celestia_tpu.x.bank import BALANCE_PREFIX, SUPPLY_KEY
+from celestia_tpu.x.bank import (
+    BALANCE_PREFIX,
+    SUPPLY_KEY,
+    _balance_key,
+    split_balance_key,
+)
 from celestia_tpu.x.staking import (
     DELEGATION_PREFIX,
     LAST_UNBONDING_HEIGHT_KEY,
@@ -70,7 +75,7 @@ def export_app_state_and_validators(app, for_zero_height: bool = False) -> dict:
         accounts.append(json.loads(raw))
     balances: dict[str, dict[str, int]] = {}
     for key, raw in store.iter_prefix(BALANCE_PREFIX):
-        addr, denom = key[len(BALANCE_PREFIX):].decode().rsplit("/", 1)
+        addr, denom = split_balance_key(key)
         balances.setdefault(addr, {})[denom] = int.from_bytes(raw, "big")
     supply = {
         key[len(SUPPLY_KEY):].decode(): int.from_bytes(raw, "big")
@@ -208,7 +213,7 @@ def import_genesis(genesis: dict, **app_kwargs):
     for addr, denoms in bank.get("balances", {}).items():
         for denom, amount in denoms.items():
             store.set(
-                BALANCE_PREFIX + addr.encode() + b"/" + denom.encode(),
+                _balance_key(addr, denom),
                 int(amount).to_bytes(16, "big"),
             )
     for denom, amount in bank.get("supply", {}).items():

@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 import json
 
+from celestia_tpu.appconsts import BOND_DENOM
 from celestia_tpu.blob import _field_bytes, _parse_fields, _require_wt
 from celestia_tpu.tx import decode_any, register_msg
 from celestia_tpu.x.bank import MsgSend
@@ -89,6 +90,18 @@ class AuthzKeeper:
             self.store.delete(_grant_key(granter, grantee, url))
             raise ValueError("authorization expired")
         if g.spend_limit is not None:
+            # The limit is a bare utia amount (the SDK's SendAuthorization
+            # carries typed Coins); comparing it against a send in another
+            # denom — e.g. an IBC voucher — would spend the granter's
+            # other balances against a utia budget and decrement the limit
+            # in the wrong unit. Restrict the spend-limit path to the bond
+            # denom. (spend_limit grants are only issued for MsgSend, which
+            # always carries a denom.)
+            if msg.denom != BOND_DENOM:
+                raise ValueError(
+                    f"authorization spend limit is {BOND_DENOM}-denominated; "
+                    f"cannot authorize a {msg.denom} send"
+                )
             amount = msg.amount
             if amount > g.spend_limit:
                 raise ValueError(

@@ -25,8 +25,45 @@ BONDED_POOL = "bonded_tokens_pool"
 NOT_BONDED_POOL = "not_bonded_tokens_pool"
 
 
+def blocked_addrs() -> frozenset[str]:
+    """Module accounts that must not receive external funds — the analogue
+    of app.ModuleAccountAddrs() handed to the bank keeper (reference
+    app/app.go:309,606-611 blocks every maccPerms account). Computed
+    lazily to avoid import cycles with gov/distribution."""
+    from celestia_tpu.x.distribution import DISTRIBUTION_MODULE_ACCOUNT
+    from celestia_tpu.x.gov import GOV_MODULE_ACCOUNT
+
+    return frozenset(
+        {
+            FEE_COLLECTOR,
+            MINT_MODULE,
+            BONDED_POOL,
+            NOT_BONDED_POOL,
+            GOV_MODULE_ACCOUNT,
+            DISTRIBUTION_MODULE_ACCOUNT,
+        }
+    )
+
+
+def is_blocked_addr(address: str) -> bool:
+    """True for module accounts and per-channel escrow accounts — any
+    address a counterparty-controlled packet must not credit directly
+    (ibc-go transfer's BlockedAddr check in OnRecvPacket)."""
+    return address in blocked_addrs() or address.startswith("escrow/")
+
+
 def _balance_key(address: str, denom: str) -> bytes:
-    return BALANCE_PREFIX + address.encode() + b"/" + denom.encode()
+    # NUL separator, not '/': both addresses (channel escrow accounts are
+    # "escrow/<port>/<channel>") and denoms (IBC voucher traces are
+    # "transfer/channel-0/utia") legitimately contain '/', so a '/' join
+    # cannot be parsed back unambiguously. NUL appears in neither.
+    return BALANCE_PREFIX + address.encode() + b"\x00" + denom.encode()
+
+
+def split_balance_key(key: bytes) -> tuple[str, str]:
+    """Inverse of _balance_key for store iteration (export, invariants)."""
+    addr, denom = key[len(BALANCE_PREFIX):].split(b"\x00", 1)
+    return addr.decode(), denom.decode()
 
 
 class BankKeeper:

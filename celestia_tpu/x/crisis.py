@@ -23,6 +23,7 @@ from celestia_tpu.x.bank import (
     NOT_BONDED_POOL,
     SUPPLY_KEY,
     BankKeeper,
+    split_balance_key,
 )
 from celestia_tpu.x.staking import StakingKeeper, VALIDATOR_PREFIX
 
@@ -36,7 +37,7 @@ class InvariantBrokenError(AssertionError):
 def bank_total_supply_invariant(store) -> None:
     totals: dict[str, int] = {}
     for key, raw in store.iter_prefix(BALANCE_PREFIX):
-        denom = key.decode().rsplit("/", 1)[1]
+        _addr, denom = split_balance_key(key)
         totals[denom] = totals.get(denom, 0) + int.from_bytes(raw, "big")
     supplies: dict[str, int] = {}
     for key, raw in store.iter_prefix(SUPPLY_KEY):

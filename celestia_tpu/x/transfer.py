@@ -130,6 +130,30 @@ class TransferKeeper:
             return Acknowledgement(success=False, error="amount must be positive")
         if not data.sender or not data.receiver:
             return Acknowledgement(success=False, error="missing sender/receiver")
+        # The receiver string is counterparty-controlled. Reject module and
+        # escrow accounts (ibc-go's BlockedAddr check: crediting e.g. the
+        # bonded pool would silently break the staking invariants) and
+        # anything that isn't a well-formed local bech32 account, with an
+        # error ack so the source chain refunds the sender.
+        from celestia_tpu.x.bank import is_blocked_addr
+
+        if is_blocked_addr(data.receiver):
+            return Acknowledgement(
+                success=False,
+                error=f"{data.receiver} is not allowed to receive funds",
+            )
+        try:
+            from celestia_tpu.crypto import BECH32_HRP, bech32_decode
+
+            hrp, _ = bech32_decode(data.receiver)
+            if hrp != BECH32_HRP:
+                raise ValueError(
+                    f"wrong HRP {hrp!r}, want {BECH32_HRP!r}"
+                )
+        except ValueError as e:
+            return Acknowledgement(
+                success=False, error=f"invalid receiver address: {e}"
+            )
         try:
             if receiver_chain_is_source(
                 packet.source_port, packet.source_channel, data.denom

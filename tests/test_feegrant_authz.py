@@ -247,6 +247,27 @@ class TestAuthz:
         assert block.tx_results[0].code != 0
         assert "exceeds the authorization spend limit" in block.tx_results[0].log
 
+    def test_spend_limit_is_denom_typed(self):
+        """A utia spend limit must not authorize sends of other denoms
+        (e.g. IBC vouchers) — the limit would be consumed in the wrong
+        unit."""
+        node = new_node()
+        alice, bob, carol = (ALICE.bech32_address(), BOB.bech32_address(),
+                             CAROL.bech32_address())
+        voucher = "transfer/channel-0/utia"
+        node.app.bank.mint(alice, 50_000, voucher)
+        a = Signer.setup_single(ALICE, node)
+        a.submit_tx([MsgGrant(alice, bob, MsgSend.TYPE_URL, spend_limit=10_000)])
+        node.produce_block(30.0)
+        b = Signer.setup_single(BOB, node)
+        b.submit_tx(
+            [MsgExec(bob, [MsgSend(alice, carol, 4_000, denom=voucher)])]
+        )
+        block = node.produce_block(45.0)
+        assert block.tx_results[0].code != 0
+        assert "denominated" in block.tx_results[0].log
+        assert node.app.bank.get_balance(carol, voucher) == 0
+
     def test_generic_grant_for_delegate(self):
         node = new_node()
         alice, bob = ALICE.bech32_address(), BOB.bech32_address()
@@ -346,6 +367,19 @@ class TestCrisisInvariants:
     def test_unknown_route_rejected(self):
         with pytest.raises(ValueError, match="unknown invariant"):
             CrisisKeeper(new_node().app.store).check_invariant("nope")
+
+    def test_voucher_denoms_not_misbucketed(self):
+        """IBC voucher denoms contain '/'; the balance-key scheme must not
+        fold 'transfer/channel-0/utia' balances into 'utia' (which made the
+        supply invariant spuriously fail on valid state)."""
+        node = new_node()
+        voucher = "transfer/channel-0/utia"
+        node.app.bank.mint(ALICE.bech32_address(), 12_345, voucher)
+        node.app.assert_invariants()  # must not raise
+        assert node.app.bank.get_balance(ALICE.bech32_address(), voucher) == 12_345
+        # escrow addresses contain '/' too — both sides of the key at once
+        node.app.bank.mint("escrow/transfer/channel-0", 777, voucher)
+        node.app.assert_invariants()
 
 
 class TestVesting:

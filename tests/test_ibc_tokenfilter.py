@@ -48,6 +48,12 @@ def new_chain(chain_id: str) -> Node:
     return node
 
 
+def _foreign_hrp_address() -> str:
+    from celestia_tpu.crypto import bech32_encode
+
+    return bech32_encode("cosmos", bytes(20))
+
+
 def mk_packet(data: FungibleTokenPacketData, seq: int = 1) -> Packet:
     return Packet(
         sequence=seq,
@@ -356,6 +362,44 @@ class TestTransferE2E:
         ack = stack.on_recv_packet(None, pkt)
         assert not ack.success
         assert "amount must be positive" in ack.error
+
+    def test_blocked_receiver_rejected_with_error_ack(self):
+        """The receiver string is counterparty-controlled: module accounts
+        and escrow accounts must get an error ack (→ source-side refund),
+        never a credit — crediting e.g. the bonded pool breaks the staking
+        invariants permanently."""
+        node_a, _node_b, _ = self._setup()
+        app = node_a.app
+        transfer = TransferKeeper(app.store, app.bank)
+        esc = escrow_address("transfer", "channel-0")
+        app.bank.mint(esc, 10_000, "utia")
+
+        for receiver in (
+            "bonded_tokens_pool",
+            "fee_collector",
+            "gov",
+            "distribution",
+            esc,
+            "escrow/transfer/channel-9",
+            "not-a-bech32-address",
+            "celestia1qqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqinvalid",
+            # valid checksum, wrong chain prefix: crediting it strands
+            # the funds (no local key derives a cosmos1... address)
+            _foreign_hrp_address(),
+        ):
+            pkt = mk_packet(
+                FungibleTokenPacketData(
+                    "transfer/channel-0/utia", 1_000, "x", receiver
+                )
+            )
+            before = app.bank.get_balance(receiver)
+            ack = transfer.on_recv_packet(None, pkt)
+            assert not ack.success, receiver
+            # nothing unescrowed, nothing credited
+            assert app.bank.get_balance(esc) == 10_000, receiver
+            assert app.bank.get_balance(receiver) == before, receiver
+        # the invariants still hold after the attack attempts
+        app.assert_invariants()
 
     def test_foreign_denom_direct_keeper_paths(self):
         """Keeper-level checks of mint/escrow bookkeeping."""
