@@ -60,6 +60,13 @@ def _load():
         lib.merkle_root.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_size_t, ctypes.c_char_p,
         ]
+        lib.leo_decode.argtypes = [
+            ctypes.c_int, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.eds_repair.argtypes = [
+            ctypes.c_int, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.eds_repair.restype = ctypes.c_int
         _lib = lib
     except Exception as e:  # noqa: BLE001 — toolchain may be absent
         _load_error = str(e)
@@ -116,6 +123,46 @@ def merkle_root(items: list[bytes]) -> bytes:
     out = ctypes.create_string_buffer(32)
     lib.merkle_root(b"".join(items), len(items), item_size, out)
     return out.raw
+
+
+def leo_decode(cells: np.ndarray, present: np.ndarray) -> np.ndarray:
+    """Single-axis Leopard erasure decode: (2k, B) cells + (2k,) bool
+    presence -> repaired (2k, B). The native analogue of
+    ops/gf256.leopard_decode (klauspost Leopard decode role)."""
+    lib = _load()
+    n, size = cells.shape
+    k = n // 2
+    if int(np.count_nonzero(present)) < k:
+        raise ValueError("not enough shards to decode")
+    buf = ctypes.create_string_buffer(np.ascontiguousarray(cells).tobytes(), n * size)
+    lib.leo_decode(
+        k, size, buf, np.ascontiguousarray(present, dtype=np.uint8).tobytes()
+    )
+    return np.frombuffer(buf.raw, dtype=np.uint8).reshape(n, size).copy()
+
+
+def eds_repair(eds: np.ndarray, present: np.ndarray) -> np.ndarray:
+    """Repair a (2k, 2k, B) EDS given a (2k, 2k) bool presence mask —
+    the native CPU rsmt2d.Repair baseline (BASELINE config 4). Raises
+    da.repair.UnrepairableError when the pattern is not decodable (the
+    same contract as the host and TPU implementations)."""
+    lib = _load()
+    w = eds.shape[0]
+    size = eds.shape[2]
+    buf = ctypes.create_string_buffer(
+        np.ascontiguousarray(eds).tobytes(), w * w * size
+    )
+    mask = ctypes.create_string_buffer(
+        np.ascontiguousarray(present, dtype=np.uint8).tobytes(), w * w
+    )
+    rc = lib.eds_repair(w // 2, size, buf, mask)
+    if rc != 0:
+        from celestia_tpu.da.repair import UnrepairableError
+
+        raise UnrepairableError(
+            "impossible to recover: erasure pattern not decodable"
+        )
+    return np.frombuffer(buf.raw, dtype=np.uint8).reshape(w, w, size).copy()
 
 
 def extend_and_root_native(shares: np.ndarray):

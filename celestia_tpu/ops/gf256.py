@@ -233,12 +233,16 @@ def _error_locator_logs_batch(erased: np.ndarray) -> np.ndarray:
     field point, via the FWHT trick (Leopard's ErrorBitfield path): FWHT
     the 0/1 erasure indicator, pointwise mod-255 multiply with the
     precomputed FWHT of the log table, FWHT back.
-    erased (A, n) 0/1 -> (A, K_ORDER) logs."""
+    erased (A, n) 0/1 -> (A, K_ORDER) logs.
+
+    int32 throughout: FWHT values stay in [0, 255) after each level's
+    mod, and the pointwise product is < 255^2 — far inside int32. (This
+    is on the per-repair hot path; int64 measured 3x slower.)"""
     a = erased.shape[0]
-    err = np.zeros((a, K_ORDER), dtype=np.int64)
+    err = np.zeros((a, K_ORDER), dtype=np.int32)
     err[:, : erased.shape[1]] = erased
     _fwht_batch(err)
-    err = (err * log_walsh()[None, :]) % K_MODULUS
+    err = (err * log_walsh().astype(np.int32)[None, :]) % K_MODULUS
     _fwht_batch(err)
     return err % K_MODULUS
 
@@ -253,6 +257,55 @@ def _mul_bytes_batch(rows: np.ndarray, log_ms: np.ndarray) -> np.ndarray:
     a_idx = np.arange(rows.shape[0]).reshape(-1, *((1,) * (rows.ndim - 1)))
     r_idx = np.arange(rows.shape[1]).reshape(1, -1, *((1,) * (rows.ndim - 2)))
     return luts[a_idx, r_idx, rows]
+
+
+def _mul_shared(v_half: np.ndarray, log_ms: np.ndarray) -> np.ndarray:
+    """Per-level twiddle multiply: twiddles are SHARED across the batch
+    (they depend on (n, level) only), so the LUT is one (blocks, 256)
+    table broadcast over the batch axis — not materialized per axis."""
+    _l, exp = _tables()
+    consts = np.where(log_ms == K_MODULUS, 0, exp[log_ms]).astype(np.uint8)
+    luts = mul_table()[consts]  # (blocks, 256)
+    b_idx = np.arange(len(log_ms)).reshape(1, -1, *((1,) * (v_half.ndim - 2)))
+    return luts[b_idx, v_half]
+
+
+def _decode_core(work: np.ndarray, n: int) -> None:
+    """The erasure-pattern-INDEPENDENT middle of the Leopard decode,
+    in place on work (A, >=n, ...): full-length IFFT, formal derivative,
+    FFT. Everything pattern-dependent (locator scale/unscale) happens
+    outside; this core is one fixed GF(256)-linear map per n, which is
+    what lets ops/repair_tpu.py compile it to a single GF(2) bit-matrix
+    for the MXU."""
+    a_count = work.shape[0]
+    dist = 1
+    while dist < n:
+        log_ms = _level_logs(n, dist, 0)
+        v = work[:, :n].reshape(a_count, -1, 2, dist, *work.shape[2:])
+        v[:, :, 1] ^= v[:, :, 0]
+        v[:, :, 0] ^= _mul_shared(v[:, :, 1], log_ms)
+        dist *= 2
+    for i in range(1, n):
+        width = ((i ^ (i - 1)) + 1) >> 1
+        work[:, i - width : i] ^= work[:, i : i + width]
+    dist = n >> 1
+    while dist >= 1:
+        log_ms = _level_logs(n, dist, 0)
+        v = work[:, :n].reshape(a_count, -1, 2, dist, *work.shape[2:])
+        v[:, :, 0] ^= _mul_shared(v[:, :, 1], log_ms)
+        v[:, :, 1] ^= v[:, :, 0]
+        dist >>= 1
+
+
+@functools.lru_cache(maxsize=8)
+def decode_core_matrix(n: int) -> np.ndarray:
+    """The (n, n) GF(256) matrix of _decode_core: out = T @ in per byte
+    lane. Derived by pushing the identity through the core (same
+    derivation style as encode_matrix)."""
+    eye = np.eye(n, dtype=np.uint8)[None]  # (1, n, n): byte lane j = e_j
+    work = eye.copy()
+    _decode_core(work, n)
+    return work[0].copy()
 
 
 def leopard_decode_batch(
@@ -298,35 +351,7 @@ def leopard_decode_batch(
     # derivative reach is i + width == n), so n rows suffice
     work = _mul_bytes_batch(codeword, scale_logs)
 
-    # transforms batched over axis 0; per-level twiddles are SHARED across
-    # the batch (they depend on (n, level) only), so the LUT is one
-    # (blocks, 256) table broadcast over A — not materialized per axis
-    def _mul_shared(v_half: np.ndarray, log_ms: np.ndarray) -> np.ndarray:
-        _l, exp = _tables()
-        consts = np.where(log_ms == K_MODULUS, 0, exp[log_ms]).astype(np.uint8)
-        luts = mul_table()[consts]  # (blocks, 256)
-        b_idx = np.arange(len(log_ms)).reshape(
-            1, -1, *((1,) * (v_half.ndim - 2))
-        )
-        return luts[b_idx, v_half]
-
-    dist = 1
-    while dist < n:
-        log_ms = _level_logs(n, dist, 0)
-        v = work[:, :n].reshape(a_count, -1, 2, dist, *work.shape[2:])
-        v[:, :, 1] ^= v[:, :, 0]
-        v[:, :, 0] ^= _mul_shared(v[:, :, 1], log_ms)
-        dist *= 2
-    for i in range(1, n):
-        width = ((i ^ (i - 1)) + 1) >> 1
-        work[:, i - width : i] ^= work[:, i : i + width]
-    dist = n >> 1
-    while dist >= 1:
-        log_ms = _level_logs(n, dist, 0)
-        v = work[:, :n].reshape(a_count, -1, 2, dist, *work.shape[2:])
-        v[:, :, 0] ^= _mul_shared(v[:, :, 1], log_ms)
-        v[:, :, 1] ^= v[:, :, 0]
-        dist >>= 1
+    _decode_core(work, n)
 
     unscale_logs = np.where(
         erased == 1, (K_MODULUS - loc[:, :n]) % K_MODULUS, K_MODULUS

@@ -32,19 +32,25 @@ import numpy as np
 from celestia_tpu.ops import gf256
 
 
-@functools.lru_cache(maxsize=16)
-def encode_bit_matrix(k: int) -> np.ndarray:
-    """(8k, 8k) uint8 0/1 matrix M2 with parity_bits = M2 @ data_bits mod 2."""
-    m = gf256.encode_matrix(k)  # (k, k) GF(256)
+def expand_bit_matrix(m: np.ndarray) -> np.ndarray:
+    """Expand an (r, c) GF(256) matrix to its (8r, 8c) 0/1 matrix over
+    GF(2): block (j, i) is the 8×8 companion matrix of
+    multiply-by-m[j,i], bit lanes LSB-first (out[8j+r, 8i+c] =
+    bit_r(m[j,i] * x^c))."""
     mul = gf256.mul_table()
     powers = (1 << np.arange(8)).astype(np.uint8)  # x^c as bytes
-    # prod[j, i, c] = M[j,i] * x^c  (byte)
+    # prod[j, i, c] = m[j,i] * x^c  (byte)
     prod = mul[m[:, :, None], powers[None, None, :]]
     # bits[j, i, c, r] = bit r of prod
     bits = (prod[..., None] >> np.arange(8)) & 1
-    # M2[8j+r, 8i+c]
-    m2 = bits.transpose(0, 3, 1, 2).reshape(8 * k, 8 * k)
-    return m2.astype(np.uint8)
+    out = bits.transpose(0, 3, 1, 2).reshape(8 * m.shape[0], 8 * m.shape[1])
+    return out.astype(np.uint8)
+
+
+@functools.lru_cache(maxsize=16)
+def encode_bit_matrix(k: int) -> np.ndarray:
+    """(8k, 8k) uint8 0/1 matrix M2 with parity_bits = M2 @ data_bits mod 2."""
+    return expand_bit_matrix(gf256.encode_matrix(k))
 
 
 def unpack_bits(x: jnp.ndarray) -> jnp.ndarray:

@@ -155,3 +155,130 @@ class TestRepair:
         present[0, 0] = False
         got = repair(eds.data, present, eds.row_roots(), eds.col_roots())
         assert np.array_equal(got, eds.data)
+
+
+def _patterns(k, rng):
+    """A mix of random and adversarial presence masks for a 2k x 2k EDS."""
+    width = 2 * k
+    out = []
+    for frac in (0.2, 0.35):
+        p = np.ones((width, width), dtype=bool)
+        flat = rng.choice(width * width, size=int(frac * width * width), replace=False)
+        p.reshape(-1)[flat] = False
+        out.append(p)
+    # multi-sweep: full row + full column + corner
+    p = np.ones((width, width), dtype=bool)
+    p[1, :] = False
+    p[:, 2] = False
+    p[0, 0] = False
+    out.append(p)
+    return out
+
+
+class TestRepairTpu:
+    """The MXU bit-matmul repair path (ops/repair_tpu) pinned against the
+    host Leopard path and the truth, on the CPU mesh."""
+
+    @pytest.mark.parametrize("k", [2, 4, 8])
+    def test_matches_host_and_truth(self, k):
+        from celestia_tpu.ops import repair_tpu
+
+        eds = make_eds(k, seed=20 + k)
+        rng = np.random.default_rng(30 + k)
+        for present in _patterns(k, rng):
+            src = np.where(present[..., None], eds.data, 0)
+            ref = repair(src, present.copy())
+            got = repair_tpu.repair_tpu(src, present)
+            assert np.array_equal(got, ref)
+            assert np.array_equal(got, eds.data)
+
+    def test_erased_garbage_ignored(self):
+        from celestia_tpu.ops import repair_tpu
+
+        eds = make_eds(4, seed=41)
+        present = np.ones((8, 8), dtype=bool)
+        present[0, :5] = False
+        present[3, 2] = False
+        corrupted = eds.data.copy()
+        corrupted[~present] = 0xCD
+        got = repair_tpu.repair_tpu(corrupted, present)
+        assert np.array_equal(got, eds.data)
+
+    def test_unrepairable_raises_in_planning(self):
+        from celestia_tpu.ops import repair_tpu
+
+        present = np.zeros((4, 4), dtype=bool)
+        present[0, 0] = True
+        with pytest.raises(UnrepairableError):
+            repair_tpu.plan_sweeps(present, 2)
+
+    def test_plan_is_mask_only(self):
+        """The sweep schedule must be derivable from the mask alone —
+        identical masks yield identical plans regardless of data."""
+        from celestia_tpu.ops import repair_tpu
+
+        present = np.ones((8, 8), dtype=bool)
+        present[2, :] = False
+        present[:, 5] = False
+        a = repair_tpu.plan_sweeps(present, 4)
+        b = repair_tpu.plan_sweeps(present, 4)
+        assert len(a) == len(b) > 1  # multi-sweep pattern
+        for pa, pb in zip(a, b):
+            assert pa.transpose == pb.transpose
+            assert np.array_equal(pa.scale_bytes, pb.scale_bytes)
+            assert np.array_equal(pa.write, pb.write)
+
+
+class TestNativeRepair:
+    """The C++ Leopard decode/repair (the measured CPU baseline for
+    BASELINE config 4) against the host path and the truth."""
+
+    def _native(self):
+        from celestia_tpu import native
+
+        if not native.available():
+            pytest.skip("native toolchain unavailable")
+        return native
+
+    @pytest.mark.parametrize("k", [2, 4, 8])
+    def test_decode_matches_host(self, k):
+        native = self._native()
+        rng = np.random.default_rng(k)
+        data = rng.integers(0, 256, size=(k, 48), dtype=np.uint8)
+        cells = np.concatenate([data, gf256.leopard_encode(data)], axis=0)
+        for _ in range(4):
+            present = np.zeros(2 * k, dtype=bool)
+            keep = rng.choice(2 * k, size=k + int(rng.integers(0, k)), replace=False)
+            present[keep] = True
+            src = np.where(present[:, None], cells, 0)
+            got = native.leo_decode(src, present)
+            ref = gf256.leopard_decode(src, present, k)
+            assert np.array_equal(got, ref)
+            assert np.array_equal(got, cells)
+
+    @pytest.mark.parametrize("k", [2, 4, 8])
+    def test_repair_matches_host_and_truth(self, k):
+        native = self._native()
+        eds = make_eds(k, seed=50 + k)
+        rng = np.random.default_rng(60 + k)
+        for present in _patterns(k, rng):
+            src = np.where(present[..., None], eds.data, 0)
+            ref = repair(src, present.copy())
+            got = native.eds_repair(src, present)
+            assert np.array_equal(got, ref)
+            assert np.array_equal(got, eds.data)
+
+    def test_unrepairable_raises(self):
+        native = self._native()
+        eds = make_eds(2, seed=3)
+        present = np.zeros((4, 4), dtype=bool)
+        present[0, 0] = True
+        with pytest.raises(UnrepairableError, match="impossible to recover"):
+            native.eds_repair(eds.data, present)
+
+    def test_decode_underdetermined_raises(self):
+        native = self._native()
+        present = np.zeros(8, dtype=bool)
+        present[:3] = True  # 3 < k=4
+        with pytest.raises(ValueError, match="not enough shards"):
+            native.leo_decode(np.zeros((8, 16), dtype=np.uint8), present)
