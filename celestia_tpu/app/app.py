@@ -54,8 +54,12 @@ from celestia_tpu.x.transfer import (
 from celestia_tpu.x.upgrade import MsgVersionChange, UpgradeKeeper
 from celestia_tpu.x.vesting import MsgCreateVestingAccount, VestingKeeper
 
+from celestia_tpu.log import logger
+
 from .ante import AnteHandler
 from .context import Context, ExecMode, GasMeter
+
+log = logger("app")
 
 GENESIS_CHAIN_ID = "celestia-tpu-1"
 
@@ -77,14 +81,45 @@ class ProposalBlockData:
     hash: bytes
 
 
+# Measured crossover for the auto backend (bench config 1 vs 2): at k=2
+# the device path is dispatch-bound (0.18x native), at k=32 it is ~50x.
+# Below this square size "auto" stays on the native CPU runtime.
+TPU_MIN_SQUARE = 16
+
+_accel_probe: bool | None = None
+
+
+def accelerator_available() -> bool:
+    """True when jax's default backend is an accelerator (not the host
+    CPU). Probed once; a broken device/tunnel reads as unavailable."""
+    global _accel_probe
+    if _accel_probe is None:
+        try:
+            import jax
+
+            _accel_probe = jax.devices()[0].platform not in ("cpu",)
+        except Exception:  # noqa: BLE001 — any init failure means "no device"
+            _accel_probe = False
+    return _accel_probe
+
+
 class App:
     SUPPORTED_VERSIONS = (1, 2)
 
     def __init__(self, chain_id: str = GENESIS_CHAIN_ID, app_version: int = 1,
-                 use_tpu: bool = False, upgrade_schedule: dict | None = None):
+                 use_tpu: bool = False, upgrade_schedule: dict | None = None,
+                 extend_backend: str | None = None):
         self.chain_id = chain_id
         self.app_version = app_version
         self.use_tpu = use_tpu
+        # use_tpu predates extend_backend and forces the device path
+        self.extend_backend = "tpu" if use_tpu else (extend_backend or "auto")
+        if self.extend_backend not in ("auto", "tpu", "native", "numpy"):
+            raise ValueError(
+                f"unknown extend backend {self.extend_backend!r} "
+                "(want auto|tpu|native|numpy)"
+            )
+        self._active_backend: str | None = None  # last backend logged
         self.store = StateStore()
         self.accounts = AccountKeeper(self.store)
         self.bank = BankKeeper(self.store)
@@ -194,22 +229,49 @@ class App:
             appconsts.square_size_upper_bound(self.app_version),
         )
 
+    def resolve_extend_backend(self, k: int) -> str:
+        """Pick the live ExtendBlock backend for a k×k square.
+
+        auto: device when an accelerator is present and k is above the
+        measured dispatch-bound crossover (TPU_MIN_SQUARE); else the
+        native C++ runtime; else numpy. Explicit backends are honored
+        ("tpu" means the jax device path on whatever backend jax has —
+        the CPU-mesh tests exercise it without hardware). All backends
+        are byte-identical (pinned by tests + the DAH oracles)."""
+        from celestia_tpu import native
+
+        backend = self.extend_backend
+        if backend == "auto":
+            if accelerator_available() and k >= TPU_MIN_SQUARE:
+                backend = "tpu"
+            elif native.available():
+                backend = "native"
+            else:
+                backend = "numpy"
+        elif backend == "native" and not native.available():
+            backend = "numpy"
+        if backend != self._active_backend:
+            log.info("extend backend", backend=backend, k=k,
+                     configured=self.extend_backend)
+            self._active_backend = backend
+        return backend
+
     def _extend_and_hash(self, data_square) -> tuple:
         """The hot path: square -> EDS -> DAH. ref: app/prepare_proposal.go:95
 
-        Backend order: TPU (use_tpu=True) > native C++ runtime > numpy
-        reference path — all byte-identical.
+        Backend per resolve_extend_backend; all byte-identical.
         """
         from celestia_tpu import native
 
-        if self.use_tpu or native.available():
+        k = square_pkg.square_size(len(data_square))
+        backend = self.resolve_extend_backend(k)
+        if backend in ("tpu", "native"):
             import numpy as np
 
-            k = square_pkg.square_size(len(data_square))
             arr = np.frombuffer(
                 b"".join(s.data for s in data_square), dtype=np.uint8
             ).reshape(k, k, appconsts.SHARE_SIZE)
-            if self.use_tpu:
+            if backend == "tpu":
                 from celestia_tpu.ops import extend_tpu
 
                 # Device computes EDS + axis roots; the tiny DAH merkle tree

@@ -63,13 +63,15 @@ def cmd_init(args):
     print(f"wrote {home}/config/config.toml and {home}/config/app.toml")
 
 
-def _build_node(home: pathlib.Path):
+def _build_node(home: pathlib.Path, **app_kwargs):
     from celestia_tpu.app import App
     from celestia_tpu.node import Node
 
     genesis = json.loads((home / "genesis.json").read_text())
     if (home / "meta.json").exists():
-        return Node.load(str(home))
+        # app_kwargs reach the App BEFORE the startup replay so e.g. a
+        # configured extend_backend governs the batched DA verification
+        return Node.load(str(home), **app_kwargs)
     if (home / "blocks").exists() and any((home / "blocks").glob("*.json")):
         raise RuntimeError(
             f"{home} has persisted blocks but no state snapshot "
@@ -80,9 +82,9 @@ def _build_node(home: pathlib.Path):
         # genesis produced by `export` — rebuild the full module state
         from celestia_tpu.app.export import import_genesis
 
-        app = import_genesis(genesis)
+        app = import_genesis(genesis, **app_kwargs)
         return Node(app, home=str(home))
-    app = App(chain_id=genesis["chain_id"])
+    app = App(chain_id=genesis["chain_id"], **app_kwargs)
     app.init_chain(
         genesis["accounts"],
         genesis_time=genesis["genesis_time"],
@@ -101,16 +103,26 @@ def cmd_start(args):
     flag_overrides = {}
     if args.block_time is not None:
         flag_overrides["consensus.goal_block_time_seconds"] = args.block_time
+    if getattr(args, "extend_backend", None) is not None:
+        flag_overrides["app.extend_backend"] = args.extend_backend
     cfg = load_config(home, flag_overrides)
-    node = _build_node(home)
+    # App.__init__ validates the backend string, so a config/env typo
+    # fails loudly here instead of silently degrading to numpy
+    node = _build_node(home, extend_backend=cfg.app.extend_backend)
     node.app.min_gas_price = cfg.app.min_gas_price
     node.mempool.ttl_blocks = cfg.consensus.mempool.ttl_num_blocks
     node.mempool.max_tx_bytes = cfg.consensus.mempool.max_tx_bytes
+    # resolve + log the live backend up front so the operator sees what
+    # this node will actually run on the hot path
+    live = node.app.resolve_extend_backend(
+        node.app.gov_square_size_upper_bound()
+    )
     server = RpcServer(node, port=args.port)
     server.start()
     print(f"node started: chain {node.app.chain_id} height {node.latest_height()} "
           f"rpc http://127.0.0.1:{server.port} "
-          f"min-gas-price {cfg.app.min_gas_price}")
+          f"min-gas-price {cfg.app.min_gas_price} "
+          f"extend-backend {cfg.app.extend_backend} (live: {live})")
     # an initial snapshot so a hard crash before the first interval never
     # leaves blocks-without-meta (which _build_node refuses to re-init)
     node.save_snapshot()
@@ -239,6 +251,10 @@ def main(argv=None):
     p_start = sub.add_parser("start")
     # None = "flag not passed" so config-file/env values aren't masked
     p_start.add_argument("--block-time", type=float, default=None)
+    p_start.add_argument("--extend-backend", default=None,
+                         choices=["auto", "tpu", "native", "numpy"],
+                         help="ExtendBlock backend (default: config "
+                              "app.extend_backend, 'auto')")
     p_start.add_argument("--log-level", default="info",
                          choices=["debug", "info", "warning", "error"])
 

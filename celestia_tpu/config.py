@@ -89,6 +89,12 @@ class AppConfig:
     api_enable: bool = False
     grpc_enable: bool = False
     grpc_web_enable: bool = False
+    # ExtendBlock backend: auto | tpu | native | numpy. "auto" picks the
+    # accelerator when a device is present AND the square is above the
+    # measured dispatch-bound crossover (app.app.TPU_MIN_SQUARE), else the
+    # native C++ runtime, else numpy. This framework's analogue of the
+    # reference selecting its codec at pkg/appconsts/global_consts.go:92.
+    extend_backend: str = "auto"
     state_sync: StateSyncConfig = dataclasses.field(default_factory=StateSyncConfig)
 
 

@@ -331,8 +331,11 @@ class Node:
         # snapshot — replay the newer blocks through the app (the WAL
         # replay the reference gets from cometbft), verifying each
         # replayed commit against the stored app hash.
-        for height in sorted(h for h in node.blocks if h > app.height):
-            block = node.blocks[height]
+        pending = [node.blocks[h]
+                   for h in sorted(h for h in node.blocks if h > app.height)]
+        da_verified = node._batch_verify_data_availability(app, pending)
+        for block in pending:
+            height = block.height
             app.begin_block(block.time)
             for raw in block.txs:
                 app.deliver_tx(raw)
@@ -344,5 +347,98 @@ class Node:
                     f"{app_hash.hex()}, stored block has "
                     f"{block.app_hash.hex()} — state corruption"
                 )
-            log.info("replayed block", height=height, app_hash=app_hash)
+            if height not in da_verified:
+                # fallback (e.g. an app-version change inside the replay
+                # window): verify solo at the now-current version
+                node._verify_block_data_hash(app, block)
+            log.info("replayed block", height=height, app_hash=app_hash,
+                     da_verified=True)
         return node
+
+    @staticmethod
+    def _rebuild_square(app: App, block: "Block"):
+        from celestia_tpu import square as square_pkg
+        from celestia_tpu.appconsts import square_size_upper_bound
+
+        return square_pkg.construct(
+            block.txs, app.app_version, square_size_upper_bound(app.app_version)
+        )
+
+    @staticmethod
+    def _verify_block_data_hash(app: App, block: "Block") -> None:
+        square = Node._rebuild_square(app, block)
+        _eds, dah = app._extend_and_hash(square)
+        if dah.hash() != block.data_hash:
+            raise ValueError(
+                f"replayed block {block.height} data hash mismatch — "
+                "block store corruption"
+            )
+
+    @staticmethod
+    def _batch_verify_data_availability(app: App, pending: list["Block"]):
+        """Re-verify the data roots of queued replay blocks, batched.
+
+        A catching-up node has many squares queued; equal sizes ride ONE
+        batched device dispatch (ops/extend_tpu.extend_and_root_batched —
+        the dp axis of the multichip design) instead of per-block calls.
+        Returns the set of heights verified. This pre-pass rebuilds
+        squares at the snapshot's app version, which can legitimately
+        mismatch after an upgrade inside the window — so it never raises:
+        any block it cannot positively verify is re-checked by the
+        in-loop solo fallback at the then-current version, which IS
+        authoritative."""
+        import numpy as np
+
+        from celestia_tpu import square as square_pkg
+        from celestia_tpu.appconsts import SHARE_SIZE
+
+        verified: set[int] = set()
+        if not pending:
+            return verified
+        groups: dict[int, list] = {}  # k -> [(block, data_square), ...]
+        for block in pending:
+            try:
+                sq = Node._rebuild_square(app, block)
+            except Exception:  # noqa: BLE001 — solo fallback decides
+                continue
+            k = square_pkg.square_size(len(sq))
+            if k != block.square_size:
+                continue  # version drift — leave for the solo fallback
+            groups.setdefault(k, []).append((block, sq))
+
+        for k, items in groups.items():
+            backend = app.resolve_extend_backend(k)
+            if backend == "tpu" and len(items) > 1:
+                import jax.numpy as jnp
+
+                from celestia_tpu import da as da_pkg
+                from celestia_tpu.ops import extend_tpu, rs_tpu
+
+                m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
+                batch = np.stack(
+                    [
+                        np.frombuffer(
+                            b"".join(s.data for s in sq), dtype=np.uint8
+                        ).reshape(k, k, SHARE_SIZE)
+                        for _b, sq in items
+                    ]
+                )
+                _eds, rows, cols, _dah = (
+                    extend_tpu.extend_and_root_batched(jnp.asarray(batch), m2)
+                )
+                rows, cols = np.asarray(rows), np.asarray(cols)
+                for i, (block, _sq) in enumerate(items):
+                    dah = da_pkg.DataAvailabilityHeader(
+                        [r.tobytes() for r in rows[i]],
+                        [c.tobytes() for c in cols[i]],
+                    )
+                    if dah.hash() == block.data_hash:
+                        verified.add(block.height)
+                log.info("batched DA verification", k=k, blocks=len(items),
+                         backend=backend)
+            else:
+                for block, sq in items:
+                    _eds, dah = app._extend_and_hash(sq)
+                    if dah.hash() == block.data_hash:
+                        verified.add(block.height)
+        return verified

@@ -81,6 +81,8 @@ def _handler_for(node: Node):
                             "height": node.latest_height(),
                             "app_version": node.app.app_version,
                             "mempool_size": len(node.mempool),
+                            "extend_backend": node.app.extend_backend,
+                            "extend_backend_live": node.app._active_backend,
                         }
                     )
                 elif len(parts) == 2 and parts[0] == "block":

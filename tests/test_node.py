@@ -269,6 +269,102 @@ class TestStateSync:
         with pytest.raises(ValueError, match="state corruption"):
             Node.load(str(tmp_path))
 
+    def test_corrupt_data_hash_detected_on_replay(self, tmp_path):
+        """Replay re-verifies data availability: a stored block whose
+        data_hash doesn't match its txs is rejected."""
+        node = new_node(tmp_path)
+        node.save_snapshot()
+        node.produce_block(30.0)
+        import pathlib
+
+        path = pathlib.Path(tmp_path) / "blocks" / "2.json"
+        data = json.loads(path.read_text())
+        data["data_hash"] = "11" * 32
+        path.write_text(json.dumps(data))
+        with pytest.raises(ValueError, match="data hash mismatch"):
+            Node.load(str(tmp_path))
+
+    def test_batched_da_verification_on_replay(self, tmp_path):
+        """A catching-up node with several queued blocks of equal square
+        size verifies their data roots in ONE batched device dispatch
+        (extend_and_root_batched) when the device backend is live."""
+        node = new_node(tmp_path, extend_backend="tpu")
+        node.save_snapshot()  # snapshot at height 1
+        signer = Signer.setup_single(ALICE, node)
+        for i in range(3):
+            b = blob_pkg.new_blob(ns.new_v0(b"batchsync!"), bytes([i]) * 400, 0)
+            signer.submit_pay_for_blob([b])
+            node.produce_block(30.0 + 15.0 * i)
+
+        pending = [node.blocks[h] for h in (2, 3, 4)]
+        app2 = Node._restore_app(
+            json.loads((tmp_path / "meta.json").read_text()),
+            (tmp_path / "state.json").read_bytes(),
+            extend_backend="tpu",
+        )
+        verified = Node._batch_verify_data_availability(app2, pending)
+        assert verified == {2, 3, 4}
+
+        recovered = Node.load(str(tmp_path), extend_backend="tpu")
+        assert recovered.app.height == 4
+        assert recovered.produce_block(90.0).app_hash == \
+            node.produce_block(90.0).app_hash
+
+
+class TestExtendBackend:
+    """Backend selection for the ExtendBlock hot path (config flag +
+    crossover auto rule) — the operator-facing TPU wiring."""
+
+    def test_invalid_backend_rejected(self):
+        with pytest.raises(ValueError, match="unknown extend backend"):
+            App(extend_backend="cuda")
+
+    def test_auto_rules(self, monkeypatch):
+        import celestia_tpu.app.app as app_mod
+        from celestia_tpu import native
+
+        app = App(extend_backend="auto")
+        # accelerator present: device above the crossover, native below
+        monkeypatch.setattr(app_mod, "_accel_probe", True)
+        monkeypatch.setattr(native, "available", lambda: True)
+        assert app.resolve_extend_backend(128) == "tpu"
+        assert app.resolve_extend_backend(app_mod.TPU_MIN_SQUARE) == "tpu"
+        assert app.resolve_extend_backend(2) == "native"
+        # no accelerator: native everywhere, numpy as last resort
+        monkeypatch.setattr(app_mod, "_accel_probe", False)
+        assert app.resolve_extend_backend(128) == "native"
+        monkeypatch.setattr(native, "available", lambda: False)
+        assert app.resolve_extend_backend(128) == "numpy"
+
+    def test_cross_backend_proposal_acceptance(self):
+        """A proposal produced on the device path must be accepted by a
+        validator running numpy (and vice versa): process_proposal
+        recomputes the DAH on its own backend and compares hashes, so
+        this pins the backends byte-identical through the full node
+        path. (Tx bytes themselves are signature-nonced, so two
+        independently-signed chains can't be compared directly.)"""
+        from celestia_tpu.app.app import ProposalBlockData
+
+        a = new_node(extend_backend="tpu")
+        b = new_node(extend_backend="numpy")
+        signer = Signer.setup_single(ALICE, a)
+        blob = blob_pkg.new_blob(ns.new_v0(b"backendtst"), b"\x42" * 600, 0)
+        signer.submit_pay_for_blob([blob])
+        proposal = a.app.prepare_proposal(a.mempool.reap())
+        assert a.app._active_backend == "tpu"
+        assert b.app.process_proposal(proposal)  # numpy validates tpu
+        assert b.app._active_backend == "numpy"
+        # and the reverse direction
+        proposal_b = b.app.prepare_proposal(proposal.txs)
+        assert proposal_b.hash == proposal.hash
+        assert a.app.process_proposal(proposal_b)
+
+    def test_config_layer_carries_backend(self, tmp_path):
+        from celestia_tpu.config import load_config
+
+        cfg = load_config(tmp_path, {"app.extend_backend": "native"})
+        assert cfg.app.extend_backend == "native"
+
 
 class TestRpc:
     def test_http_api(self):
