@@ -41,7 +41,20 @@ from celestia_tpu.x.distribution import (
 from celestia_tpu.x.gov import GovKeeper, MsgDeposit, MsgSubmitProposal, MsgVote
 from celestia_tpu.x.mint import MintKeeper
 from celestia_tpu.x.paramfilter import apply_param_changes
-from celestia_tpu.x.ibc import MsgAcknowledgement, MsgRecvPacket, MsgTimeout
+from celestia_tpu.x.ibc import (
+    MsgAcknowledgement,
+    MsgRecvPacket,
+    MsgTimeout,
+    packet_ack_key,
+    packet_commitment_key,
+    packet_receipt_key,
+)
+from celestia_tpu.x.lightclient import (
+    ClientKeeper,
+    MsgCreateClient,
+    MsgSubmitMisbehaviour,
+    MsgUpdateClient,
+)
 from celestia_tpu.x.slashing import MsgUnjail, SlashingKeeper
 from celestia_tpu.x.staking import MsgDelegate, MsgUndelegate, StakingKeeper
 from celestia_tpu.x.tokenfilter import TokenFilterMiddleware
@@ -635,15 +648,21 @@ class App:
         elif isinstance(msg, MsgRecvPacket):
             self._handle_recv_packet(ctx, msg)
         elif isinstance(msg, MsgAcknowledgement):
-            transfer = TransferKeeper(ctx.store, BankKeeper(ctx.store))
-            transfer.channels.require_relayer(msg.signer)
-            self._transfer_stack(transfer).on_acknowledgement_packet(
-                ctx, msg.packet, msg.acknowledgement
-            )
+            self._handle_acknowledgement(ctx, msg)
         elif isinstance(msg, MsgTimeout):
-            transfer = TransferKeeper(ctx.store, BankKeeper(ctx.store))
-            transfer.channels.require_relayer(msg.signer)
-            self._transfer_stack(transfer).on_timeout_packet(ctx, msg.packet)
+            self._handle_timeout(ctx, msg)
+        elif isinstance(msg, MsgCreateClient):
+            ClientKeeper(ctx.store).create_client(
+                msg.client_id, msg.chain_id, msg.initial_header
+            )
+        elif isinstance(msg, MsgUpdateClient):
+            ClientKeeper(ctx.store).update_client(
+                msg.client_id, msg.signed_header
+            )
+        elif isinstance(msg, MsgSubmitMisbehaviour):
+            ClientKeeper(ctx.store).submit_misbehaviour(
+                msg.client_id, msg.header_a, msg.header_b
+            )
         else:
             raise ValueError(f"unroutable message type {type(msg).__name__}")
 
@@ -652,18 +671,117 @@ class App:
         """tokenfilter over transfer (ref: app/app.go:380-385)."""
         return TokenFilterMiddleware(TransferIBCModule(transfer))
 
+    def _authorize_packet_msg(
+        self, ctx: Context, channels, port_id: str, channel_id: str, msg
+    ) -> str:
+        """Per-channel trust model dispatch: a client-bound channel
+        requires a proof on the message (returns the client id to verify
+        it against); a legacy channel requires a registered relayer
+        (returns "")."""
+        ch = channels.get_channel(port_id, channel_id)
+        if ch is None:
+            raise ValueError(f"channel {port_id}/{channel_id} is not open")
+        if ch.client_id:
+            if msg.proof is None:
+                raise ValueError(
+                    f"channel {port_id}/{channel_id} is bound to client "
+                    f"{ch.client_id}: packet messages must carry a proof"
+                )
+            return ch.client_id
+        channels.require_relayer(msg.signer)
+        return ""
+
     def _handle_recv_packet(self, ctx: Context, msg: MsgRecvPacket) -> None:
         """04-channel RecvPacket: receipt + app callback + written ack.
         An error ack is NOT a tx failure — state effects of the receipt
-        and ack persist, only the app-level transfer is refused."""
+        and ack persist, only the app-level transfer is refused.
+
+        On a client-bound channel the packet commitment is proven under
+        the counterparty app hash (ibc-go proofCommitment,
+        04-channel RecvPacket verification)."""
         packet = msg.packet
         if packet.destination_port != PORT_ID_TRANSFER:
             raise ValueError(f"no app bound to port {packet.destination_port}")
         transfer = TransferKeeper(ctx.store, BankKeeper(ctx.store))
-        transfer.channels.require_relayer(msg.signer)
+        client_id = self._authorize_packet_msg(
+            ctx, transfer.channels,
+            packet.destination_port, packet.destination_channel, msg,
+        )
+        if client_id:
+            ClientKeeper(ctx.store).verify_membership(
+                client_id,
+                msg.proof_height,
+                packet_commitment_key(
+                    packet.source_port, packet.source_channel, packet.sequence
+                ),
+                packet.commitment(),
+                msg.proof,
+            )
         transfer.channels.recv_packet(packet, ctx.block_time)
         ack = self._transfer_stack(transfer).on_recv_packet(ctx, packet)
         transfer.channels.write_acknowledgement(packet, ack)
+
+    def _handle_acknowledgement(self, ctx: Context, msg: MsgAcknowledgement) -> None:
+        """04-channel AcknowledgePacket: on a client-bound channel the
+        written ack bytes are proven under the counterparty app hash
+        (proofAcked) before the commitment is cleared and the app
+        callback runs."""
+        packet = msg.packet
+        transfer = TransferKeeper(ctx.store, BankKeeper(ctx.store))
+        client_id = self._authorize_packet_msg(
+            ctx, transfer.channels,
+            packet.source_port, packet.source_channel, msg,
+        )
+        if client_id:
+            ClientKeeper(ctx.store).verify_membership(
+                client_id,
+                msg.proof_height,
+                packet_ack_key(
+                    packet.destination_port, packet.destination_channel,
+                    packet.sequence,
+                ),
+                msg.acknowledgement.marshal(),
+                msg.proof,
+            )
+        self._transfer_stack(transfer).on_acknowledgement_packet(
+            ctx, packet, msg.acknowledgement
+        )
+
+    def _handle_timeout(self, ctx: Context, msg: MsgTimeout) -> None:
+        """04-channel TimeoutPacket: on a client-bound channel the
+        refund requires (a) a receipt ABSENCE proof on the counterparty
+        (proofUnreceived) and (b) a verified counterparty header whose
+        time is past the packet timeout — so a delivered packet can
+        never also be refunded (the recv+timeout double-credit)."""
+        packet = msg.packet
+        transfer = TransferKeeper(ctx.store, BankKeeper(ctx.store))
+        client_id = self._authorize_packet_msg(
+            ctx, transfer.channels,
+            packet.source_port, packet.source_channel, msg,
+        )
+        if client_id:
+            clients = ClientKeeper(ctx.store)
+            cons = clients.get_consensus_state(client_id, msg.proof_height)
+            if cons is None:
+                raise ValueError(
+                    f"no consensus state at height {msg.proof_height}"
+                )
+            if cons.timestamp < packet.timeout_timestamp:
+                raise ValueError(
+                    "timeout not yet elapsed on the counterparty: header "
+                    f"time {cons.timestamp} < timeout "
+                    f"{packet.timeout_timestamp}"
+                )
+            clients.verify_non_membership(
+                client_id,
+                msg.proof_height,
+                packet_receipt_key(
+                    packet.destination_port, packet.destination_channel,
+                    packet.sequence,
+                ),
+                msg.proof,
+            )
+        self._transfer_stack(transfer).on_timeout_packet(ctx, packet)
 
     def _gov_keeper(self, ctx) -> GovKeeper:
         bank = BankKeeper(ctx.store)

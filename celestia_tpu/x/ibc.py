@@ -15,15 +15,23 @@ tokenfilter middleware (x/tokenfilter.py) run on:
 - ack path: sender-side commitment verification + deletion on
   acknowledgement, with the ack routed back to the sending application
 
-Light-client header verification is consciously absent (the reference
-gets it from 02-client/tendermint): a relayer here is trusted to carry
-bytes between in-process chains, which is exactly the boundary
-test/util/testnode's ibctesting setup exercises. That trust is ENFORCED,
-not assumed: packet-bearing messages (MsgRecvPacket / MsgAcknowledgement
-/ MsgTimeout) are only accepted from relayer accounts registered in the
-channel keeper (register_relayer) — the stand-in for ibc-go's
-commitment-proof verification, without which any funded account could
-forge packets against the transfer escrow.
+Packet verification comes in two trust models, selected per channel:
+
+- **light-client mode** (the reference's model, `Channel.client_id`
+  set): packet messages carry SMT commitment proofs + a proof height;
+  the handler verifies them against the counterparty app hash tracked
+  by the 02-client analogue (x/lightclient.py). No relayer
+  registration — any account that can produce a valid proof may relay,
+  exactly like ibc-go. MsgTimeout requires a receipt *absence* proof,
+  so a relayer cannot deliver on the destination and still claim a
+  timeout refund on the source (the double-credit a pure clock check
+  would allow).
+- **trusted-relayer mode** (`client_id` empty — legacy/test substrate):
+  packet-bearing messages are only accepted from relayer accounts
+  registered in the channel keeper (register_relayer). That trust is
+  ENFORCED, not assumed — but it is a materially weaker model: a
+  registered relayer can forge packets and double-credit via
+  recv+timeout. Production channels should bind a client.
 """
 
 from __future__ import annotations
@@ -51,6 +59,11 @@ class Channel:
     counterparty_port_id: str
     counterparty_channel_id: str
     state: str = CHANNEL_STATE_OPEN
+    # 02-client binding: when set, packet messages on this channel must
+    # carry proofs verified by this light client (x/lightclient.py).
+    # Empty = legacy trusted-relayer substrate. (Divergence from ibc-go:
+    # no 03-connection indirection — the channel binds its client.)
+    client_id: str = ""
 
     def marshal(self) -> bytes:
         return json.dumps(dataclasses.asdict(self), sort_keys=True).encode()
@@ -129,29 +142,55 @@ URL_MSG_ACKNOWLEDGEMENT = "/ibc.core.channel.v1.MsgAcknowledgement"
 URL_MSG_TIMEOUT = "/ibc.core.channel.v1.MsgTimeout"
 
 
+def _marshal_proof(proof) -> bytes:
+    """smt.Proof → deterministic JSON bytes for the wire."""
+    return json.dumps(proof.marshal(), sort_keys=True).encode()
+
+
+def _unmarshal_proof(raw: bytes):
+    from celestia_tpu import smt as smt_mod
+
+    return smt_mod.Proof.unmarshal(json.loads(raw))
+
+
 def _register_packet_msgs():
-    from celestia_tpu.blob import _field_bytes, _parse_fields, _require_wt
+    from celestia_tpu.blob import (
+        _field_bytes,
+        _field_uint,
+        _parse_fields,
+        _require_wt,
+    )
     from celestia_tpu.tx import register_msg
 
     @register_msg(URL_MSG_RECV_PACKET)
     @dataclasses.dataclass
     class MsgRecvPacket:
-        """Relayer-submitted packet delivery (04-channel MsgRecvPacket)."""
+        """Relayer-submitted packet delivery (04-channel MsgRecvPacket).
+
+        On a client-bound channel, `proof`/`proof_height` must prove the
+        packet commitment under the counterparty app hash at that
+        verified height (ibc-go's proofCommitment)."""
 
         packet: Packet
         signer: str  # the relayer
+        proof: object | None = None  # smt.Proof of the packet commitment
+        proof_height: int = 0
 
         def get_signers(self) -> list[str]:
             return [self.signer]
 
         def marshal(self) -> bytes:
-            return _field_bytes(
+            out = _field_bytes(
                 1, json.dumps(self.packet.to_json(), sort_keys=True).encode()
             ) + _field_bytes(2, self.signer.encode())
+            if self.proof is not None:
+                out += _field_bytes(3, _marshal_proof(self.proof))
+                out += _field_uint(4, self.proof_height)
+            return out
 
         @classmethod
         def unmarshal(cls, raw: bytes) -> "MsgRecvPacket":
-            packet, signer = None, ""
+            packet, signer, proof, height = None, "", None, 0
             for tag, wt, val in _parse_fields(raw):
                 if tag == 1:
                     _require_wt(wt, 2, tag)
@@ -159,38 +198,55 @@ def _register_packet_msgs():
                 elif tag == 2:
                     _require_wt(wt, 2, tag)
                     signer = bytes(val).decode()
+                elif tag == 3:
+                    _require_wt(wt, 2, tag)
+                    proof = _unmarshal_proof(bytes(val))
+                elif tag == 4:
+                    _require_wt(wt, 0, tag)
+                    height = val
             if packet is None:
                 raise ValueError("MsgRecvPacket without packet")
-            return cls(packet, signer)
+            return cls(packet, signer, proof, height)
 
         def validate_basic(self) -> None:
             if not self.signer:
                 raise ValueError("missing relayer signer")
+            if self.proof is not None and self.proof_height <= 0:
+                raise ValueError("proof without proof height")
 
     @register_msg(URL_MSG_ACKNOWLEDGEMENT)
     @dataclasses.dataclass
     class MsgAcknowledgement:
-        """Relayer-submitted ack delivery (04-channel MsgAcknowledgement)."""
+        """Relayer-submitted ack delivery (04-channel MsgAcknowledgement).
+
+        On a client-bound channel, `proof`/`proof_height` must prove the
+        written ack bytes under the counterparty app hash (proofAcked)."""
 
         packet: Packet
         acknowledgement: Acknowledgement
         signer: str
+        proof: object | None = None  # smt.Proof of the written ack
+        proof_height: int = 0
 
         def get_signers(self) -> list[str]:
             return [self.signer]
 
         def marshal(self) -> bytes:
-            return (
+            out = (
                 _field_bytes(
                     1, json.dumps(self.packet.to_json(), sort_keys=True).encode()
                 )
                 + _field_bytes(2, self.acknowledgement.marshal())
                 + _field_bytes(3, self.signer.encode())
             )
+            if self.proof is not None:
+                out += _field_bytes(4, _marshal_proof(self.proof))
+                out += _field_uint(5, self.proof_height)
+            return out
 
         @classmethod
         def unmarshal(cls, raw: bytes) -> "MsgAcknowledgement":
-            packet, ack, signer = None, None, ""
+            packet, ack, signer, proof, height = None, None, "", None, 0
             for tag, wt, val in _parse_fields(raw):
                 if tag == 1:
                     _require_wt(wt, 2, tag)
@@ -201,37 +257,56 @@ def _register_packet_msgs():
                 elif tag == 3:
                     _require_wt(wt, 2, tag)
                     signer = bytes(val).decode()
+                elif tag == 4:
+                    _require_wt(wt, 2, tag)
+                    proof = _unmarshal_proof(bytes(val))
+                elif tag == 5:
+                    _require_wt(wt, 0, tag)
+                    height = val
             if packet is None or ack is None:
                 raise ValueError("MsgAcknowledgement missing packet/ack")
-            return cls(packet, ack, signer)
+            return cls(packet, ack, signer, proof, height)
 
         def validate_basic(self) -> None:
             if not self.signer:
                 raise ValueError("missing relayer signer")
+            if self.proof is not None and self.proof_height <= 0:
+                raise ValueError("proof without proof height")
 
     @register_msg(URL_MSG_TIMEOUT)
     @dataclasses.dataclass
     class MsgTimeout:
-        """Relayer-submitted timeout (04-channel MsgTimeout). In ibc-go the
-        relayer proves non-receipt on the counterparty via the light
-        client; under this substrate's trusted-relayer model the sending
-        chain checks only that the timeout has objectively elapsed
-        (its own block time) before refunding."""
+        """Relayer-submitted timeout (04-channel MsgTimeout).
+
+        On a client-bound channel the relayer must prove NON-receipt on
+        the counterparty (an SMT absence proof of the receipt key) at a
+        verified height whose header time is past the packet timeout —
+        ibc-go's proofUnreceived. That closes the recv+timeout
+        double-credit a bare clock check allows. On a legacy channel the
+        sending chain checks only that the timeout has objectively
+        elapsed on its own clock (documented weaker trust: a registered
+        relayer could deliver on the destination and still refund)."""
 
         packet: Packet
         signer: str
+        proof: object | None = None  # smt.Proof of receipt ABSENCE
+        proof_height: int = 0
 
         def get_signers(self) -> list[str]:
             return [self.signer]
 
         def marshal(self) -> bytes:
-            return _field_bytes(
+            out = _field_bytes(
                 1, json.dumps(self.packet.to_json(), sort_keys=True).encode()
             ) + _field_bytes(2, self.signer.encode())
+            if self.proof is not None:
+                out += _field_bytes(3, _marshal_proof(self.proof))
+                out += _field_uint(4, self.proof_height)
+            return out
 
         @classmethod
         def unmarshal(cls, raw: bytes) -> "MsgTimeout":
-            packet, signer = None, ""
+            packet, signer, proof, height = None, "", None, 0
             for tag, wt, val in _parse_fields(raw):
                 if tag == 1:
                     _require_wt(wt, 2, tag)
@@ -239,15 +314,23 @@ def _register_packet_msgs():
                 elif tag == 2:
                     _require_wt(wt, 2, tag)
                     signer = bytes(val).decode()
+                elif tag == 3:
+                    _require_wt(wt, 2, tag)
+                    proof = _unmarshal_proof(bytes(val))
+                elif tag == 4:
+                    _require_wt(wt, 0, tag)
+                    height = val
             if packet is None:
                 raise ValueError("MsgTimeout without packet")
-            return cls(packet, signer)
+            return cls(packet, signer, proof, height)
 
         def validate_basic(self) -> None:
             if not self.signer:
                 raise ValueError("missing relayer signer")
             if not self.packet.timeout_timestamp:
                 raise ValueError("packet has no timeout to elapse")
+            if self.proof is not None and self.proof_height <= 0:
+                raise ValueError("proof without proof height")
 
     return MsgRecvPacket, MsgAcknowledgement, MsgTimeout
 
@@ -261,6 +344,22 @@ def _chan_key(prefix: bytes, port_id: str, channel_id: str) -> bytes:
 
 def _seq_key(prefix: bytes, port_id: str, channel_id: str, seq: int) -> bytes:
     return _chan_key(prefix, port_id, channel_id) + b"/" + seq.to_bytes(8, "big")
+
+
+# Public proof paths (23-commitment key scheme): both chains run this
+# framework, so a verifier can reconstruct the exact store key the
+# counterparty used and check the SMT proof against its app hash.
+
+def packet_commitment_key(port_id: str, channel_id: str, seq: int) -> bytes:
+    return _seq_key(COMMITMENT_PREFIX, port_id, channel_id, seq)
+
+
+def packet_receipt_key(port_id: str, channel_id: str, seq: int) -> bytes:
+    return _seq_key(RECEIPT_PREFIX, port_id, channel_id, seq)
+
+
+def packet_ack_key(port_id: str, channel_id: str, seq: int) -> bytes:
+    return _seq_key(ACK_PREFIX, port_id, channel_id, seq)
 
 
 class ChannelKeeper:
@@ -287,10 +386,16 @@ class ChannelKeeper:
         channel_id: str,
         counterparty_port_id: str,
         counterparty_channel_id: str,
+        client_id: str = "",
     ) -> Channel:
         """Direct OPEN (the post-handshake state ibctesting coordinators
-        drive the four-step handshake to)."""
-        ch = Channel(port_id, channel_id, counterparty_port_id, counterparty_channel_id)
+        drive the four-step handshake to). Pass `client_id` to bind the
+        channel to a light client — packet messages then require proofs
+        instead of relayer registration."""
+        ch = Channel(
+            port_id, channel_id, counterparty_port_id,
+            counterparty_channel_id, client_id=client_id,
+        )
         self.set_channel(ch)
         return ch
 

@@ -1,0 +1,534 @@
+"""02-client / 07-tendermint light-client analogue.
+
+The reference verifies counterparty chains via ibc-go's 02-client core
+wired at app/app.go:370-385 with the 07-tendermint client: a ClientState
+tracks a trusted validator set; MsgUpdateClient carries a signed header
+whose commit must be signed by >2/3 of the trusted voting power; packet
+messages then prove commitment (non-)membership against the verified
+app hash instead of being trusted on the relayer's word.
+
+This module is the tpu-framework equivalent over the SMT state
+commitment (celestia_tpu.smt) and secp256k1 validator keys
+(celestia_tpu.crypto):
+
+- `ClientState`: counterparty chain id, latest verified height, the
+  trusted validator set (pubkey, power) used to check the next update,
+  and a frozen flag set on proven misbehaviour.
+- `ConsensusState` (per verified height): the counterparty app hash and
+  header time — exactly what packet proof verification and timeout
+  elapse checks consume (ibc-go ConsensusState{Timestamp, Root}).
+- `update_client`: sequential verification — signatures over the
+  header's deterministic sign bytes from validators in the *trusted*
+  set carrying > 2/3 of trusted power (stricter than tendermint's 1/3
+  skipping trust level; documented divergence: no connection layer, the
+  channel binds a client directly).
+- `submit_misbehaviour`: two validly-signed conflicting headers at one
+  height freeze the client (02-client CheckMisbehaviourAndUpdateState).
+- `verify_membership` / `verify_non_membership`: SMT proof verification
+  against the stored consensus app hash (ibc-go 23-commitment role).
+  Both chains run this framework, so store key schemes agree; the
+  channel keeper's commitment/receipt/ack keys are the proof paths.
+
+Divergences from ibc-go (documented, deliberate):
+- no 03-connection layer: `Channel.client_id` binds the channel to its
+  client directly (the handshake machinery adds no DA capability here);
+- the header carries the full next validator set instead of a
+  NextValidatorsHash + later reveal — same trust result, one fewer
+  indirection;
+- update rule is >2/3 of *trusted* power (adjacent-style), so there is
+  no skipping trust-level parameter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from celestia_tpu import smt as smt_mod
+from celestia_tpu.crypto import verify_signature
+
+CLIENT_STATE_PREFIX = b"ibc/client/state/"
+CONSENSUS_STATE_PREFIX = b"ibc/client/consensus/"
+
+TRUST_NUMERATOR = 2
+TRUST_DENOMINATOR = 3
+
+
+@dataclasses.dataclass
+class ValidatorInfo:
+    """One trusted validator: compressed secp256k1 pubkey + voting power."""
+
+    pubkey: str  # hex, 33-byte compressed SEC1
+    power: int
+
+    def to_json(self) -> dict:
+        return {"pubkey": self.pubkey, "power": self.power}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ValidatorInfo":
+        return cls(pubkey=d["pubkey"], power=int(d["power"]))
+
+
+@dataclasses.dataclass
+class Header:
+    """Light-client header: what the counterparty's validators sign.
+
+    tendermint's Header + the full next valset (see module docstring)."""
+
+    chain_id: str
+    height: int
+    time: float
+    app_hash: bytes
+    validators: list[ValidatorInfo]  # valset trusted for the NEXT update
+
+    def sign_bytes(self) -> bytes:
+        """Deterministic canonical encoding every signer commits to."""
+        return json.dumps(
+            {
+                "chain_id": self.chain_id,
+                "height": self.height,
+                "time": self.time,
+                "app_hash": self.app_hash.hex(),
+                "validators": [v.to_json() for v in self.validators],
+            },
+            sort_keys=True,
+            separators=(",", ":"),
+        ).encode()
+
+    def to_json(self) -> dict:
+        return {
+            "chain_id": self.chain_id,
+            "height": self.height,
+            "time": self.time,
+            "app_hash": self.app_hash.hex(),
+            "validators": [v.to_json() for v in self.validators],
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Header":
+        return cls(
+            chain_id=d["chain_id"],
+            height=int(d["height"]),
+            time=float(d["time"]),
+            app_hash=bytes.fromhex(d["app_hash"]),
+            validators=[ValidatorInfo.from_json(v) for v in d["validators"]],
+        )
+
+
+@dataclasses.dataclass
+class SignedHeader:
+    """Header + commit: (pubkey, signature) pairs over header.sign_bytes().
+
+    tendermint SignedHeader{Header, Commit}; signatures are the
+    framework's 64-byte low-S (r ‖ s) secp256k1 form."""
+
+    header: Header
+    signatures: list[tuple[str, str]]  # (pubkey hex, signature hex)
+
+    def to_json(self) -> dict:
+        return {
+            "header": self.header.to_json(),
+            "signatures": [[p, s] for p, s in self.signatures],
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "SignedHeader":
+        return cls(
+            header=Header.from_json(d["header"]),
+            signatures=[(p, s) for p, s in d["signatures"]],
+        )
+
+
+@dataclasses.dataclass
+class ClientState:
+    """02-client ClientState analogue (07-tendermint subset)."""
+
+    client_id: str
+    chain_id: str
+    latest_height: int
+    validators: list[ValidatorInfo]  # trusted set for the next update
+    frozen: bool = False
+
+    def marshal(self) -> bytes:
+        return json.dumps(
+            {
+                "client_id": self.client_id,
+                "chain_id": self.chain_id,
+                "latest_height": self.latest_height,
+                "validators": [v.to_json() for v in self.validators],
+                "frozen": self.frozen,
+            },
+            sort_keys=True,
+        ).encode()
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "ClientState":
+        d = json.loads(raw)
+        return cls(
+            client_id=d["client_id"],
+            chain_id=d["chain_id"],
+            latest_height=int(d["latest_height"]),
+            validators=[ValidatorInfo.from_json(v) for v in d["validators"]],
+            frozen=bool(d["frozen"]),
+        )
+
+
+@dataclasses.dataclass
+class ConsensusState:
+    """Per-height verified snapshot: app hash (proof root) + header time
+    (timeout elapse clock). ibc-go ConsensusState{Timestamp, Root}."""
+
+    app_hash: bytes
+    timestamp: float
+
+    def marshal(self) -> bytes:
+        return json.dumps(
+            {"app_hash": self.app_hash.hex(), "timestamp": self.timestamp},
+            sort_keys=True,
+        ).encode()
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "ConsensusState":
+        d = json.loads(raw)
+        return cls(
+            app_hash=bytes.fromhex(d["app_hash"]),
+            timestamp=float(d["timestamp"]),
+        )
+
+
+def _consensus_key(client_id: str, height: int) -> bytes:
+    return (
+        CONSENSUS_STATE_PREFIX
+        + client_id.encode()
+        + b"/"
+        + height.to_bytes(8, "big")
+    )
+
+
+def verify_commit(
+    trusted: list[ValidatorInfo], header: Header,
+    signatures: list[tuple[str, str]],
+) -> None:
+    """Raise unless > 2/3 of the trusted power validly signed the header.
+
+    Each pubkey counts at most once; signatures from keys outside the
+    trusted set contribute nothing (they may appear — a relayer can
+    forward a commit with future-valset signatures mixed in)."""
+    sign_bytes = header.sign_bytes()
+    power_of = {v.pubkey: v.power for v in trusted}
+    total = sum(power_of.values())
+    if total <= 0:
+        raise ValueError("trusted validator set has no power")
+    signed = 0
+    seen: set[str] = set()
+    for pubkey_hex, sig_hex in signatures:
+        if pubkey_hex in seen or pubkey_hex not in power_of:
+            continue
+        if not verify_signature(
+            bytes.fromhex(pubkey_hex), sign_bytes, bytes.fromhex(sig_hex)
+        ):
+            raise ValueError(f"invalid commit signature from {pubkey_hex[:16]}…")
+        seen.add(pubkey_hex)
+        signed += power_of[pubkey_hex]
+    if signed * TRUST_DENOMINATOR <= total * TRUST_NUMERATOR:
+        raise ValueError(
+            f"insufficient voting power signed the header: {signed}/{total} "
+            f"(need > {TRUST_NUMERATOR}/{TRUST_DENOMINATOR})"
+        )
+
+
+URL_MSG_CREATE_CLIENT = "/ibc.core.client.v1.MsgCreateClient"
+URL_MSG_UPDATE_CLIENT = "/ibc.core.client.v1.MsgUpdateClient"
+URL_MSG_SUBMIT_MISBEHAVIOUR = "/ibc.core.client.v1.MsgSubmitMisbehaviour"
+
+
+def _register_client_msgs():
+    from celestia_tpu.blob import _field_bytes, _parse_fields, _require_wt
+    from celestia_tpu.tx import register_msg
+
+    def _json_field(tag: int, obj: dict) -> bytes:
+        return _field_bytes(
+            tag, json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+        )
+
+    @register_msg(URL_MSG_CREATE_CLIENT)
+    @dataclasses.dataclass
+    class MsgCreateClient:
+        """Create a light client from an initial trusted header
+        (ibc-go MsgCreateClient: ClientState + initial ConsensusState)."""
+
+        client_id: str
+        chain_id: str
+        initial_header: Header
+        signer: str
+
+        def get_signers(self) -> list[str]:
+            return [self.signer]
+
+        def marshal(self) -> bytes:
+            return (
+                _field_bytes(1, self.client_id.encode())
+                + _field_bytes(2, self.chain_id.encode())
+                + _json_field(3, self.initial_header.to_json())
+                + _field_bytes(4, self.signer.encode())
+            )
+
+        @classmethod
+        def unmarshal(cls, raw: bytes) -> "MsgCreateClient":
+            client_id = chain_id = signer = ""
+            header = None
+            for tag, wt, val in _parse_fields(raw):
+                _require_wt(wt, 2, tag)
+                if tag == 1:
+                    client_id = bytes(val).decode()
+                elif tag == 2:
+                    chain_id = bytes(val).decode()
+                elif tag == 3:
+                    header = Header.from_json(json.loads(bytes(val)))
+                elif tag == 4:
+                    signer = bytes(val).decode()
+            if header is None:
+                raise ValueError("MsgCreateClient without initial header")
+            return cls(client_id, chain_id, header, signer)
+
+        def validate_basic(self) -> None:
+            if not self.client_id or not self.chain_id:
+                raise ValueError("missing client/chain id")
+            if not self.signer:
+                raise ValueError("missing signer")
+            if not self.initial_header.validators:
+                raise ValueError("initial header carries no validator set")
+
+    @register_msg(URL_MSG_UPDATE_CLIENT)
+    @dataclasses.dataclass
+    class MsgUpdateClient:
+        """Advance a client with a new signed header (ibc-go
+        MsgUpdateClient)."""
+
+        client_id: str
+        signed_header: SignedHeader
+        signer: str
+
+        def get_signers(self) -> list[str]:
+            return [self.signer]
+
+        def marshal(self) -> bytes:
+            return (
+                _field_bytes(1, self.client_id.encode())
+                + _json_field(2, self.signed_header.to_json())
+                + _field_bytes(3, self.signer.encode())
+            )
+
+        @classmethod
+        def unmarshal(cls, raw: bytes) -> "MsgUpdateClient":
+            client_id = signer = ""
+            signed = None
+            for tag, wt, val in _parse_fields(raw):
+                _require_wt(wt, 2, tag)
+                if tag == 1:
+                    client_id = bytes(val).decode()
+                elif tag == 2:
+                    signed = SignedHeader.from_json(json.loads(bytes(val)))
+                elif tag == 3:
+                    signer = bytes(val).decode()
+            if signed is None:
+                raise ValueError("MsgUpdateClient without header")
+            return cls(client_id, signed, signer)
+
+        def validate_basic(self) -> None:
+            if not self.client_id:
+                raise ValueError("missing client id")
+            if not self.signer:
+                raise ValueError("missing signer")
+            if not self.signed_header.signatures:
+                raise ValueError("signed header carries no signatures")
+
+    @register_msg(URL_MSG_SUBMIT_MISBEHAVIOUR)
+    @dataclasses.dataclass
+    class MsgSubmitMisbehaviour:
+        """Freeze a client on proof of equivocation (ibc-go
+        MsgSubmitMisbehaviour: two conflicting signed headers)."""
+
+        client_id: str
+        header_a: SignedHeader
+        header_b: SignedHeader
+        signer: str
+
+        def get_signers(self) -> list[str]:
+            return [self.signer]
+
+        def marshal(self) -> bytes:
+            return (
+                _field_bytes(1, self.client_id.encode())
+                + _json_field(2, self.header_a.to_json())
+                + _json_field(3, self.header_b.to_json())
+                + _field_bytes(4, self.signer.encode())
+            )
+
+        @classmethod
+        def unmarshal(cls, raw: bytes) -> "MsgSubmitMisbehaviour":
+            client_id = signer = ""
+            a = b = None
+            for tag, wt, val in _parse_fields(raw):
+                _require_wt(wt, 2, tag)
+                if tag == 1:
+                    client_id = bytes(val).decode()
+                elif tag == 2:
+                    a = SignedHeader.from_json(json.loads(bytes(val)))
+                elif tag == 3:
+                    b = SignedHeader.from_json(json.loads(bytes(val)))
+                elif tag == 4:
+                    signer = bytes(val).decode()
+            if a is None or b is None:
+                raise ValueError("MsgSubmitMisbehaviour missing headers")
+            return cls(client_id, a, b, signer)
+
+        def validate_basic(self) -> None:
+            if not self.client_id:
+                raise ValueError("missing client id")
+            if not self.signer:
+                raise ValueError("missing signer")
+
+    return MsgCreateClient, MsgUpdateClient, MsgSubmitMisbehaviour
+
+
+MsgCreateClient, MsgUpdateClient, MsgSubmitMisbehaviour = _register_client_msgs()
+
+
+class ClientKeeper:
+    """02-client keeper over the framework store."""
+
+    def __init__(self, store):
+        self.store = store
+
+    # --- client lifecycle ---
+
+    def create_client(
+        self, client_id: str, chain_id: str, initial: Header
+    ) -> ClientState:
+        """Create a client from an initial trusted header (the social
+        genesis trust assumption every light client starts from —
+        ibc-go MsgCreateClient with an initial consensus state)."""
+        if self.get_client(client_id) is not None:
+            raise ValueError(f"client {client_id} already exists")
+        if not initial.validators:
+            raise ValueError("initial header carries no validator set")
+        cs = ClientState(
+            client_id=client_id,
+            chain_id=chain_id,
+            latest_height=initial.height,
+            validators=list(initial.validators),
+        )
+        self._set_client(cs)
+        self.store.set(
+            _consensus_key(client_id, initial.height),
+            ConsensusState(initial.app_hash, initial.time).marshal(),
+        )
+        return cs
+
+    def get_client(self, client_id: str) -> ClientState | None:
+        raw = self.store.get(CLIENT_STATE_PREFIX + client_id.encode())
+        return ClientState.unmarshal(raw) if raw else None
+
+    def _set_client(self, cs: ClientState) -> None:
+        self.store.set(CLIENT_STATE_PREFIX + cs.client_id.encode(), cs.marshal())
+
+    def get_consensus_state(
+        self, client_id: str, height: int
+    ) -> ConsensusState | None:
+        raw = self.store.get(_consensus_key(client_id, height))
+        return ConsensusState.unmarshal(raw) if raw else None
+
+    def _require_active(self, client_id: str) -> ClientState:
+        cs = self.get_client(client_id)
+        if cs is None:
+            raise ValueError(f"unknown client {client_id}")
+        if cs.frozen:
+            raise ValueError(f"client {client_id} is frozen for misbehaviour")
+        return cs
+
+    # --- update path ---
+
+    def update_client(self, client_id: str, signed: SignedHeader) -> ClientState:
+        """Sequential header verification (07-tendermint CheckHeaderAnd
+        UpdateState): chain id match, height advance, > 2/3 trusted power
+        signed; then adopt the header's valset and consensus state."""
+        cs = self._require_active(client_id)
+        header = signed.header
+        if header.chain_id != cs.chain_id:
+            raise ValueError(
+                f"header chain id {header.chain_id!r} does not match "
+                f"client chain id {cs.chain_id!r}"
+            )
+        if header.height <= cs.latest_height:
+            raise ValueError(
+                f"header height {header.height} is not newer than the "
+                f"client's latest {cs.latest_height}"
+            )
+        if not header.validators:
+            raise ValueError("header carries no validator set")
+        verify_commit(cs.validators, header, signed.signatures)
+        cs.latest_height = header.height
+        cs.validators = list(header.validators)
+        self._set_client(cs)
+        self.store.set(
+            _consensus_key(client_id, header.height),
+            ConsensusState(header.app_hash, header.time).marshal(),
+        )
+        return cs
+
+    def submit_misbehaviour(
+        self, client_id: str, a: SignedHeader, b: SignedHeader
+    ) -> ClientState:
+        """Freeze on two validly-signed conflicting headers at one height
+        (equivocation — 02-client misbehaviour)."""
+        cs = self._require_active(client_id)
+        if a.header.height != b.header.height:
+            raise ValueError("misbehaviour headers are at different heights")
+        if a.header.chain_id != cs.chain_id or b.header.chain_id != cs.chain_id:
+            raise ValueError("misbehaviour header chain id mismatch")
+        if a.header.sign_bytes() == b.header.sign_bytes():
+            raise ValueError("headers are identical — no conflict")
+        verify_commit(cs.validators, a.header, a.signatures)
+        verify_commit(cs.validators, b.header, b.signatures)
+        cs.frozen = True
+        self._set_client(cs)
+        return cs
+
+    # --- proof verification (23-commitment role) ---
+
+    def verify_membership(
+        self,
+        client_id: str,
+        height: int,
+        key: bytes,
+        value: bytes,
+        proof: smt_mod.Proof,
+    ) -> None:
+        """Raise unless `key → value` is committed in the counterparty
+        state at the verified `height`."""
+        cons = self._proof_consensus(client_id, height)
+        if not smt_mod.verify_proof(cons.app_hash, key, value, proof):
+            raise ValueError(
+                f"membership proof failed for {key!r} at height {height}"
+            )
+
+    def verify_non_membership(
+        self, client_id: str, height: int, key: bytes, proof: smt_mod.Proof
+    ) -> None:
+        """Raise unless `key` is provably ABSENT from the counterparty
+        state at the verified `height` (SMT absence proof)."""
+        cons = self._proof_consensus(client_id, height)
+        if not smt_mod.verify_proof(cons.app_hash, key, None, proof):
+            raise ValueError(
+                f"non-membership proof failed for {key!r} at height {height}"
+            )
+
+    def _proof_consensus(self, client_id: str, height: int) -> ConsensusState:
+        self._require_active(client_id)
+        cons = self.get_consensus_state(client_id, height)
+        if cons is None:
+            raise ValueError(
+                f"client {client_id} has no consensus state at height {height}"
+            )
+        return cons

@@ -51,6 +51,11 @@ class Validator:
     tokens: int  # bonded utia
     moniker: str = ""
     jailed: bool = False
+    # consensus pubkey (hex compressed secp256k1) — what signs block
+    # headers; consumed by light clients tracking this chain (the SDK
+    # Validator.ConsensusPubkey analogue). Empty for validators that
+    # never sign (pure staking tests).
+    pubkey: str = ""
 
     @property
     def power(self) -> int:

@@ -1,0 +1,517 @@
+"""02-client / 07-tendermint light-client verification (VERDICT r2 item 4;
+ref: ibc-go core wired at app/app.go:370-385, client update gov handler
+app/ibc_proposal_handler.go:16-28).
+
+The decisive property: packet messages on a client-bound channel are
+accepted or rejected by PROOF VERIFICATION alone — no relayer
+registration exists anywhere in these tests."""
+
+import pytest
+
+from celestia_tpu.app import App
+from celestia_tpu.crypto import PrivateKey
+from celestia_tpu.node import Node
+from celestia_tpu.smt import Proof
+from celestia_tpu.state import StateStore
+from celestia_tpu.testutil.ibc import (
+    LightClientRelayer,
+    add_consensus_validator,
+    make_header,
+    open_client_channel,
+    sign_header,
+    validator_set,
+)
+from celestia_tpu.user import Signer
+from celestia_tpu.x.ibc import (
+    MsgRecvPacket,
+    MsgTimeout,
+    Packet,
+    packet_commitment_key,
+    packet_receipt_key,
+)
+from celestia_tpu.x.lightclient import (
+    ClientKeeper,
+    Header,
+    MsgSubmitMisbehaviour,
+    MsgUpdateClient,
+    SignedHeader,
+    ValidatorInfo,
+    verify_commit,
+)
+from celestia_tpu.x.transfer import (
+    PORT_ID_TRANSFER,
+    FungibleTokenPacketData,
+    MsgTransfer,
+    escrow_address,
+)
+
+ALICE = PrivateKey.from_secret(b"alice")
+BOB = PrivateKey.from_secret(b"bob")
+RELAYER_A = PrivateKey.from_secret(b"relayer-a")
+RELAYER_B = PrivateKey.from_secret(b"relayer-b")
+VAL_A1 = PrivateKey.from_secret(b"val-a1")
+VAL_A2 = PrivateKey.from_secret(b"val-a2")
+VAL_B1 = PrivateKey.from_secret(b"val-b1")
+VAL_B2 = PrivateKey.from_secret(b"val-b2")
+VAL_B3 = PrivateKey.from_secret(b"val-b3")
+ATTACKER = PrivateKey.from_secret(b"attacker")
+
+BOND = 10_000_000  # 10 power units
+
+
+def new_chain(chain_id: str, val_keys) -> Node:
+    app = App(chain_id=chain_id)
+    app.init_chain(
+        {
+            ALICE.bech32_address(): 1_000_000_000,
+            BOB.bech32_address(): 1_000_000_000,
+            RELAYER_A.bech32_address(): 1_000_000_000,
+            RELAYER_B.bech32_address(): 1_000_000_000,
+            ATTACKER.bech32_address(): 1_000_000_000,
+        },
+        genesis_time=0.0,
+    )
+    for k in val_keys:
+        add_consensus_validator(app, k, BOND)
+    node = Node(app)
+    node.produce_block(15.0)
+    return node
+
+
+def _mk_header(height=5, chain_id="chain-x", app_hash=b"\xaa" * 32,
+               time=100.0, validators=None):
+    return Header(
+        chain_id=chain_id,
+        height=height,
+        time=time,
+        app_hash=app_hash,
+        validators=validators or [],
+    )
+
+
+class TestVerifyCommit:
+    """The > 2/3 trusted-power commit rule in isolation."""
+
+    def _valset(self, keys_powers):
+        return [
+            ValidatorInfo(k.public_key().hex(), p) for k, p in keys_powers
+        ]
+
+    def _sigs(self, header, keys):
+        sb = header.sign_bytes()
+        return [(k.public_key().hex(), k.sign(sb).hex()) for k in keys]
+
+    def test_two_thirds_passes(self):
+        trusted = self._valset([(VAL_B1, 10), (VAL_B2, 10), (VAL_B3, 10)])
+        h = _mk_header(validators=trusted)
+        verify_commit(trusted, h, self._sigs(h, [VAL_B1, VAL_B2, VAL_B3]))
+
+    def test_exactly_two_thirds_fails(self):
+        """Tendermint requires STRICTLY more than 2/3."""
+        trusted = self._valset([(VAL_B1, 10), (VAL_B2, 10), (VAL_B3, 10)])
+        h = _mk_header(validators=trusted)
+        with pytest.raises(ValueError, match="insufficient voting power"):
+            verify_commit(trusted, h, self._sigs(h, [VAL_B1, VAL_B2]))
+
+    def test_weighted_majority_passes(self):
+        trusted = self._valset([(VAL_B1, 90), (VAL_B2, 5), (VAL_B3, 5)])
+        h = _mk_header(validators=trusted)
+        verify_commit(trusted, h, self._sigs(h, [VAL_B1]))
+
+    def test_duplicate_signatures_count_once(self):
+        trusted = self._valset([(VAL_B1, 10), (VAL_B2, 20)])
+        h = _mk_header(validators=trusted)
+        sigs = self._sigs(h, [VAL_B1]) * 3
+        with pytest.raises(ValueError, match="insufficient voting power"):
+            verify_commit(trusted, h, sigs)
+
+    def test_untrusted_keys_contribute_nothing(self):
+        trusted = self._valset([(VAL_B1, 10), (VAL_B2, 10), (VAL_B3, 10)])
+        h = _mk_header(validators=trusted)
+        sigs = self._sigs(h, [VAL_B1, VAL_A1, VAL_A2, ATTACKER])
+        with pytest.raises(ValueError, match="insufficient voting power"):
+            verify_commit(trusted, h, sigs)
+
+    def test_invalid_signature_rejected(self):
+        trusted = self._valset([(VAL_B1, 10)])
+        h = _mk_header(validators=trusted)
+        other = _mk_header(height=6, validators=trusted)
+        # signature over the WRONG header's bytes
+        sigs = self._sigs(other, [VAL_B1])
+        with pytest.raises(ValueError, match="invalid commit signature"):
+            verify_commit(trusted, h, sigs)
+
+
+class TestClientKeeper:
+    def _keeper_with_client(self):
+        store = StateStore()
+        keeper = ClientKeeper(store)
+        valset = [
+            ValidatorInfo(VAL_B1.public_key().hex(), 10),
+            ValidatorInfo(VAL_B2.public_key().hex(), 10),
+            ValidatorInfo(VAL_B3.public_key().hex(), 10),
+        ]
+        initial = _mk_header(height=1, validators=valset, time=10.0)
+        keeper.create_client("07-tendermint-0", "chain-x", initial)
+        return store, keeper, valset
+
+    def _signed(self, header, keys):
+        sb = header.sign_bytes()
+        return SignedHeader(
+            header,
+            [(k.public_key().hex(), k.sign(sb).hex()) for k in keys],
+        )
+
+    def test_create_and_update(self):
+        _store, keeper, valset = self._keeper_with_client()
+        h2 = _mk_header(height=2, validators=valset, app_hash=b"\xbb" * 32,
+                        time=20.0)
+        cs = keeper.update_client(
+            "07-tendermint-0", self._signed(h2, [VAL_B1, VAL_B2, VAL_B3])
+        )
+        assert cs.latest_height == 2
+        cons = keeper.get_consensus_state("07-tendermint-0", 2)
+        assert cons.app_hash == b"\xbb" * 32
+        assert cons.timestamp == 20.0
+        # the initial consensus state is retained for old-height proofs
+        assert keeper.get_consensus_state("07-tendermint-0", 1) is not None
+
+    def test_stale_height_rejected(self):
+        _s, keeper, valset = self._keeper_with_client()
+        h1 = _mk_header(height=1, validators=valset)
+        with pytest.raises(ValueError, match="not newer"):
+            keeper.update_client(
+                "07-tendermint-0", self._signed(h1, [VAL_B1, VAL_B2, VAL_B3])
+            )
+
+    def test_wrong_chain_id_rejected(self):
+        _s, keeper, valset = self._keeper_with_client()
+        h = _mk_header(height=2, chain_id="chain-evil", validators=valset)
+        with pytest.raises(ValueError, match="does not match"):
+            keeper.update_client(
+                "07-tendermint-0", self._signed(h, [VAL_B1, VAL_B2, VAL_B3])
+            )
+
+    def test_valset_rotation(self):
+        """An update signed by the old set installs the new set; the next
+        update must be signed by the NEW set."""
+        _s, keeper, _valset = self._keeper_with_client()
+        new_set = [ValidatorInfo(VAL_A1.public_key().hex(), 10)]
+        h2 = _mk_header(height=2, validators=new_set)
+        keeper.update_client(
+            "07-tendermint-0", self._signed(h2, [VAL_B1, VAL_B2, VAL_B3])
+        )
+        h3 = _mk_header(height=3, validators=new_set)
+        # old set can no longer advance the client
+        with pytest.raises(ValueError, match="insufficient voting power"):
+            keeper.update_client(
+                "07-tendermint-0", self._signed(h3, [VAL_B1, VAL_B2, VAL_B3])
+            )
+        keeper.update_client("07-tendermint-0", self._signed(h3, [VAL_A1]))
+        assert keeper.get_client("07-tendermint-0").latest_height == 3
+
+    def test_misbehaviour_freezes(self):
+        _s, keeper, valset = self._keeper_with_client()
+        ha = _mk_header(height=7, validators=valset, app_hash=b"\x01" * 32)
+        hb = _mk_header(height=7, validators=valset, app_hash=b"\x02" * 32)
+        keeper.submit_misbehaviour(
+            "07-tendermint-0",
+            self._signed(ha, [VAL_B1, VAL_B2, VAL_B3]),
+            self._signed(hb, [VAL_B1, VAL_B2, VAL_B3]),
+        )
+        assert keeper.get_client("07-tendermint-0").frozen
+        h2 = _mk_header(height=8, validators=valset)
+        with pytest.raises(ValueError, match="frozen"):
+            keeper.update_client(
+                "07-tendermint-0", self._signed(h2, [VAL_B1, VAL_B2, VAL_B3])
+            )
+        with pytest.raises(ValueError, match="frozen"):
+            keeper.verify_membership(
+                "07-tendermint-0", 1, b"k", b"v", Proof(b"\x00" * 32, [])
+            )
+
+    def test_misbehaviour_requires_valid_commits(self):
+        _s, keeper, valset = self._keeper_with_client()
+        ha = _mk_header(height=7, validators=valset, app_hash=b"\x01" * 32)
+        hb = _mk_header(height=7, validators=valset, app_hash=b"\x02" * 32)
+        with pytest.raises(ValueError, match="insufficient voting power"):
+            keeper.submit_misbehaviour(
+                "07-tendermint-0",
+                self._signed(ha, [VAL_B1]),
+                self._signed(hb, [VAL_B1]),
+            )
+        assert not keeper.get_client("07-tendermint-0").frozen
+
+    def test_proof_verification_against_real_store(self):
+        """Membership/non-membership against an actual SMT app hash."""
+        counterparty = StateStore()
+        counterparty.set(b"ibc/commitment/x", b"\x42" * 32)
+        counterparty.commit()
+        app_hash = counterparty.app_hashes[counterparty.version]
+
+        store = StateStore()
+        keeper = ClientKeeper(store)
+        valset = [ValidatorInfo(VAL_B1.public_key().hex(), 1)]
+        keeper.create_client(
+            "c0", "chain-x",
+            _mk_header(height=1, validators=valset, app_hash=app_hash),
+        )
+        value, _root, proof = counterparty.query_with_proof(b"ibc/commitment/x")
+        keeper.verify_membership(
+            "c0", 1, b"ibc/commitment/x", value, proof
+        )
+        with pytest.raises(ValueError, match="membership proof failed"):
+            keeper.verify_membership(
+                "c0", 1, b"ibc/commitment/x", b"\x43" * 32, proof
+            )
+        _v, _r, absent = counterparty.query_with_proof(b"ibc/other")
+        keeper.verify_non_membership("c0", 1, b"ibc/other", absent)
+        with pytest.raises(ValueError, match="non-membership proof failed"):
+            keeper.verify_non_membership(
+                "c0", 1, b"ibc/commitment/x", proof
+            )
+
+
+class TestLightClientE2E:
+    """Two chains, client-bound channels, permissionless relaying —
+    NO register_relayer call appears anywhere in this class."""
+
+    def _setup(self):
+        node_a = new_chain("chain-a", [VAL_A1, VAL_A2])
+        node_b = new_chain("chain-b", [VAL_B1, VAL_B2, VAL_B3])
+        open_client_channel(node_a, node_b)
+        relayer = LightClientRelayer(
+            node_a, node_b, RELAYER_A, RELAYER_B,
+            [VAL_A1, VAL_A2], [VAL_B1, VAL_B2, VAL_B3],
+        )
+        return node_a, node_b, relayer
+
+    def test_voucher_coming_home_with_proofs(self):
+        """The accepted inbound flow under the tokenfilter, now gated by
+        commitment proofs instead of relayer registration."""
+        node_a, node_b, relayer = self._setup()
+        alice, bob = ALICE.bech32_address(), BOB.bech32_address()
+        esc = escrow_address("transfer", "channel-0")
+
+        node_a.app.bank.mint(esc, 7_000, "utia")
+        node_b.app.bank.mint(bob, 7_000, "transfer/channel-0/utia")
+        node_a.app.store.commit_hash_refresh()
+        node_b.app.store.commit_hash_refresh()
+
+        b_signer = Signer.setup_single(BOB, node_b)
+        res = b_signer.submit_tx(
+            [MsgTransfer("transfer", "channel-0", "transfer/channel-0/utia",
+                         7_000, bob, alice)]
+        )
+        assert res.code == 0, res.log
+        node_b.produce_block(30.0)
+
+        before = node_a.app.bank.get_balance(alice)
+        relayer.relay(45.0, 45.0)
+        assert node_a.app.bank.get_balance(esc) == 0
+        assert node_a.app.bank.get_balance(alice) == before + 7_000
+        ack = node_a.app.ibc.get_acknowledgement("transfer", "channel-0", 1)
+        assert ack is not None and ack.success
+
+    def test_forged_packet_rejected_by_proof_verification(self):
+        """An attacker (any funded account) forges a packet claiming B
+        sent a voucher home. Without a valid commitment proof the
+        DeliverTx handler rejects it — the escrow stays put."""
+        node_a, node_b, _relayer = self._setup()
+        alice = ALICE.bech32_address()
+        esc = escrow_address("transfer", "channel-0")
+        node_a.app.bank.mint(esc, 9_000, "utia")
+        node_a.app.store.commit_hash_refresh()
+
+        forged = Packet(
+            sequence=1,
+            source_port="transfer",
+            source_channel="channel-0",
+            destination_port="transfer",
+            destination_channel="channel-0",
+            data=FungibleTokenPacketData(
+                "transfer/channel-0/utia", 9_000,
+                BOB.bech32_address(), alice,
+            ).marshal(),
+        )
+        attacker = Signer.setup_single(ATTACKER, node_a)
+
+        # (1) no proof at all → refused outright
+        res = attacker.submit_tx([MsgRecvPacket(forged, attacker.address())])
+        block = node_a.produce_block(45.0)
+        failed = [r for r in block.tx_results if r.code != 0]
+        assert failed and "must carry a proof" in failed[0].log
+
+        # (2) a proof for a key that does NOT hold this commitment
+        _v, _root, bogus = node_a.app.store.query_with_proof(b"no/such/key")
+        res = attacker.submit_tx(
+            [MsgRecvPacket(forged, attacker.address(), bogus, 1)]
+        )
+        block = node_a.produce_block(60.0)
+        failed = [r for r in block.tx_results if r.code != 0]
+        assert failed and "proof failed" in failed[0].log
+
+        # escrow untouched, nothing credited
+        assert node_a.app.bank.get_balance(esc) == 9_000
+
+    def test_forged_client_update_rejected(self):
+        """An attacker cannot advance the client with a header signed by
+        their own key — the trusted valset's power gate holds."""
+        node_a, node_b, _relayer = self._setup()
+        attacker = Signer.setup_single(ATTACKER, node_a)
+        fake = make_header(node_b)
+        fake.height += 1
+        fake.app_hash = b"\xee" * 32
+        fake.validators = [ValidatorInfo(ATTACKER.public_key().hex(), 100)]
+        signed = sign_header(fake, [ATTACKER])
+        attacker.submit_tx([
+            MsgUpdateClient("07-tendermint-0", signed, attacker.address())
+        ])
+        block = node_a.produce_block(45.0)
+        failed = [r for r in block.tx_results if r.code != 0]
+        assert failed and "insufficient voting power" in failed[0].log
+        # client unmoved
+        client = ClientKeeper(node_a.app.store).get_client("07-tendermint-0")
+        assert client.latest_height < fake.height
+
+    def test_honest_timeout_with_absence_proof(self):
+        """Un-relayed packet past its timeout: refund flows once the
+        relayer proves non-receipt under a verified header."""
+        node_a, node_b, relayer = self._setup()
+        alice = ALICE.bech32_address()
+        esc = escrow_address("transfer", "channel-0")
+
+        a_signer = Signer.setup_single(ALICE, node_a)
+        res = a_signer.submit_tx([
+            MsgTransfer("transfer", "channel-0", "utia", 4_000,
+                        alice, BOB.bech32_address(),
+                        timeout_timestamp=40.0)
+        ])
+        assert res.code == 0, res.log
+        node_a.produce_block(30.0)
+        assert node_a.app.bank.get_balance(esc) == 4_000
+        packet = node_a.app.ibc.pending_packets(PORT_ID_TRANSFER, "channel-0")[0]
+
+        # destination advances past the timeout without receiving
+        node_b.produce_block(50.0)
+        before = node_a.app.bank.get_balance(alice)
+        relayer.timeout(packet, node_a, node_b, relayer.signer_a, 55.0, 50.0)
+        assert node_a.app.bank.get_balance(esc) == 0
+        assert node_a.app.bank.get_balance(alice) == before + 4_000
+
+    def test_delivered_packet_cannot_be_timed_out(self):
+        """The double-credit ADVICE r2 flagged: deliver on B, then try to
+        refund on A. The receipt on B makes the absence proof impossible,
+        so the refund is rejected by proof verification."""
+        node_a, node_b, relayer = self._setup()
+        alice, bob = ALICE.bech32_address(), BOB.bech32_address()
+        esc = escrow_address("transfer", "channel-0")
+
+        a_signer = Signer.setup_single(ALICE, node_a)
+        a_signer.submit_tx([
+            MsgTransfer("transfer", "channel-0", "utia", 4_000, alice, bob,
+                        timeout_timestamp=100.0)
+        ])
+        node_a.produce_block(30.0)
+        packet = node_a.app.ibc.pending_packets(PORT_ID_TRANSFER, "channel-0")[0]
+
+        # deliver the recv leg on B (honestly, with a proof) BEFORE timeout
+        height = relayer.update_client(
+            node_a, node_b, relayer.signer_b, 35.0
+        )
+        _v, _r, proof = node_a.app.store.query_with_proof(
+            packet_commitment_key("transfer", "channel-0", packet.sequence)
+        )
+        res = relayer.signer_b.submit_tx([
+            MsgRecvPacket(packet, relayer.signer_b.address(), proof, height)
+        ])
+        assert res.code == 0, res.log
+        recv_block = node_b.produce_block(50.0)  # delivered BEFORE timeout
+        assert all(r.code == 0 for r in recv_block.tx_results)
+        node_b.produce_block(120.0)  # B's clock passes the timeout
+
+        # now try the timeout refund on A with a proof of the receipt key
+        height = relayer.update_client(
+            node_b, node_a, relayer.signer_a, 125.0
+        )
+        _v, _r, receipt_proof = node_b.app.store.query_with_proof(
+            packet_receipt_key("transfer", "channel-0", packet.sequence)
+        )
+        relayer.signer_a.submit_tx([
+            MsgTimeout(packet, relayer.signer_a.address(),
+                       receipt_proof, height)
+        ])
+        block = node_a.produce_block(130.0)
+        failed = [r for r in block.tx_results if r.code != 0]
+        assert failed and "non-membership proof failed" in failed[0].log
+        assert node_a.app.bank.get_balance(esc) == 4_000  # NOT refunded
+
+    def test_misbehaviour_tx_freezes_client(self):
+        """Equivocating validators freeze their client on the other
+        chain; relaying halts."""
+        node_a, node_b, relayer = self._setup()
+        h = make_header(node_b)
+        ha = Header(h.chain_id, h.height + 1, h.time, b"\x01" * 32,
+                    h.validators)
+        hb = Header(h.chain_id, h.height + 1, h.time, b"\x02" * 32,
+                    h.validators)
+        keys = [VAL_B1, VAL_B2, VAL_B3]
+        reporter = Signer.setup_single(ATTACKER, node_a)
+        res = reporter.submit_tx([
+            MsgSubmitMisbehaviour(
+                "07-tendermint-0",
+                sign_header(ha, keys), sign_header(hb, keys),
+                reporter.address(),
+            )
+        ])
+        assert res.code == 0, res.log
+        node_a.produce_block(45.0)
+        assert ClientKeeper(node_a.app.store).get_client(
+            "07-tendermint-0"
+        ).frozen
+
+    def test_validator_set_rotation_e2e(self):
+        """Chain B rotates its validator set via real staking txs; the
+        client on A follows across the handoff."""
+        node_a, node_b, relayer = self._setup()
+        alice, bob = ALICE.bech32_address(), BOB.bech32_address()
+        esc = escrow_address("transfer", "channel-0")
+
+        # sync A's client once under the ORIGINAL B valset
+        relayer.update_client(node_b, node_a, relayer.signer_a, 20.0)
+
+        # B's valset rotates: a new heavyweight joins, old ones leave
+        new_val = PrivateKey.from_secret(b"val-b-new")
+        add_consensus_validator(node_b.app, new_val, 10 * BOND)
+        for k in (VAL_B1, VAL_B2, VAL_B3):
+            v = node_b.app.staking.get_validator(k.bech32_address())
+            v.jailed = True  # power → 0, leaves the valset
+            node_b.app.staking.set_validator(v)
+        node_b.app.store.commit_hash_refresh()
+        node_b.produce_block(25.0)
+
+        # the OLD set signs the handoff header (they were trusted), which
+        # installs the new set...
+        relayer.val_keys[id(node_b)] = [VAL_B1, VAL_B2, VAL_B3]
+        relayer.update_client(node_b, node_a, relayer.signer_a, 30.0)
+        client = ClientKeeper(node_a.app.store).get_client("07-tendermint-0")
+        assert [v.pubkey for v in client.validators] == [
+            new_val.public_key().hex()
+        ]
+
+        # ...after which only the new validator's signature advances it,
+        # and a real transfer still round-trips
+        relayer.val_keys[id(node_b)] = [new_val]
+        node_a.app.bank.mint(esc, 1_000, "utia")
+        node_b.app.bank.mint(bob, 1_000, "transfer/channel-0/utia")
+        node_a.app.store.commit_hash_refresh()
+        node_b.app.store.commit_hash_refresh()
+        b_signer = Signer.setup_single(BOB, node_b)
+        b_signer.submit_tx(
+            [MsgTransfer("transfer", "channel-0", "transfer/channel-0/utia",
+                         1_000, bob, alice)]
+        )
+        node_b.produce_block(40.0)
+        before = node_a.app.bank.get_balance(alice)
+        relayer.relay(50.0, 50.0)
+        assert node_a.app.bank.get_balance(alice) == before + 1_000
