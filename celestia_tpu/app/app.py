@@ -652,9 +652,7 @@ class App:
         elif isinstance(msg, MsgTimeout):
             self._handle_timeout(ctx, msg)
         elif isinstance(msg, MsgCreateClient):
-            ClientKeeper(ctx.store).create_client(
-                msg.client_id, msg.chain_id, msg.initial_header
-            )
+            ClientKeeper(ctx.store).create_client(msg.initial_header)
         elif isinstance(msg, MsgUpdateClient):
             ClientKeeper(ctx.store).update_client(
                 msg.client_id, msg.signed_header

@@ -153,23 +153,23 @@ def open_client_channel(
     """Create light clients on both chains from each other's current
     headers (the MsgCreateClient genesis trust), then open a channel
     pair bound to them — packet messages on these channels require
-    proofs, not relayer registration."""
+    proofs, not relayer registration. Client ids are assigned
+    server-side; `client_a`/`client_b` assert the expected assignment
+    (the first client on a fresh chain is 07-tendermint-0)."""
     from celestia_tpu.x.lightclient import ClientKeeper
 
     app_a, app_b = node_a.app, node_b.app
-    ClientKeeper(app_a.store).create_client(
-        client_a, app_b.chain_id, make_header(node_b)
-    )
-    ClientKeeper(app_b.store).create_client(
-        client_b, app_a.chain_id, make_header(node_a)
-    )
+    cs_a = ClientKeeper(app_a.store).create_client(make_header(node_b))
+    cs_b = ClientKeeper(app_b.store).create_client(make_header(node_a))
+    assert cs_a.client_id == client_a, cs_a.client_id
+    assert cs_b.client_id == client_b, cs_b.client_id
     app_a.ibc.open_channel(
         PORT_ID_TRANSFER, channel_a, PORT_ID_TRANSFER, channel_b,
-        client_id=client_a,
+        client_id=cs_a.client_id,
     )
     app_b.ibc.open_channel(
         PORT_ID_TRANSFER, channel_b, PORT_ID_TRANSFER, channel_a,
-        client_id=client_b,
+        client_id=cs_b.client_id,
     )
     app_a.store.commit_hash_refresh()
     app_b.store.commit_hash_refresh()
@@ -281,7 +281,7 @@ class LightClientRelayer:
         return len(packets)
 
     def timeout(self, packet, src_node, dst_node, src_signer,
-                src_time: float, dst_time: float) -> None:
+                src_time: float) -> None:
         """Refund a timed-out packet the honest way: verified header past
         the timeout + receipt absence proof on the destination."""
         from celestia_tpu.x.ibc import MsgTimeout, packet_receipt_key

@@ -49,6 +49,8 @@ from celestia_tpu.crypto import verify_signature
 
 CLIENT_STATE_PREFIX = b"ibc/client/state/"
 CONSENSUS_STATE_PREFIX = b"ibc/client/consensus/"
+CLIENT_COUNTER_KEY = b"ibc/client/nextSequence"
+CLIENT_TYPE = "07-tendermint"
 
 TRUST_NUMERATOR = 2
 TRUST_DENOMINATOR = 3
@@ -84,15 +86,7 @@ class Header:
     def sign_bytes(self) -> bytes:
         """Deterministic canonical encoding every signer commits to."""
         return json.dumps(
-            {
-                "chain_id": self.chain_id,
-                "height": self.height,
-                "time": self.time,
-                "app_hash": self.app_hash.hex(),
-                "validators": [v.to_json() for v in self.validators],
-            },
-            sort_keys=True,
-            separators=(",", ":"),
+            self.to_json(), sort_keys=True, separators=(",", ":")
         ).encode()
 
     def to_json(self) -> dict:
@@ -224,10 +218,13 @@ def verify_commit(
     for pubkey_hex, sig_hex in signatures:
         if pubkey_hex in seen or pubkey_hex not in power_of:
             continue
+        # an invalid signature contributes nothing but does not poison
+        # the commit (tendermint counts only valid precommits — evidence
+        # forwarded verbatim may carry garbage entries)
         if not verify_signature(
             bytes.fromhex(pubkey_hex), sign_bytes, bytes.fromhex(sig_hex)
         ):
-            raise ValueError(f"invalid commit signature from {pubkey_hex[:16]}…")
+            continue
         seen.add(pubkey_hex)
         signed += power_of[pubkey_hex]
     if signed * TRUST_DENOMINATOR <= total * TRUST_NUMERATOR:
@@ -255,10 +252,10 @@ def _register_client_msgs():
     @dataclasses.dataclass
     class MsgCreateClient:
         """Create a light client from an initial trusted header
-        (ibc-go MsgCreateClient: ClientState + initial ConsensusState)."""
+        (ibc-go MsgCreateClient: ClientState + initial ConsensusState).
+        The client id is assigned server-side; the tracked chain id is
+        the initial header's."""
 
-        client_id: str
-        chain_id: str
         initial_header: Header
         signer: str
 
@@ -266,36 +263,29 @@ def _register_client_msgs():
             return [self.signer]
 
         def marshal(self) -> bytes:
-            return (
-                _field_bytes(1, self.client_id.encode())
-                + _field_bytes(2, self.chain_id.encode())
-                + _json_field(3, self.initial_header.to_json())
-                + _field_bytes(4, self.signer.encode())
+            return _json_field(1, self.initial_header.to_json()) + _field_bytes(
+                2, self.signer.encode()
             )
 
         @classmethod
         def unmarshal(cls, raw: bytes) -> "MsgCreateClient":
-            client_id = chain_id = signer = ""
+            signer = ""
             header = None
             for tag, wt, val in _parse_fields(raw):
                 _require_wt(wt, 2, tag)
                 if tag == 1:
-                    client_id = bytes(val).decode()
-                elif tag == 2:
-                    chain_id = bytes(val).decode()
-                elif tag == 3:
                     header = Header.from_json(json.loads(bytes(val)))
-                elif tag == 4:
+                elif tag == 2:
                     signer = bytes(val).decode()
             if header is None:
                 raise ValueError("MsgCreateClient without initial header")
-            return cls(client_id, chain_id, header, signer)
+            return cls(header, signer)
 
         def validate_basic(self) -> None:
-            if not self.client_id or not self.chain_id:
-                raise ValueError("missing client/chain id")
             if not self.signer:
                 raise ValueError("missing signer")
+            if not self.initial_header.chain_id:
+                raise ValueError("initial header carries no chain id")
             if not self.initial_header.validators:
                 raise ValueError("initial header carries no validator set")
 
@@ -403,19 +393,28 @@ class ClientKeeper:
 
     # --- client lifecycle ---
 
-    def create_client(
-        self, client_id: str, chain_id: str, initial: Header
-    ) -> ClientState:
+    def create_client(self, initial: Header) -> ClientState:
         """Create a client from an initial trusted header (the social
         genesis trust assumption every light client starts from —
-        ibc-go MsgCreateClient with an initial consensus state)."""
-        if self.get_client(client_id) is not None:
-            raise ValueError(f"client {client_id} already exists")
+        ibc-go MsgCreateClient with an initial consensus state).
+
+        The client id is generated server-side (`07-tendermint-<n>`,
+        ibc-go's scheme) — caller-chosen ids would let an attacker squat
+        a well-known id with a validator set they control before the
+        honest client is created. The tracked chain id comes from the
+        initial header itself, so the genesis consensus state can never
+        belong to a different chain than the client claims to track."""
         if not initial.validators:
             raise ValueError("initial header carries no validator set")
+        if not initial.chain_id:
+            raise ValueError("initial header carries no chain id")
+        seq_raw = self.store.get(CLIENT_COUNTER_KEY)
+        seq = int.from_bytes(seq_raw, "big") if seq_raw else 0
+        client_id = f"{CLIENT_TYPE}-{seq}"
+        self.store.set(CLIENT_COUNTER_KEY, (seq + 1).to_bytes(8, "big"))
         cs = ClientState(
             client_id=client_id,
-            chain_id=chain_id,
+            chain_id=initial.chain_id,
             latest_height=initial.height,
             validators=list(initial.validators),
         )
@@ -425,6 +424,12 @@ class ClientKeeper:
             ConsensusState(initial.app_hash, initial.time).marshal(),
         )
         return cs
+
+    def next_client_id(self) -> str:
+        """The id create_client will assign next (for callers that need
+        to know it before submitting — ibc-go emits it as an event)."""
+        seq_raw = self.store.get(CLIENT_COUNTER_KEY)
+        return f"{CLIENT_TYPE}-{int.from_bytes(seq_raw, 'big') if seq_raw else 0}"
 
     def get_client(self, client_id: str) -> ClientState | None:
         raw = self.store.get(CLIENT_STATE_PREFIX + client_id.encode())

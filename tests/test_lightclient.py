@@ -132,14 +132,23 @@ class TestVerifyCommit:
         with pytest.raises(ValueError, match="insufficient voting power"):
             verify_commit(trusted, h, sigs)
 
-    def test_invalid_signature_rejected(self):
+    def test_invalid_signature_contributes_nothing(self):
+        """A garbage signature under a trusted key is skipped, not
+        counted (and does not poison an otherwise-sufficient commit)."""
         trusted = self._valset([(VAL_B1, 10)])
         h = _mk_header(validators=trusted)
         other = _mk_header(height=6, validators=trusted)
         # signature over the WRONG header's bytes
         sigs = self._sigs(other, [VAL_B1])
-        with pytest.raises(ValueError, match="invalid commit signature"):
+        with pytest.raises(ValueError, match="insufficient voting power"):
             verify_commit(trusted, h, sigs)
+        # garbage entry alongside a sufficient valid commit: passes
+        trusted3 = self._valset([(VAL_B1, 10), (VAL_B2, 10), (VAL_B3, 10)])
+        h3 = _mk_header(validators=trusted3)
+        sigs = self._sigs(other, [VAL_B1]) + self._sigs(
+            h3, [VAL_B1, VAL_B2, VAL_B3]
+        )
+        verify_commit(trusted3, h3, sigs)
 
 
 class TestClientKeeper:
@@ -152,7 +161,9 @@ class TestClientKeeper:
             ValidatorInfo(VAL_B3.public_key().hex(), 10),
         ]
         initial = _mk_header(height=1, validators=valset, time=10.0)
-        keeper.create_client("07-tendermint-0", "chain-x", initial)
+        cs = keeper.create_client(initial)
+        assert cs.client_id == "07-tendermint-0"  # server-assigned
+        assert cs.chain_id == "chain-x"  # derived from the header
         return store, keeper, valset
 
     def _signed(self, header, keys):
@@ -252,23 +263,22 @@ class TestClientKeeper:
         store = StateStore()
         keeper = ClientKeeper(store)
         valset = [ValidatorInfo(VAL_B1.public_key().hex(), 1)]
-        keeper.create_client(
-            "c0", "chain-x",
-            _mk_header(height=1, validators=valset, app_hash=app_hash),
-        )
+        cid = keeper.create_client(
+            _mk_header(height=1, validators=valset, app_hash=app_hash)
+        ).client_id
         value, _root, proof = counterparty.query_with_proof(b"ibc/commitment/x")
         keeper.verify_membership(
-            "c0", 1, b"ibc/commitment/x", value, proof
+            cid, 1, b"ibc/commitment/x", value, proof
         )
         with pytest.raises(ValueError, match="membership proof failed"):
             keeper.verify_membership(
-                "c0", 1, b"ibc/commitment/x", b"\x43" * 32, proof
+                cid, 1, b"ibc/commitment/x", b"\x43" * 32, proof
             )
         _v, _r, absent = counterparty.query_with_proof(b"ibc/other")
-        keeper.verify_non_membership("c0", 1, b"ibc/other", absent)
+        keeper.verify_non_membership(cid, 1, b"ibc/other", absent)
         with pytest.raises(ValueError, match="non-membership proof failed"):
             keeper.verify_non_membership(
-                "c0", 1, b"ibc/commitment/x", proof
+                cid, 1, b"ibc/commitment/x", proof
             )
 
 
@@ -395,7 +405,7 @@ class TestLightClientE2E:
         # destination advances past the timeout without receiving
         node_b.produce_block(50.0)
         before = node_a.app.bank.get_balance(alice)
-        relayer.timeout(packet, node_a, node_b, relayer.signer_a, 55.0, 50.0)
+        relayer.timeout(packet, node_a, node_b, relayer.signer_a, 55.0)
         assert node_a.app.bank.get_balance(esc) == 0
         assert node_a.app.bank.get_balance(alice) == before + 4_000
 
