@@ -1,0 +1,229 @@
+"""Stake-weighted BFT commit layer for the devnet.
+
+The reference delegates consensus to celestia-core (CometBFT); the app
+ships semantics through ABCI (SURVEY §1 L0). This module is the
+framework's L0 substitute for multi-process operation
+(test/util/testnode/full_node.go:70's role): a deterministic,
+single-round, leader-driven commit protocol with tendermint's economic
+structure —
+
+- **proposer rotation by voting power** (`proposer_rotation`): the
+  tendermint proposer-priority algorithm (priority += power each round,
+  proposer = max priority, proposer -= total) run as a pure function of
+  (valset, height), so every replica picks the same leader with a
+  long-run frequency proportional to stake and no consensus state to
+  merkleize.
+- **signed votes** (`Vote`): each validator's consensus key signs the
+  canonical (chain_id, height, proposal hash, accept) bytes.
+- **commit certificates** (`CommitCert`): a proposal commits only with
+  valid signatures carrying > 2/3 of the bonded voting power —
+  stake-weighted, so a jailed or slashed >1/3 validator halts the
+  chain until power recovers (the economic property the lockstep
+  unanimity harness could not express).
+
+One round, no locking/evidence rounds: on a devnet every replica is
+honest-but-crashable; safety comes from the 2/3 power gate and the
+app-hash cross-check at commit, liveness from the proposer retrying.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+
+from celestia_tpu.crypto import verify_signature
+
+TRUST_NUMERATOR = 2
+TRUST_DENOMINATOR = 3
+
+
+@dataclasses.dataclass
+class ConsensusValidator:
+    """A bonded validator as the vote tally sees it."""
+
+    operator: str
+    pubkey: str  # hex compressed secp256k1 (consensus key)
+    power: int
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ConsensusValidator":
+        return cls(d["operator"], d["pubkey"], int(d["power"]))
+
+
+def consensus_valset(staking) -> list["ConsensusValidator"]:
+    """The signing valset: bonded validators that registered a consensus
+    pubkey, in the staking keeper's deterministic order."""
+    return [
+        ConsensusValidator(v.operator, v.pubkey, v.power)
+        for v in staking.bonded_validators()
+        if v.pubkey
+    ]
+
+
+def total_power(valset: list[ConsensusValidator]) -> int:
+    return sum(v.power for v in valset)
+
+
+def proposer_rotation(valset: list[ConsensusValidator], height: int) -> str:
+    """Tendermint's proposer-priority rotation as a pure function.
+
+    Replays the priority algorithm from a zeroed state for `height`
+    rounds over the CURRENT valset. Deterministic across replicas (same
+    committed valset → same leader) and stake-proportional in the long
+    run. O(height · n); a devnet at height 10⁴ with 10 validators is
+    10⁵ integer ops — irrelevant. Divergence from tendermint: priorities
+    reset when the valset changes (pure function of the present set)
+    instead of carrying over — acceptable because fairness here is
+    per-valset-epoch, not across epochs."""
+    if not valset:
+        raise ValueError("empty validator set")
+    prio = {v.operator: 0 for v in valset}
+    total = total_power(valset)
+    if total <= 0:
+        raise ValueError("validator set has no power")
+    proposer = valset[0].operator
+    for _ in range(height + 1):
+        for v in valset:
+            prio[v.operator] += v.power
+        # max priority; ties break on operator address for determinism
+        proposer = max(valset, key=lambda v: (prio[v.operator], v.operator)).operator
+        prio[proposer] -= total
+    return proposer
+
+
+def proposal_hash(
+    chain_id: str,
+    height: int,
+    block_time: float,
+    proposer: str,
+    data_hash: bytes,
+    square_size: int,
+    txs: list[bytes],
+) -> bytes:
+    """Canonical digest of everything a vote endorses. Votes sign this,
+    so two proposals differing in any field produce disjoint votes."""
+    txs_digest = hashlib.sha256(
+        b"".join(hashlib.sha256(t).digest() for t in txs)
+    ).digest()
+    payload = json.dumps(
+        {
+            "chain_id": chain_id,
+            "height": height,
+            "time": block_time,
+            "proposer": proposer,
+            "data_hash": data_hash.hex(),
+            "square_size": square_size,
+            "txs": txs_digest.hex(),
+        },
+        sort_keys=True,
+        separators=(",", ":"),
+    ).encode()
+    return hashlib.sha256(payload).digest()
+
+
+def vote_sign_bytes(chain_id: str, height: int, prop_hash: bytes,
+                    accept: bool) -> bytes:
+    return json.dumps(
+        {
+            "chain_id": chain_id,
+            "height": height,
+            "proposal": prop_hash.hex(),
+            "accept": accept,
+        },
+        sort_keys=True,
+        separators=(",", ":"),
+    ).encode()
+
+
+@dataclasses.dataclass
+class Vote:
+    operator: str
+    accept: bool
+    signature: str  # hex, over vote_sign_bytes
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Vote":
+        return cls(d["operator"], bool(d["accept"]), d["signature"])
+
+
+def make_vote(key, operator: str, chain_id: str, height: int,
+              prop_hash: bytes, accept: bool) -> Vote:
+    sig = key.sign(vote_sign_bytes(chain_id, height, prop_hash, accept))
+    return Vote(operator, accept, sig.hex())
+
+
+@dataclasses.dataclass
+class CommitCert:
+    """Proof that > 2/3 of bonded power accepted a proposal."""
+
+    height: int
+    prop_hash: bytes
+    votes: list[Vote]
+
+    def to_json(self) -> dict:
+        return {
+            "height": self.height,
+            "prop_hash": self.prop_hash.hex(),
+            "votes": [v.to_json() for v in self.votes],
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "CommitCert":
+        return cls(
+            height=int(d["height"]),
+            prop_hash=bytes.fromhex(d["prop_hash"]),
+            votes=[Vote.from_json(v) for v in d["votes"]],
+        )
+
+
+def tally(valset: list[ConsensusValidator], chain_id: str, height: int,
+          prop_hash: bytes, votes: list[Vote]) -> int:
+    """Accepting power carried by valid, de-duplicated votes from the
+    valset. Invalid/unknown/duplicate entries contribute nothing."""
+    power_of = {v.operator: v.power for v in valset}
+    pubkey_of = {v.operator: v.pubkey for v in valset}
+    seen: set[str] = set()
+    accepted = 0
+    for vote in votes:
+        if vote.operator in seen or vote.operator not in power_of:
+            continue
+        if not vote.accept:
+            continue
+        if not verify_signature(
+            bytes.fromhex(pubkey_of[vote.operator]),
+            vote_sign_bytes(chain_id, height, prop_hash, vote.accept),
+            bytes.fromhex(vote.signature),
+        ):
+            continue
+        seen.add(vote.operator)
+        accepted += power_of[vote.operator]
+    return accepted
+
+
+def meets_quorum(accepted: int, total: int) -> bool:
+    """STRICTLY more than 2/3 of total power — the single place the
+    trust fraction lives (leaders, verifiers, and harnesses must agree
+    on the threshold or leaders mint certificates peers reject)."""
+    return accepted * TRUST_DENOMINATOR > total * TRUST_NUMERATOR
+
+
+def verify_commit_cert(
+    valset: list[ConsensusValidator], chain_id: str, cert: CommitCert
+) -> None:
+    """Raise unless the certificate carries > 2/3 of the valset power."""
+    total = total_power(valset)
+    if total <= 0:
+        raise ValueError("validator set has no power")
+    accepted = tally(valset, chain_id, cert.height, cert.prop_hash, cert.votes)
+    if not meets_quorum(accepted, total):
+        raise ValueError(
+            f"commit certificate carries {accepted}/{total} power "
+            f"(need > {TRUST_NUMERATOR}/{TRUST_DENOMINATOR})"
+        )

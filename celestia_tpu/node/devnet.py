@@ -1,0 +1,433 @@
+"""Networked multi-process devnet: N validator Nodes on localhost.
+
+The reference boots real in-process validator nodes with open ports
+(test/util/testnode/full_node.go:70) and a k8s e2e testnet
+(test/e2e/testnet.go:16). This module is the framework's localhost
+equivalent: each validator is its own OS process running a Node +
+RpcServer; they exchange proposals, stake-weighted votes, commit
+certificates, and gossiped txs over the existing HTTP RPC transport,
+and a crashed validator rejoins via the existing state-sync snapshot
+path.
+
+Protocol (node/consensus.py): leader-driven, one round per height.
+
+1. The rotation leader (proposer_rotation over the bonded valset)
+   reaps its mempool, runs PrepareProposal, signs the proposal hash,
+   and POSTs /consensus/proposal to every peer.
+2. Peers re-run ProcessProposal and return a signed stake vote. A
+   validator votes at most once per height (tracked per height; a
+   conflicting proposal at the same height is refused while the vote
+   is fresh), so two certificates can never form at one height while
+   > 1/3 of power is honest-and-live.
+3. With > 2/3 of bonded power accepting, the leader applies the block,
+   then POSTs /consensus/commit (proposal + certificate + its app
+   hash). Peers verify the certificate against their OWN committed
+   valset, apply the block, and cross-check the app hash — any
+   divergence halts that peer loudly (the reference's app-hash
+   mismatch panic).
+4. broadcast_tx gossips: a tx accepted by any node's CheckTx is
+   forwarded once to every peer, so it reaches the next leader's
+   mempool.
+
+Fault model: crash faults, not Byzantine. Within one liveness window
+the vote-once rule makes two certificates at a height impossible while
+> 1/3 of power is honest-and-live. The window is load-bearing: a
+leader that STALLS longer than `liveness_timeout` mid-commit (rather
+than dying) can leave one peer committed on its block while expired
+votes let a takeover leader certify a different block — the stalled
+leader then halts on the app-hash cross-check at the next height
+instead of being prevented up front. CometBFT closes that hole with
+locking/round machinery and slashable evidence; a devnet of
+honest-but-crashable replicas accepts the window, and that divergence
+is deliberate and documented here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import threading
+import time
+
+from celestia_tpu.crypto import PrivateKey
+from celestia_tpu.log import logger
+from celestia_tpu.node.client import RpcClient
+from celestia_tpu.node.consensus import (
+    CommitCert,
+    ConsensusValidator,
+    consensus_valset,
+    make_vote,
+    meets_quorum,
+    proposal_hash,
+    proposer_rotation,
+    tally,
+    total_power,
+    verify_commit_cert,
+)
+from celestia_tpu.node.node import Node
+
+log = logger("devnet")
+
+
+class PeerClient(RpcClient):
+    """RpcClient + the consensus routes."""
+
+    def consensus_proposal(self, body: dict) -> dict:
+        return self._post("/consensus/proposal", body)
+
+    def consensus_commit(self, body: dict) -> dict:
+        return self._post("/consensus/commit", body)
+
+    def gossip_tx(self, raw: bytes) -> dict:
+        return self._post("/broadcast_tx", {"tx": raw.hex(), "forward": False})
+
+
+class ValidatorNode:
+    """A Node + consensus key + peer set: one devnet validator.
+
+    Attach to a Node before serving RPC (RpcServer routes the
+    /consensus/* endpoints through `node.validator`)."""
+
+    def __init__(self, node: Node, key: PrivateKey, peers: list[str],
+                 liveness_timeout: float = 10.0):
+        self.node = node
+        self.key = key
+        self.operator = key.bech32_address()
+        self.peers = [PeerClient(p, timeout=5.0) for p in peers]
+        self.liveness_timeout = liveness_timeout
+        # vote-once bookkeeping: height -> (prop_hash, voted_at)
+        self._voted: dict[int, tuple[bytes, float]] = {}
+        self._vote_lock = threading.Lock()
+        self._last_commit = time.monotonic()
+        self.halted: str | None = None  # set on app-hash divergence
+        node.validator = self
+
+    # ---- helpers ----
+
+    def _valset(self) -> list[ConsensusValidator]:
+        return consensus_valset(self.node.app.staking)
+
+    def _prop_hash(self, body: dict) -> bytes:
+        return proposal_hash(
+            self.node.app.chain_id,
+            int(body["height"]),
+            float(body["time"]),
+            body["proposer"],
+            bytes.fromhex(body["data_hash"]),
+            int(body["square_size"]),
+            [bytes.fromhex(t) for t in body["txs"]],
+        )
+
+    # ---- peer-facing handlers (RPC threads) ----
+
+    def handle_proposal(self, body: dict) -> dict:
+        """ProcessProposal + stake vote (consensus step 2)."""
+        if self.halted:
+            raise ValueError(f"validator halted: {self.halted}")
+        height = int(body["height"])
+        if height != self.node.app.height + 1:
+            raise ValueError(
+                f"proposal height {height}, expected {self.node.app.height + 1}"
+            )
+        valset = self._valset()
+        if body["proposer"] not in {v.operator for v in valset}:
+            raise ValueError(f"proposer {body['proposer']} is not bonded")
+        ph = self._prop_hash(body)
+
+        with self._vote_lock:
+            prior = self._voted.get(height)
+            if prior is not None and prior[0] != ph:
+                if time.monotonic() - prior[1] < self.liveness_timeout:
+                    raise ValueError(
+                        f"already voted at height {height} for a different "
+                        "proposal"
+                    )
+                # stale vote from a leader that died before committing —
+                # crash-fault liveness: free the height for re-proposal
+            from celestia_tpu.app.app import ProposalBlockData
+
+            proposal = ProposalBlockData(
+                txs=[bytes.fromhex(t) for t in body["txs"]],
+                square_size=int(body["square_size"]),
+                hash=bytes.fromhex(body["data_hash"]),
+            )
+            with self.node._lock:
+                accept = self.node.app.process_proposal(proposal)
+            vote = make_vote(
+                self.key, self.operator, self.node.app.chain_id, height, ph,
+                accept,
+            )
+            if accept:
+                self._voted[height] = (ph, time.monotonic())
+        return {"vote": vote.to_json()}
+
+    def handle_commit(self, body: dict) -> dict:
+        """Verify the certificate against our OWN valset, apply, and
+        cross-check the app hash (consensus step 3)."""
+        if self.halted:
+            raise ValueError(f"validator halted: {self.halted}")
+        height = int(body["height"])
+        if height <= self.node.app.height:
+            return {"app_hash": self._app_hash_hex(), "height": self.node.app.height}
+        if height != self.node.app.height + 1:
+            raise ValueError(
+                f"commit height {height}, node at {self.node.app.height}: "
+                "catch up via state sync"
+            )
+        cert = CommitCert.from_json(body["cert"])
+        ph = self._prop_hash(body)
+        if cert.prop_hash != ph:
+            raise ValueError("certificate does not match the proposal")
+        verify_commit_cert(self._valset(), self.node.app.chain_id, cert)
+        # expected_height re-checks under node._lock: two concurrent
+        # commit handlers both passing the height gate above must not
+        # stack — the second would apply a block its certificate does
+        # not cover
+        block = self.node.apply_external_block(
+            [bytes.fromhex(t) for t in body["txs"]],
+            int(body["square_size"]),
+            bytes.fromhex(body["data_hash"]),
+            float(body["time"]),
+            expected_height=height,
+        )
+        self._last_commit = time.monotonic()
+        if block.app_hash.hex() != body["app_hash"]:
+            # deterministic state machines diverged — halt loudly, never
+            # keep signing on a forked state
+            self.halted = (
+                f"app hash divergence at height {height}: "
+                f"{block.app_hash.hex()} != {body['app_hash']}"
+            )
+            log.error("HALT", reason=self.halted)
+            raise ValueError(self.halted)
+        return {"app_hash": block.app_hash.hex(), "height": block.height}
+
+    def gossip_tx(self, raw: bytes) -> None:
+        """Forward a freshly-admitted tx to every peer once."""
+        for peer in self.peers:
+            try:
+                peer.gossip_tx(raw)
+            except Exception as e:  # noqa: BLE001 — a dead peer is fine
+                log.info("gossip skip", peer=peer.base_url, error=str(e))
+
+    # ---- leader drive ----
+
+    def _app_hash_hex(self) -> str:
+        store = self.node.app.store
+        return store.app_hashes.get(store.version, b"").hex()
+
+    def is_leader(self, height: int) -> bool:
+        valset = self._valset()
+        return bool(valset) and proposer_rotation(valset, height) == self.operator
+
+    def try_propose(self, block_time: float | None = None) -> dict | None:
+        """One consensus round, if it's our turn (or the leader looks
+        dead). Returns the commit summary or None."""
+        if self.halted:
+            return None
+        app = self.node.app
+        height = app.height + 1
+        leader = self.is_leader(height)
+        if not leader and (
+            time.monotonic() - self._last_commit < self.liveness_timeout
+        ):
+            return None  # the rotation leader is alive — let it drive
+
+        block_time = block_time if block_time is not None else time.time()
+        with self.node._lock:
+            proposal = app.prepare_proposal(self.node.mempool.reap())
+        body = {
+            "height": height,
+            "time": block_time,
+            "proposer": self.operator,
+            "square_size": proposal.square_size,
+            "data_hash": proposal.hash.hex(),
+            "txs": [t.hex() for t in proposal.txs],
+        }
+        ph = self._prop_hash(body)
+        valset = self._valset()
+
+        with self._vote_lock:
+            # the vote-once rule binds the proposer too: having voted
+            # for another leader's fresh proposal at this height, we
+            # must not sign a conflicting one of our own
+            prior = self._voted.get(height)
+            if prior is not None and prior[0] != ph:
+                if time.monotonic() - prior[1] < self.liveness_timeout:
+                    return None
+            self._voted[height] = (ph, time.monotonic())
+        votes = [
+            make_vote(self.key, self.operator, app.chain_id, height, ph, True)
+        ]
+        for peer in self.peers:
+            try:
+                res = peer.consensus_proposal(body)
+                if "vote" in res:
+                    from celestia_tpu.node.consensus import Vote
+
+                    votes.append(Vote.from_json(res["vote"]))
+            except Exception as e:  # noqa: BLE001
+                log.info("peer vote skip", peer=peer.base_url, error=str(e))
+
+        accepted = tally(valset, app.chain_id, height, ph, votes)
+        total = total_power(valset)
+        if not meets_quorum(accepted, total):
+            log.info("round failed", height=height, power=f"{accepted}/{total}")
+            return None
+        cert = CommitCert(height, ph, votes)
+
+        block = self.node.apply_external_block(
+            proposal.txs, proposal.square_size, proposal.hash, block_time,
+            expected_height=height,
+        )
+        self._last_commit = time.monotonic()
+        commit_body = {**body, "cert": cert.to_json(),
+                       "app_hash": block.app_hash.hex()}
+        peer_hashes = {}
+        for peer in self.peers:
+            try:
+                res = peer.consensus_commit(commit_body)
+                peer_hashes[peer.base_url] = res.get("app_hash", res.get("error"))
+            except Exception as e:  # noqa: BLE001
+                log.info("peer commit skip", peer=peer.base_url, error=str(e))
+        log.info("devnet block", height=block.height,
+                 app_hash=block.app_hash.hex()[:16],
+                 votes=f"{accepted}/{total}", peers=len(peer_hashes))
+        return {
+            "height": block.height,
+            "app_hash": block.app_hash.hex(),
+            "power": [accepted, total],
+            "peer_hashes": peer_hashes,
+        }
+
+
+# ------------------------------------------------------------------ #
+# process entry
+
+
+def build_validator(genesis: dict, index: int, listen_port: int,
+                    peer_ports: list[int], home: str | None = None,
+                    liveness_timeout: float = 10.0):
+    """Construct (Node, ValidatorNode, RpcServer) for validator `index`
+    of a devnet genesis document:
+
+        {"chain_id": ..., "accounts": {addr: amount},
+         "validators": [{"secret": hex, "tokens": N}, ...]}
+
+    Every process derives the same genesis state, so height-0 app
+    hashes agree by construction."""
+    from celestia_tpu.app import App
+    from celestia_tpu.node.rpc import RpcServer
+
+    secrets = [bytes.fromhex(v["secret"]) for v in genesis["validators"]]
+    keys = [PrivateKey.from_secret(s) for s in secrets]
+    app = App(chain_id=genesis["chain_id"])
+    accounts = {k: int(v) for k, v in genesis.get("accounts", {}).items()}
+    for key, v in zip(keys, genesis["validators"]):
+        accounts.setdefault(key.bech32_address(), 0)
+        accounts[key.bech32_address()] += int(v["tokens"])
+    app.init_chain(
+        accounts,
+        genesis_time=float(genesis.get("genesis_time", 0.0)),
+        genesis_validators={
+            k.bech32_address(): int(v["tokens"])
+            for k, v in zip(keys, genesis["validators"])
+        },
+    )
+    # register consensus pubkeys (the gentx ConsensusPubkey field)
+    for key in keys:
+        val = app.staking.get_validator(key.bech32_address())
+        val.pubkey = key.public_key().hex()
+        app.staking.set_validator(val)
+    app.store.commit_hash_refresh()
+
+    node = Node(app, home=home)
+    validator = ValidatorNode(
+        node, keys[index],
+        [f"http://127.0.0.1:{p}" for p in peer_ports],
+        liveness_timeout=liveness_timeout,
+    )
+    server = RpcServer(node, port=listen_port)
+    return node, validator, server
+
+
+def write_genesis(path: str, n_validators: int = 3,
+                  tokens: int = 10_000_000,
+                  chain_id: str = "devnet-local") -> dict:
+    """Write a throwaway devnet genesis: deterministic validator
+    secrets (NEVER for anything but a local devnet) + a funded
+    `devnet-faucet` account."""
+    faucet = PrivateKey.from_secret(b"devnet-faucet")
+    genesis = {
+        "chain_id": chain_id,
+        "accounts": {faucet.bech32_address(): 10**12},
+        "validators": [
+            {"secret": f"devnet-val-{i}".encode().hex(), "tokens": tokens}
+            for i in range(n_validators)
+        ],
+    }
+    pathlib.Path(path).write_text(json.dumps(genesis, indent=1))
+    return genesis
+
+
+def run_validator(args) -> None:
+    genesis = json.loads(pathlib.Path(args.genesis).read_text())
+    ports = [int(p) for p in args.ports.split(",")]
+    listen = ports[args.index]
+    peers = [p for i, p in enumerate(ports) if i != args.index]
+    node, validator, server = build_validator(
+        genesis, args.index, listen, peers, home=args.home or None,
+        liveness_timeout=args.liveness_timeout,
+    )
+    server.start()
+    log.info("validator up", index=args.index, port=listen,
+             operator=validator.operator)
+    try:
+        while True:
+            validator.try_propose()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+
+
+def main(argv=None) -> int:
+    # A devnet validator never needs the accelerator: honor a cpu
+    # request at the config level, because the environment's
+    # sitecustomize pins JAX_PLATFORMS to the TPU tunnel and wins over
+    # plain env vars (see tests/conftest.py) — N validator processes
+    # fighting over the single-chip tunnel would serialize for nothing.
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001 — no jax, nothing to pin
+            pass
+    parser = argparse.ArgumentParser(
+        prog="python -m celestia_tpu.node.devnet",
+        description="one validator process of a localhost devnet",
+    )
+    parser.add_argument("--genesis", required=True,
+                        help="path to the shared genesis JSON")
+    parser.add_argument("--index", type=int, required=True,
+                        help="this validator's index in genesis.validators")
+    parser.add_argument("--ports", required=True,
+                        help="comma-separated RPC ports, one per validator")
+    parser.add_argument("--home", default="",
+                        help="block/snapshot persistence directory")
+    parser.add_argument("--interval", type=float, default=1.0,
+                        help="leader tick interval seconds")
+    parser.add_argument("--liveness-timeout", type=float, default=10.0,
+                        help="seconds before a peer takes over a dead leader")
+    args = parser.parse_args(argv)
+    run_validator(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

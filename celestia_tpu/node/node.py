@@ -156,11 +156,46 @@ class Node:
 
     def _produce_block_locked(self, block_time: float | None) -> Block:
         block_time = block_time if block_time is not None else time.time()
-        t0 = time.perf_counter()
         proposal = self.app.prepare_proposal(self.mempool.reap())
+        return self._apply_block_locked(proposal, block_time, own=True)
+
+    def apply_external_block(self, txs: list[bytes], square_size: int,
+                             data_hash: bytes, block_time: float,
+                             expected_height: int | None = None) -> Block:
+        """Apply a block decided elsewhere (a devnet peer's committed
+        proposal): full ProcessProposal validation, then the normal
+        deliver/commit pipeline. The caller (node/devnet.py) has already
+        verified the commit certificate; `expected_height` re-binds the
+        block to the height that certificate covers UNDER the node lock,
+        so two concurrent commit deliveries can never stack (the second
+        would otherwise land at height+1 with a cert for height)."""
+        from celestia_tpu.app.app import ProposalBlockData
+
+        with self._lock:
+            if (
+                expected_height is not None
+                and self.app.height + 1 != expected_height
+            ):
+                raise ValueError(
+                    f"block certified for height {expected_height}, node "
+                    f"is at {self.app.height}"
+                )
+            proposal = ProposalBlockData(
+                txs=list(txs), square_size=square_size, hash=data_hash
+            )
+            return self._apply_block_locked(proposal, block_time, own=False)
+
+    def _apply_block_locked(self, proposal, block_time: float,
+                            own: bool) -> Block:
+        t0 = time.perf_counter()
         if not self.app.process_proposal(proposal):
-            log.error("own proposal rejected", height=self.app.height + 1)
-            raise RuntimeError("node produced a proposal it cannot accept")
+            if own:
+                log.error("own proposal rejected", height=self.app.height + 1)
+                raise RuntimeError("node produced a proposal it cannot accept")
+            raise ValueError(
+                f"proposal for height {self.app.height + 1} fails "
+                "ProcessProposal"
+            )
 
         self.app.begin_block(block_time)
         results = [self.app.deliver_tx(t) for t in proposal.txs]

@@ -419,12 +419,39 @@ def _handler_for(node: Node):
                 if parts == ["broadcast_tx"]:
                     raw = bytes.fromhex(body["tx"])
                     res = node.broadcast_tx(raw)
+                    # devnet gossip: forward a freshly-admitted tx to
+                    # peers exactly once (forward=False marks relayed
+                    # copies, so gossip never loops). Off-thread: a hung
+                    # peer must not stall the submitter's reply into its
+                    # client timeout (and a retry double-submit).
+                    validator = getattr(node, "validator", None)
+                    if (
+                        res.code == 0
+                        and validator is not None
+                        and body.get("forward", True)
+                    ):
+                        threading.Thread(
+                            target=validator.gossip_tx, args=(raw,),
+                            daemon=True,
+                        ).start()
                     self._reply(
                         {"code": res.code, "log": res.log, "priority": res.priority}
                     )
                 elif parts == ["produce_block"]:
                     block = node.produce_block()
                     self._reply(block.to_json())
+                elif parts == ["consensus", "proposal"]:
+                    validator = getattr(node, "validator", None)
+                    if validator is None:
+                        self._reply({"error": "not a devnet validator"}, 404)
+                    else:
+                        self._reply(validator.handle_proposal(body))
+                elif parts == ["consensus", "commit"]:
+                    validator = getattr(node, "validator", None)
+                    if validator is None:
+                        self._reply({"error": "not a devnet validator"}, 404)
+                    else:
+                        self._reply(validator.handle_commit(body))
                 else:
                     self._reply({"error": "unknown route"}, 404)
             except Exception as e:  # noqa: BLE001

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Localhost multi-validator devnet (the reference's scripts/single-node.sh
+# sibling, scaled out; see test/util/testnode/full_node.go:70 for the
+# capability this reproduces). Each validator is its own OS process with
+# its own RPC port; they exchange proposals, stake votes, commit
+# certificates, and gossiped txs over HTTP.
+#
+#   scripts/multi-node.sh [N_VALIDATORS] [BASE_DIR]
+#
+# RPC endpoints come up on 127.0.0.1:26657..26657+N-1. Ctrl-C stops all.
+set -euo pipefail
+N=${1:-3}
+BASE=${2:-"${TMPDIR:-/tmp}/celestia-devnet"}
+PORT0=${PORT0:-26657}
+cd "$(dirname "$0")/.."
+
+mkdir -p "$BASE"
+GENESIS="$BASE/genesis.json"
+python -c "from celestia_tpu.node.devnet import write_genesis; write_genesis('$GENESIS', $N)"
+
+PORTS=$(python -c "print(','.join(str($PORT0+i) for i in range($N)))")
+PIDS=()
+cleanup() { for p in "${PIDS[@]}"; do kill "$p" 2>/dev/null || true; done; }
+trap cleanup EXIT INT TERM
+
+for i in $(seq 0 $((N-1))); do
+  JAX_PLATFORMS=cpu python -m celestia_tpu.node.devnet \
+    --genesis "$GENESIS" --index "$i" --ports "$PORTS" \
+    --home "$BASE/v$i" &
+  PIDS+=($!)
+done
+echo "devnet up: $N validators, RPC on ports $PORTS (base dir $BASE)"
+wait
