@@ -3,9 +3,18 @@
 The reference uses Cosmos SDK protobuf txs (TxRaw{body, auth_info,
 signatures}) signed in SIGN_MODE_DIRECT over SignDoc{body_bytes,
 auth_info_bytes, chain_id, account_number} (pkg/user/signer.go:287,
-app/encoding/encoding.go). This module implements that scheme with the
-same structure on the in-repo proto codec: deterministic byte encodings,
-a message registry keyed by type URL, and direct-mode sign bytes.
+app/encoding/encoding.go). This module implements those proto shapes
+byte-for-byte on the in-repo wire codec — `tests/test_wire_parity.py`
+pins every layer (TxRaw, SignDoc, TxBody, AuthInfo, SignerInfo, Fee,
+MsgPayForBlobs, Blob, BlobTx) against golden bytes produced by an
+independent protobuf implementation of the reference .proto files.
+
+Known wire divergences (deliberate, see specs/wire.md):
+- TxBody.timeout_height / extension options are not modeled (encoded
+  as their proto3 defaults, i.e. absent — byte-compatible until used).
+- Fee is restricted to a single Coin; multi-coin fees are rejected at
+  decode (the chain's fee market is utia-only).
+- Signatures are 64-byte low-S (r ‖ s) secp256k1 — same as Cosmos.
 """
 
 from __future__ import annotations
@@ -44,6 +53,12 @@ def decode_any(type_url: str, value: bytes):
 
 @dataclasses.dataclass
 class Fee:
+    """cosmos.tx.v1beta1.Fee: `repeated Coin amount = 1` (Coin is
+    {string denom = 1, string amount = 2} — the amount is a decimal
+    STRING on the wire), `uint64 gas_limit = 2`, `string payer = 3`,
+    `string granter = 4`. The dataclass keeps the single-coin view the
+    ante chain consumes; multi-coin fees are rejected at decode."""
+
     amount: int = 0
     gas_limit: int = 0
     denom: str = "utia"
@@ -51,54 +66,120 @@ class Fee:
     granter: str = ""
 
     def marshal(self) -> bytes:
+        out = b""
+        if self.amount:
+            coin = _field_bytes(1, self.denom.encode()) + _field_bytes(
+                2, str(self.amount).encode()
+            )
+            out += _field_bytes(1, coin)
         return (
-            _field_uint(1, self.amount)
+            out
             + _field_uint(2, self.gas_limit)
-            + _field_bytes(3, self.denom.encode())
-            + _field_bytes(4, self.payer.encode())
-            + _field_bytes(5, self.granter.encode())
+            + _field_bytes(3, self.payer.encode())
+            + _field_bytes(4, self.granter.encode())
         )
 
     @classmethod
     def unmarshal(cls, raw: bytes) -> "Fee":
-        f = cls(denom="")
+        f = cls(amount=0, denom="")
+        seen_coin = False
         for tag, wt, val in _parse_fields(raw):
             if tag == 1:
-                _require_wt(wt, 0, tag)
-                f.amount = int(val)
+                _require_wt(wt, 2, tag)
+                if seen_coin:
+                    raise ValueError(
+                        "multi-coin fees are not supported (utia-only fee market)"
+                    )
+                seen_coin = True
+                for t2, w2, v2 in _parse_fields(bytes(val)):
+                    if t2 == 1:
+                        _require_wt(w2, 2, t2)
+                        f.denom = bytes(v2).decode()
+                    elif t2 == 2:
+                        _require_wt(w2, 2, t2)
+                        amount_str = bytes(v2).decode()
+                        if not amount_str.isdigit():
+                            raise ValueError(
+                                f"invalid coin amount {amount_str!r}"
+                            )
+                        f.amount = int(amount_str)
             elif tag == 2:
                 _require_wt(wt, 0, tag)
                 f.gas_limit = int(val)
             elif tag == 3:
                 _require_wt(wt, 2, tag)
-                f.denom = bytes(val).decode()
-            elif tag == 4:
-                _require_wt(wt, 2, tag)
                 f.payer = bytes(val).decode()
-            elif tag == 5:
+            elif tag == 4:
                 _require_wt(wt, 2, tag)
                 f.granter = bytes(val).decode()
         return f
 
 
+SECP256K1_PUBKEY_TYPE_URL = "/cosmos.crypto.secp256k1.PubKey"
+SIGN_MODE_DIRECT = 1  # cosmos.tx.signing.v1beta1.SignMode
+
+
 @dataclasses.dataclass
 class SignerInfo:
+    """cosmos.tx.v1beta1.SignerInfo: `Any public_key = 1` (wrapping
+    cosmos.crypto.secp256k1.PubKey{bytes key = 1}), `ModeInfo
+    mode_info = 2` (single/DIRECT), `uint64 sequence = 3`."""
+
     public_key: bytes  # 33-byte compressed secp256k1
     sequence: int
 
     def marshal(self) -> bytes:
-        return _field_bytes(1, self.public_key) + _field_uint(2, self.sequence)
+        pubkey_any = _field_bytes(
+            1, SECP256K1_PUBKEY_TYPE_URL.encode()
+        ) + _field_bytes(2, _field_bytes(1, self.public_key))
+        # ModeInfo{ single: Single{ mode: SIGN_MODE_DIRECT } }
+        mode_info = _field_bytes(1, _field_uint(1, SIGN_MODE_DIRECT))
+        return (
+            _field_bytes(1, pubkey_any)
+            + _field_bytes(2, mode_info)
+            + _field_uint(3, self.sequence)
+        )
 
     @classmethod
     def unmarshal(cls, raw: bytes) -> "SignerInfo":
         s = cls(b"", 0)
+        mode = None
         for tag, wt, val in _parse_fields(raw):
             if tag == 1:
                 _require_wt(wt, 2, tag)
-                s.public_key = bytes(val)
+                type_url, value = "", b""
+                for t2, w2, v2 in _parse_fields(bytes(val)):
+                    if t2 == 1:
+                        _require_wt(w2, 2, t2)
+                        type_url = bytes(v2).decode()
+                    elif t2 == 2:
+                        _require_wt(w2, 2, t2)
+                        value = bytes(v2)
+                if type_url != SECP256K1_PUBKEY_TYPE_URL:
+                    raise ValueError(
+                        f"unsupported signer pubkey type {type_url!r}"
+                    )
+                for t2, w2, v2 in _parse_fields(value):
+                    if t2 == 1:
+                        _require_wt(w2, 2, t2)
+                        s.public_key = bytes(v2)
             elif tag == 2:
+                _require_wt(wt, 2, tag)
+                for t2, w2, v2 in _parse_fields(bytes(val)):
+                    if t2 == 1:
+                        _require_wt(w2, 2, t2)
+                        for t3, w3, v3 in _parse_fields(bytes(v2)):
+                            if t3 == 1:
+                                _require_wt(w3, 0, t3)
+                                mode = int(v3)
+            elif tag == 3:
                 _require_wt(wt, 0, tag)
                 s.sequence = int(val)
+        # the check runs whether or not mode_info was present: an
+        # OMITTED mode_info must not bypass the DIRECT requirement (the
+        # SDK rejects unset sign modes)
+        if mode != SIGN_MODE_DIRECT:
+            raise ValueError(f"unsupported sign mode {mode} (only DIRECT)")
         return s
 
 

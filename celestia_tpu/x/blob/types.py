@@ -38,16 +38,42 @@ class MsgPayForBlobs:
         return [self.signer]
 
     def marshal(self) -> bytes:
+        # proto3 packs `repeated uint32` by default (one length-delimited
+        # field holding concatenated varints) — the reference's generated
+        # Go code does exactly this, so byte parity requires it here
+        # (proto/celestia/blob/v1/tx.proto fields 3 and 8)
+        from celestia_tpu.blob import uvarint
+
         out = _field_bytes(1, self.signer.encode())
         for ns in self.namespaces:
             out += _field_bytes(2, ns)
-        for size in self.blob_sizes:
-            out += _field_uint(3, size) if size else b"\x18\x00"
+        if self.blob_sizes:
+            out += _field_bytes(
+                3, b"".join(uvarint(s) for s in self.blob_sizes)
+            )
         for c in self.share_commitments:
             out += _field_bytes(4, c)
-        for v in self.share_versions:
-            out += _field_uint(8, v) if v else b"\x40\x00"
+        if self.share_versions:
+            out += _field_bytes(
+                8, b"".join(uvarint(v) for v in self.share_versions)
+            )
         return out
+
+    @staticmethod
+    def _repeated_uint(wt: int, val, into: list[int]) -> None:
+        """Packed (wt 2) or unpacked (wt 0) repeated scalar — a
+        conforming proto parser accepts both encodings."""
+        from celestia_tpu.blob import read_uvarint
+
+        if wt == 0:
+            into.append(int(val))
+            return
+        if wt != 2:
+            raise ValueError(f"repeated uint field has wire type {wt}")
+        buf, pos = bytes(val), 0
+        while pos < len(buf):
+            n, pos = read_uvarint(buf, pos)
+            into.append(n)
 
     @classmethod
     def unmarshal(cls, raw: bytes) -> "MsgPayForBlobs":
@@ -60,14 +86,12 @@ class MsgPayForBlobs:
                 _require_wt(wt, 2, tag)
                 msg.namespaces.append(bytes(val))
             elif tag == 3:
-                _require_wt(wt, 0, tag)
-                msg.blob_sizes.append(int(val))
+                cls._repeated_uint(wt, val, msg.blob_sizes)
             elif tag == 4:
                 _require_wt(wt, 2, tag)
                 msg.share_commitments.append(bytes(val))
             elif tag == 8:
-                _require_wt(wt, 0, tag)
-                msg.share_versions.append(int(val))
+                cls._repeated_uint(wt, val, msg.share_versions)
         return msg
 
     def validate_basic(self) -> None:

@@ -1,0 +1,333 @@
+"""Byte-for-byte tx wire parity against the reference proto shapes
+(VERDICT r2 item 6; ref: pkg/user/signer.go:287 signs SIGN_MODE_DIRECT
+TxRaw/SignDoc, app/encoding/encoding.go:26-55 registers the codec,
+proto/celestia/blob/v1/tx.proto + proto/celestia/core/v1/blob/blob.proto
+define the blob messages).
+
+Golden oracle: the message types are rebuilt here from the .proto
+definitions with `google.protobuf` dynamic descriptors — an independent
+encoder implementing the same spec as the reference's generated Go code
+(proto3 deterministic encoding: fields by number, packed repeated
+scalars, zero-value omission). Every layer of the in-repo hand-rolled
+codec must serialize byte-identically.
+"""
+
+import pytest
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+from celestia_tpu import blob as blob_pkg
+from celestia_tpu import namespace as ns_pkg
+from celestia_tpu.crypto import PrivateKey
+from celestia_tpu.tx import (
+    SECP256K1_PUBKEY_TYPE_URL,
+    Fee,
+    SignerInfo,
+    Tx,
+    sign_doc_bytes,
+    sign_tx,
+)
+from celestia_tpu.x.blob.types import MsgPayForBlobs
+
+ALICE = PrivateKey.from_secret(b"alice")
+
+
+def _build_pool():
+    """The reference proto files, reconstructed as dynamic descriptors.
+
+    Field numbers/types transcribed from:
+    - cosmos/base/v1beta1/coin.proto (Coin)
+    - cosmos/tx/v1beta1/tx.proto (TxRaw, SignDoc, TxBody, AuthInfo,
+      SignerInfo, ModeInfo, Fee)
+    - cosmos/crypto/secp256k1/keys.proto (PubKey)
+    - /root/reference/proto/celestia/blob/v1/tx.proto (MsgPayForBlobs)
+    - /root/reference/proto/celestia/core/v1/blob/blob.proto (Blob, BlobTx)
+    """
+    pool = descriptor_pool.DescriptorPool()
+    pool.Add(descriptor_pb2.FileDescriptorProto(
+        name="google/protobuf/any.proto", package="google.protobuf",
+        syntax="proto3",
+        message_type=[dict(
+            name="Any",
+            field=[
+                dict(name="type_url", number=1, type=9, label=1),
+                dict(name="value", number=2, type=12, label=1),
+            ],
+        )],
+    ))
+
+    def msg(name, *fields):
+        return dict(name=name, field=[
+            dict(name=n, number=num, type=t, label=lab,
+                 **({"type_name": tn} if tn else {}))
+            for (n, num, t, lab, tn) in fields
+        ])
+
+    # type codes: 4=uint64, 9=string, 11=message, 12=bytes, 13=uint32, 14=enum
+    # labels: 1=optional, 3=repeated
+    pool.Add(descriptor_pb2.FileDescriptorProto(
+        name="cosmos.proto", package="cosmos",
+        syntax="proto3",
+        dependency=["google/protobuf/any.proto"],
+        enum_type=[dict(
+            name="SignMode",
+            value=[dict(name="SIGN_MODE_UNSPECIFIED", number=0),
+                   dict(name="SIGN_MODE_DIRECT", number=1)],
+        )],
+        message_type=[
+            msg("Coin",
+                ("denom", 1, 9, 1, None),
+                ("amount", 2, 9, 1, None)),
+            msg("PubKey",
+                ("key", 1, 12, 1, None)),
+            msg("Fee",
+                ("amount", 1, 11, 3, ".cosmos.Coin"),
+                ("gas_limit", 2, 4, 1, None),
+                ("payer", 3, 9, 1, None),
+                ("granter", 4, 9, 1, None)),
+            dict(name="ModeInfo",
+                 field=[dict(name="single", number=1, type=11, label=1,
+                             type_name=".cosmos.ModeInfo.Single")],
+                 nested_type=[msg("Single",
+                                  ("mode", 1, 14, 1, ".cosmos.SignMode"))]),
+            msg("SignerInfo",
+                ("public_key", 1, 11, 1, ".google.protobuf.Any"),
+                ("mode_info", 2, 11, 1, ".cosmos.ModeInfo"),
+                ("sequence", 3, 4, 1, None)),
+            msg("AuthInfo",
+                ("signer_infos", 1, 11, 3, ".cosmos.SignerInfo"),
+                ("fee", 2, 11, 1, ".cosmos.Fee")),
+            msg("TxBody",
+                ("messages", 1, 11, 3, ".google.protobuf.Any"),
+                ("memo", 2, 9, 1, None),
+                ("timeout_height", 3, 4, 1, None)),
+            msg("TxRaw",
+                ("body_bytes", 1, 12, 1, None),
+                ("auth_info_bytes", 2, 12, 1, None),
+                ("signatures", 3, 12, 3, None)),
+            msg("SignDoc",
+                ("body_bytes", 1, 12, 1, None),
+                ("auth_info_bytes", 2, 12, 1, None),
+                ("chain_id", 3, 9, 1, None),
+                ("account_number", 4, 4, 1, None)),
+            msg("MsgPayForBlobs",
+                ("signer", 1, 9, 1, None),
+                ("namespaces", 2, 12, 3, None),
+                ("blob_sizes", 3, 13, 3, None),
+                ("share_commitments", 4, 12, 3, None),
+                ("share_versions", 8, 13, 3, None)),
+            msg("Blob",
+                ("namespace_id", 1, 12, 1, None),
+                ("data", 2, 12, 1, None),
+                ("share_version", 3, 13, 1, None),
+                ("namespace_version", 4, 13, 1, None)),
+            msg("BlobTx",
+                ("tx", 1, 12, 1, None),
+                ("blobs", 2, 11, 3, ".cosmos.Blob"),
+                ("type_id", 3, 9, 1, None)),
+        ],
+    ))
+    return pool
+
+
+@pytest.fixture(scope="module")
+def types():
+    pool = _build_pool()
+    names = ["Coin", "PubKey", "Fee", "ModeInfo", "SignerInfo", "AuthInfo",
+             "TxBody", "TxRaw", "SignDoc", "MsgPayForBlobs", "Blob", "BlobTx"]
+    out = {
+        n: message_factory.GetMessageClass(
+            pool.FindMessageTypeByName(f"cosmos.{n}")
+        )
+        for n in names
+    }
+    out["Any"] = message_factory.GetMessageClass(
+        pool.FindMessageTypeByName("google.protobuf.Any")
+    )
+    return out
+
+
+def ser(m) -> bytes:
+    return m.SerializeToString(deterministic=True)
+
+
+NS = ns_pkg.new_v0(b"wireparity")
+COMMIT = b"\x5c" * 32
+
+
+def _pfb() -> MsgPayForBlobs:
+    return MsgPayForBlobs(
+        signer=ALICE.bech32_address(),
+        namespaces=[NS.bytes],
+        blob_sizes=[512, 0, 70000],
+        share_commitments=[COMMIT],
+        share_versions=[0, 1],
+    )
+
+
+def _ref_pfb(types):
+    return types["MsgPayForBlobs"](
+        signer=ALICE.bech32_address(),
+        namespaces=[NS.bytes],
+        blob_sizes=[512, 0, 70000],
+        share_commitments=[COMMIT],
+        share_versions=[0, 1],
+    )
+
+
+class TestMessageParity:
+    def test_fee(self, types):
+        ours = Fee(amount=21_000, gas_limit=123_456, payer="", granter="g")
+        ref = types["Fee"](
+            amount=[types["Coin"](denom="utia", amount="21000")],
+            gas_limit=123_456, granter="g",
+        )
+        assert ours.marshal() == ser(ref)
+
+    def test_fee_zero_amount_omits_coin(self, types):
+        ours = Fee(amount=0, gas_limit=9)
+        assert ours.marshal() == ser(types["Fee"](gas_limit=9))
+
+    def test_signer_info(self, types):
+        pub = ALICE.public_key()
+        ours = SignerInfo(public_key=pub, sequence=42)
+        ref = types["SignerInfo"](
+            public_key=types["Any"](
+                type_url=SECP256K1_PUBKEY_TYPE_URL,
+                value=ser(types["PubKey"](key=pub)),
+            ),
+            mode_info=types["ModeInfo"](
+                single=types["ModeInfo"].Single(mode=1)
+            ),
+            sequence=42,
+        )
+        assert ours.marshal() == ser(ref)
+
+    def test_msg_pay_for_blobs_packed_repeated(self, types):
+        assert _pfb().marshal() == ser(_ref_pfb(types))
+
+    def test_msg_pay_for_blobs_roundtrip_accepts_unpacked(self):
+        """A conforming parser accepts the unpacked spelling too."""
+        from celestia_tpu.blob import _field_bytes, _field_uint
+
+        raw = (
+            _field_bytes(1, b"celestia1xyz")
+            + (_field_uint(3, 512) or b"") + b"\x18\x00"  # unpacked, incl. zero
+            + _field_uint(8, 1)
+        )
+        msg = MsgPayForBlobs.unmarshal(raw)
+        assert msg.blob_sizes == [512, 0]
+        assert msg.share_versions == [1]
+
+    def test_blob_and_blob_tx(self, types):
+        blob = blob_pkg.new_blob(NS, b"\xaa" * 100, 0)
+        ref_blob = types["Blob"](
+            namespace_id=NS.id, data=b"\xaa" * 100,
+            share_version=0, namespace_version=0,
+        )
+        assert blob.marshal() == ser(ref_blob)
+
+        tx_bytes = b"\x01\x02\x03"
+        ours = blob_pkg.marshal_blob_tx(tx_bytes, [blob])
+        ref = types["BlobTx"](tx=tx_bytes, blobs=[ref_blob], type_id="BLOB")
+        assert ours == ser(ref)
+
+
+class TestTxParity:
+    def _ref_tx_parts(self, types, pfb_ours, fee_ours, sequence):
+        body = types["TxBody"](
+            messages=[types["Any"](
+                type_url=MsgPayForBlobs.TYPE_URL,
+                value=pfb_ours.marshal(),
+            )],
+            memo="m",
+        )
+        auth = types["AuthInfo"](
+            signer_infos=[types["SignerInfo"](
+                public_key=types["Any"](
+                    type_url=SECP256K1_PUBKEY_TYPE_URL,
+                    value=ser(types["PubKey"](key=ALICE.public_key())),
+                ),
+                mode_info=types["ModeInfo"](
+                    single=types["ModeInfo"].Single(mode=1)
+                ),
+                sequence=sequence,
+            )],
+            fee=types["Fee"](
+                amount=[types["Coin"](denom="utia",
+                                      amount=str(fee_ours.amount))],
+                gas_limit=fee_ours.gas_limit,
+            ),
+        )
+        return ser(body), ser(auth)
+
+    def test_sign_doc_and_tx_raw(self, types):
+        """End to end: the Signer-built tx's body/auth/SignDoc/TxRaw all
+        match the reference encodings, and the signature verifies over
+        the reference-encoded SignDoc."""
+        from celestia_tpu.crypto import verify_signature
+
+        fee = Fee(amount=2_000, gas_limit=80_000)
+        tx = sign_tx(ALICE, [_pfb()], "wire-chain", account_number=7,
+                     sequence=3, fee=fee, memo="m")
+        ref_body, ref_auth = self._ref_tx_parts(types, _pfb(), fee, 3)
+        assert tx.body_bytes() == ref_body
+        assert tx.auth_info_bytes() == ref_auth
+
+        ref_doc = ser(types["SignDoc"](
+            body_bytes=ref_body, auth_info_bytes=ref_auth,
+            chain_id="wire-chain", account_number=7,
+        ))
+        assert sign_doc_bytes(ref_body, ref_auth, "wire-chain", 7) == ref_doc
+        assert verify_signature(ALICE.public_key(), ref_doc, tx.signatures[0])
+
+        ref_raw = ser(types["TxRaw"](
+            body_bytes=ref_body, auth_info_bytes=ref_auth,
+            signatures=[tx.signatures[0]],
+        ))
+        assert tx.marshal() == ref_raw
+
+    def test_round_trip_through_decoder(self):
+        fee = Fee(amount=2_000, gas_limit=80_000, granter="granter-addr")
+        tx = sign_tx(ALICE, [_pfb()], "wire-chain", account_number=7,
+                     sequence=3, fee=fee, memo="m")
+        decoded = Tx.unmarshal(tx.marshal())
+        assert decoded.fee == fee
+        assert decoded.signer_infos[0].public_key == ALICE.public_key()
+        assert decoded.signer_infos[0].sequence == 3
+        assert decoded.memo == "m"
+        assert decoded.msgs[0].blob_sizes == [512, 0, 70000]
+        assert decoded.marshal() == tx.marshal()
+
+    def test_multi_coin_fee_rejected(self, types):
+        ref = types["Fee"](
+            amount=[types["Coin"](denom="utia", amount="1"),
+                    types["Coin"](denom="uatom", amount="2")],
+            gas_limit=1,
+        )
+        with pytest.raises(ValueError, match="multi-coin"):
+            Fee.unmarshal(ser(ref))
+
+    def test_non_direct_sign_mode_rejected(self, types):
+        ref = types["SignerInfo"](
+            public_key=types["Any"](
+                type_url=SECP256K1_PUBKEY_TYPE_URL,
+                value=ser(types["PubKey"](key=ALICE.public_key())),
+            ),
+            mode_info=types["ModeInfo"](
+                single=types["ModeInfo"].Single(mode=0)
+            ),
+            sequence=1,
+        )
+        with pytest.raises(ValueError, match="unsupported sign mode"):
+            SignerInfo.unmarshal(ser(ref))
+
+    def test_foreign_pubkey_type_rejected(self, types):
+        ref = types["SignerInfo"](
+            public_key=types["Any"](
+                type_url="/cosmos.crypto.ed25519.PubKey",
+                value=ser(types["PubKey"](key=b"\x00" * 32)),
+            ),
+            sequence=1,
+        )
+        with pytest.raises(ValueError, match="unsupported signer pubkey"):
+            SignerInfo.unmarshal(ser(ref))
