@@ -119,8 +119,20 @@ def cmd_start(args):
     )
     server = RpcServer(node, port=args.port)
     server.start()
+    # the reference node serves gRPC alongside RPC (app/app.go:693-719);
+    # enabled via app.toml grpc_enable or the --grpc-port flag
+    grpc_server = None
+    grpc_note = ""
+    if cfg.app.grpc_enable or getattr(args, "grpc_port", None) is not None:
+        from celestia_tpu.node.grpc_api import NodeGrpcServer
+
+        grpc_server = NodeGrpcServer(
+            node, port=getattr(args, "grpc_port", None) or 0
+        )
+        grpc_server.start()
+        grpc_note = f"grpc 127.0.0.1:{grpc_server.port} "
     print(f"node started: chain {node.app.chain_id} height {node.latest_height()} "
-          f"rpc http://127.0.0.1:{server.port} "
+          f"rpc http://127.0.0.1:{server.port} {grpc_note}"
           f"min-gas-price {cfg.app.min_gas_price} "
           f"extend-backend {cfg.app.extend_backend} (live: {live})")
     # an initial snapshot so a hard crash before the first interval never
@@ -141,6 +153,8 @@ def cmd_start(args):
                   f"square {block.square_size} data {block.data_hash.hex()[:16]}")
     except KeyboardInterrupt:
         server.stop()
+        if grpc_server is not None:
+            grpc_server.stop()
         node.save_snapshot()
         print("node stopped")
 
@@ -161,6 +175,123 @@ def cmd_export(args):
         print(f"exported genesis (height {genesis['height']}) to {args.output}")
     else:
         print(text)
+
+
+def cmd_download_genesis(args):
+    """Fetch a chain's genesis from a live node and install it in the
+    home directory (ref: cmd/celestia-appd/cmd/download-genesis.go,
+    which fetches by chain id from a public URL; here the source is any
+    node's /genesis RPC route)."""
+    import urllib.request
+
+    home = _home(args)
+    with urllib.request.urlopen(
+        args.node.rstrip("/") + "/genesis", timeout=15
+    ) as resp:
+        genesis = json.loads(resp.read())
+    if args.chain_id and genesis.get("chain_id") != args.chain_id:
+        print(
+            f"refusing: node serves chain {genesis.get('chain_id')!r}, "
+            f"expected {args.chain_id!r}",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    target = home / "genesis.json"
+    if target.exists() and not args.force:
+        print(f"{target} already exists (use --force to overwrite)",
+              file=sys.stderr)
+        sys.exit(1)
+    target.write_text(json.dumps(genesis, indent=2, sort_keys=True))
+    print(f"wrote genesis for chain {genesis.get('chain_id')} to {target}")
+
+
+def cmd_addrbook(args):
+    """Manage the peer address book (ref: cmd/celestia-appd/cmd/
+    addrbook.go converts peer lists into the node's addrbook.json)."""
+    home = _home(args)
+    path = home / "addrbook.json"
+    book = json.loads(path.read_text()) if path.exists() else {"peers": []}
+    if args.book_cmd in ("add", "remove") and not args.peer:
+        print(f"addrbook {args.book_cmd} needs a peer URL", file=sys.stderr)
+        sys.exit(1)
+    if args.book_cmd == "add":
+        if args.peer in book["peers"]:
+            print(f"{args.peer} already in addrbook")
+        else:
+            book["peers"].append(args.peer)
+            path.write_text(json.dumps(book, indent=2))
+            print(f"added {args.peer} ({len(book['peers'])} peers)")
+    elif args.book_cmd == "remove":
+        if args.peer not in book["peers"]:
+            print(f"{args.peer} not in addrbook", file=sys.stderr)
+            sys.exit(1)
+        book["peers"].remove(args.peer)
+        path.write_text(json.dumps(book, indent=2))
+        print(f"removed {args.peer} ({len(book['peers'])} peers)")
+    else:  # list
+        for peer in book["peers"]:
+            print(peer)
+
+
+def cmd_rollback(args):
+    """Roll the chain back one block (the CometBFT `rollback` analogue:
+    recover from an app-hash mismatch by re-executing the last height).
+    Works by deleting the newest persisted block and replaying from the
+    last snapshot — so the snapshot must be at or below the target
+    height."""
+    home = _home(args)
+    blocks_dir = home / "blocks"
+    heights = sorted(
+        int(p.stem) for p in blocks_dir.glob("*.json")
+    ) if blocks_dir.exists() else []
+    if not heights:
+        print("no persisted blocks to roll back", file=sys.stderr)
+        sys.exit(1)
+    latest = heights[-1]
+    if not (home / "meta.json").exists():
+        # the blocks-without-meta crash state _build_node refuses to
+        # re-init from — rollback can't help without a snapshot either
+        print("no state snapshot (meta.json); cannot roll back — restore "
+              "meta.json/state.json or clear blocks/", file=sys.stderr)
+        sys.exit(1)
+    meta = json.loads((home / "meta.json").read_text())
+    if meta["height"] >= latest:
+        print(
+            f"snapshot is at height {meta['height']} >= latest block "
+            f"{latest}: cannot roll back past the last snapshot (no "
+            "older snapshot retained)",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    (blocks_dir / f"{latest}.json").unlink()
+    # prove the store still replays cleanly to the new head
+    node = _build_node(home)
+    node.save_snapshot()
+    print(f"rolled back block {latest}; chain head is now "
+          f"{node.app.height} (app hash "
+          f"{node.app.store.app_hashes[node.app.store.version].hex()[:16]}…)")
+
+
+def cmd_compact(args):
+    """Prune persisted blocks no longer needed for crash recovery
+    (the store-compaction analogue): recovery replays from the last
+    snapshot, so blocks strictly below the snapshot height are dead
+    weight. `--keep-recent` retains extra history for serving peers."""
+    home = _home(args)
+    meta_path = home / "meta.json"
+    if not meta_path.exists():
+        print("no snapshot; refusing to prune (recovery would need "
+              "every block)", file=sys.stderr)
+        sys.exit(1)
+    snapshot_height = json.loads(meta_path.read_text())["height"]
+    floor = max(0, snapshot_height - args.keep_recent)
+    removed = 0
+    for path in sorted((home / "blocks").glob("*.json")):
+        if int(path.stem) < floor:
+            path.unlink()
+            removed += 1
+    print(f"pruned {removed} blocks below height {floor} "
+          f"(snapshot at {snapshot_height}, keep-recent {args.keep_recent})")
 
 
 def cmd_keys(args):
@@ -251,6 +382,10 @@ def main(argv=None):
     p_start = sub.add_parser("start")
     # None = "flag not passed" so config-file/env values aren't masked
     p_start.add_argument("--block-time", type=float, default=None)
+    p_start.add_argument("--grpc-port", type=int, default=None,
+                         help="also serve the gRPC API on this port "
+                              "(0 = ephemeral; default: only when "
+                              "app.toml grpc_enable)")
     p_start.add_argument("--extend-backend", default=None,
                          choices=["auto", "tpu", "native", "numpy"],
                          help="ExtendBlock backend (default: config "
@@ -284,6 +419,21 @@ def main(argv=None):
     p_query = sub.add_parser("query")
     p_query.add_argument("path")
 
+    p_dl = sub.add_parser("download-genesis")
+    p_dl.add_argument("--node", required=True,
+                      help="RPC base URL of a live node to fetch from")
+    p_dl.add_argument("--force", action="store_true")
+
+    p_book = sub.add_parser("addrbook")
+    p_book.add_argument("book_cmd", choices=["add", "remove", "list"])
+    p_book.add_argument("peer", nargs="?", default=None)
+
+    sub.add_parser("rollback")
+
+    p_compact = sub.add_parser("compact")
+    p_compact.add_argument("--keep-recent", type=int, default=100,
+                           help="blocks to retain below the snapshot height")
+
     args = parser.parse_args(argv)
     {
         "init": cmd_init,
@@ -292,6 +442,10 @@ def main(argv=None):
         "keys": cmd_keys,
         "tx": cmd_tx,
         "query": cmd_query,
+        "download-genesis": cmd_download_genesis,
+        "addrbook": cmd_addrbook,
+        "rollback": cmd_rollback,
+        "compact": cmd_compact,
     }[args.cmd](args)
 
 

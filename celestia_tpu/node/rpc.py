@@ -85,6 +85,16 @@ def _handler_for(node: Node):
                             "extend_backend_live": node.app._active_backend,
                         }
                     )
+                elif parts == ["genesis"]:
+                    # the download-genesis source (ref: cmd/celestia-appd/
+                    # cmd/download-genesis.go fetches a chain's genesis;
+                    # here any node serves the one it started from)
+                    if node.home and (node.home / "genesis.json").exists():
+                        self._reply(
+                            json.loads((node.home / "genesis.json").read_text())
+                        )
+                    else:
+                        self._reply({"error": "node has no genesis file"}, 404)
                 elif len(parts) == 2 and parts[0] == "block":
                     block = node.get_block(int(parts[1]))
                     if block is None:

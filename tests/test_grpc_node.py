@@ -1,0 +1,129 @@
+"""Node gRPC API (VERDICT r2 item 7; ref: app/app.go:693-719 serves the
+SDK gRPC services from the node, pkg/user/signer.go:287 dials them).
+
+The gRPC twin of tests/test_node.py::TestRpcClient: the full Signer
+stack (tx options, nonce recovery) over a real gRPC channel, plus the
+cosmos.tx.v1beta1.Service surface and verifiable state proofs.
+"""
+
+import pytest
+
+from celestia_tpu import blob as blob_pkg
+from celestia_tpu import namespace as ns
+from celestia_tpu.app import App
+from celestia_tpu.crypto import PrivateKey
+from celestia_tpu.node import Node
+from celestia_tpu.node.grpc_api import GrpcClient, NodeGrpcServer
+from celestia_tpu.node.node import tx_hash
+from celestia_tpu.state import StateStore
+from celestia_tpu.user import Signer
+
+ALICE = PrivateKey.from_secret(b"alice")
+VALIDATOR = PrivateKey.from_secret(b"validator")
+
+
+def new_node() -> Node:
+    app = App()
+    app.init_chain(
+        {
+            VALIDATOR.bech32_address(): 1_000_000_000_000,
+            ALICE.bech32_address(): 50_000_000_000,
+        },
+        genesis_time=0.0,
+    )
+    node = Node(app)
+    node.produce_block(15.0)
+    return node
+
+
+@pytest.fixture()
+def served():
+    node = new_node()
+    server = NodeGrpcServer(node, port=0)
+    server.start()
+    client = GrpcClient(f"127.0.0.1:{server.port}")
+    yield node, client
+    client.close()
+    server.stop()
+
+
+class TestGrpcClient:
+    def test_signer_over_grpc(self, served):
+        node, client = served
+        assert client.status()["chain_id"] == node.app.chain_id
+        signer = Signer.setup_single(ALICE, client)
+        b = blob_pkg.new_blob(ns.new_v0(b"grpc"), b"\x21" * 400, 0)
+        res = signer.submit_pay_for_blob([b])
+        assert res.code == 0, res.log
+        node.produce_block(30.0)
+        found = client.get_tx(tx_hash(res.raw))
+        assert found is not None and found["result"]["code"] == 0
+        assert client.balance(ALICE.bech32_address()) > 0
+        assert client.params("blob")["gas_per_blob_byte"] == 8
+
+    def test_nonce_recovery_over_grpc(self, served):
+        node, client = served
+        from celestia_tpu.x.bank import MsgSend
+
+        s1 = Signer.setup_single(ALICE, client)
+        s2 = Signer.setup_single(ALICE, client)  # same sequence
+        assert s1.submit_tx(
+            [MsgSend(ALICE.bech32_address(), VALIDATOR.bech32_address(), 5)]
+        ).code == 0
+        res = s2.submit_tx(
+            [MsgSend(ALICE.bech32_address(), VALIDATOR.bech32_address(), 7)]
+        )
+        assert res.code == 0, res.log  # auto re-signed at expected seq
+        block = node.produce_block(30.0)
+        assert [r.code for r in block.tx_results] == [0, 0]
+        assert s2.resync_sequence() == 2
+
+    def test_account_not_found(self, served):
+        _node, client = served
+        ghost = PrivateKey.from_secret(b"ghost").bech32_address()
+        assert client.account(ghost) is None
+
+    def test_cosmos_tx_service_get_tx(self, served):
+        """The reference-shaped cosmos.tx.v1beta1.Service surface."""
+        node, client = served
+        signer = Signer.setup_single(ALICE, client)
+        from celestia_tpu.x.bank import MsgSend
+
+        res = signer.submit_tx(
+            [MsgSend(ALICE.bech32_address(), VALIDATOR.bech32_address(), 9)]
+        )
+        assert res.code == 0
+        node.produce_block(30.0)
+        got = client.cosmos_get_tx(tx_hash(res.raw))
+        assert got["code"] == 0
+        assert got["height"] == node.app.height
+        assert got["tx_bytes"] == res.raw
+
+    def test_rejected_tx_surfaces_checktx_log(self, served):
+        """CheckTx failures come back in the BroadcastTxResponse the way
+        the HTTP route returns them (no transport exception)."""
+        _node, client = served
+        res = client.broadcast_tx(b"\x00garbage")
+        assert res.code != 0
+        assert res.log
+
+    def test_state_proof_verifies(self, served):
+        node, client = served
+        # a key that exists: ALICE's account record
+        acct_key = None
+        for key, _v in node.app.store.iter_prefix(b""):
+            if ALICE.bech32_address().encode() in key:
+                acct_key = key
+                break
+        assert acct_key is not None
+        got = client.state_proof(acct_key)
+        assert got["value"] is not None
+        assert StateStore.verify_proof(
+            got["app_hash"], acct_key, got["value"], got["proof"]
+        )
+        # absence proof for a missing key
+        missing = client.state_proof(b"no/such/key")
+        assert missing["value"] is None
+        assert StateStore.verify_proof(
+            missing["app_hash"], b"no/such/key", None, missing["proof"]
+        )
