@@ -31,6 +31,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
+import threading
 
 from celestia_tpu.crypto import verify_signature
 
@@ -68,31 +69,51 @@ def total_power(valset: list[ConsensusValidator]) -> int:
     return sum(v.power for v in valset)
 
 
+# rotation memo: valset signature -> [advanced_height, prio dict, proposer]
+# (leader loops call proposer_rotation every tick; without the memo the
+# zero-state replay is O(height · n) per call and grows forever). The
+# lock serializes advancement: RPC handler threads and the leader loop
+# share the cached priority dict.
+_ROTATION_CACHE: dict[tuple, list] = {}
+_ROTATION_CACHE_MAX = 8
+_ROTATION_LOCK = threading.Lock()
+
+
 def proposer_rotation(valset: list[ConsensusValidator], height: int) -> str:
     """Tendermint's proposer-priority rotation as a pure function.
 
     Replays the priority algorithm from a zeroed state for `height`
     rounds over the CURRENT valset. Deterministic across replicas (same
     committed valset → same leader) and stake-proportional in the long
-    run. O(height · n); a devnet at height 10⁴ with 10 validators is
-    10⁵ integer ops — irrelevant. Divergence from tendermint: priorities
-    reset when the valset changes (pure function of the present set)
-    instead of carrying over — acceptable because fairness here is
-    per-valset-epoch, not across epochs."""
+    run. Incremental per valset (the replay position is memoized, so a
+    leader tick at height H costs O(n), not O(H · n)). Divergence from
+    tendermint: priorities reset when the valset changes (pure function
+    of the present set) instead of carrying over — acceptable because
+    fairness here is per-valset-epoch, not across epochs."""
     if not valset:
         raise ValueError("empty validator set")
-    prio = {v.operator: 0 for v in valset}
     total = total_power(valset)
     if total <= 0:
         raise ValueError("validator set has no power")
-    proposer = valset[0].operator
-    for _ in range(height + 1):
-        for v in valset:
-            prio[v.operator] += v.power
-        # max priority; ties break on operator address for determinism
-        proposer = max(valset, key=lambda v: (prio[v.operator], v.operator)).operator
-        prio[proposer] -= total
-    return proposer
+    key = tuple((v.operator, v.power) for v in valset)
+    with _ROTATION_LOCK:
+        state = _ROTATION_CACHE.get(key)
+        if state is None or state[0] > height:
+            state = [-1, {v.operator: 0 for v in valset}, valset[0].operator]
+        at, prio, proposer = state[0], state[1], state[2]
+        while at < height:
+            for v in valset:
+                prio[v.operator] += v.power
+            # max priority; ties break on operator address for determinism
+            proposer = max(
+                valset, key=lambda v: (prio[v.operator], v.operator)
+            ).operator
+            prio[proposer] -= total
+            at += 1
+        if len(_ROTATION_CACHE) >= _ROTATION_CACHE_MAX and key not in _ROTATION_CACHE:
+            _ROTATION_CACHE.pop(next(iter(_ROTATION_CACHE)))
+        _ROTATION_CACHE[key] = [at, prio, proposer]
+        return proposer
 
 
 def proposal_hash(

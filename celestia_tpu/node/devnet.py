@@ -102,6 +102,11 @@ class ValidatorNode:
         self._voted: dict[int, tuple[bytes, float]] = {}
         self._vote_lock = threading.Lock()
         self._last_commit = time.monotonic()
+        # cached own proposal per height: a failed round (missing peer
+        # vote) retries the IDENTICAL body next tick — regenerating with
+        # a fresh timestamp would trip everyone's vote-once rule and
+        # stall the height for a full liveness window
+        self._my_proposal: tuple | None = None  # (height, body, ph, proposal)
         self.halted: str | None = None  # set on app-hash divergence
         node.validator = self
 
@@ -213,6 +218,41 @@ class ValidatorNode:
             except Exception as e:  # noqa: BLE001 — a dead peer is fine
                 log.info("gossip skip", peer=peer.base_url, error=str(e))
 
+    # ---- catch-up (crash-fault rejoin, and recovery from a single
+    # missed commit delivery) ----
+
+    def maybe_catch_up(self) -> bool:
+        """When no commit has landed for a liveness window and a peer is
+        ahead, state-sync from it in place. This is what un-strands a
+        validator that missed one commit POST (handle_commit refuses
+        height gaps by design) and what lets a restarted process rejoin.
+        Returns True when a sync happened."""
+        if time.monotonic() - self._last_commit < self.liveness_timeout:
+            return False
+        our_height = self.node.app.height
+        for peer in self.peers:
+            try:
+                status = peer.status()
+                if status.get("height", 0) <= our_height:
+                    continue
+                snap = peer.snapshot()
+                if snap.get("height", 0) <= our_height:
+                    continue  # peer is ahead but its snapshot is not
+                self.node.restore_from_snapshot(snap)
+                with self._vote_lock:
+                    self._voted = {
+                        h: v for h, v in self._voted.items()
+                        if h > self.node.app.height
+                    }
+                self._my_proposal = None
+                self._last_commit = time.monotonic()
+                log.info("caught up from peer", peer=peer.base_url,
+                         height=self.node.app.height)
+                return True
+            except Exception as e:  # noqa: BLE001 — try the next peer
+                log.info("catch-up skip", peer=peer.base_url, error=str(e))
+        return False
+
     # ---- leader drive ----
 
     def _app_hash_hex(self) -> str:
@@ -236,18 +276,23 @@ class ValidatorNode:
         ):
             return None  # the rotation leader is alive — let it drive
 
-        block_time = block_time if block_time is not None else time.time()
-        with self.node._lock:
-            proposal = app.prepare_proposal(self.node.mempool.reap())
-        body = {
-            "height": height,
-            "time": block_time,
-            "proposer": self.operator,
-            "square_size": proposal.square_size,
-            "data_hash": proposal.hash.hex(),
-            "txs": [t.hex() for t in proposal.txs],
-        }
-        ph = self._prop_hash(body)
+        cached = self._my_proposal
+        if cached is not None and cached[0] == height:
+            _h, body, ph, proposal = cached  # retry the identical round
+        else:
+            block_time = block_time if block_time is not None else time.time()
+            with self.node._lock:
+                proposal = app.prepare_proposal(self.node.mempool.reap())
+            body = {
+                "height": height,
+                "time": block_time,
+                "proposer": self.operator,
+                "square_size": proposal.square_size,
+                "data_hash": proposal.hash.hex(),
+                "txs": [t.hex() for t in proposal.txs],
+            }
+            ph = self._prop_hash(body)
+            self._my_proposal = (height, body, ph, proposal)
         valset = self._valset()
 
         with self._vote_lock:
@@ -280,9 +325,11 @@ class ValidatorNode:
         cert = CommitCert(height, ph, votes)
 
         block = self.node.apply_external_block(
-            proposal.txs, proposal.square_size, proposal.hash, block_time,
+            proposal.txs, proposal.square_size, proposal.hash,
+            float(body["time"]),
             expected_height=height,
         )
+        self._my_proposal = None  # round closed
         self._last_commit = time.monotonic()
         commit_body = {**body, "cert": cert.to_json(),
                        "app_hash": block.app_hash.hex()}
@@ -387,6 +434,7 @@ def run_validator(args) -> None:
              operator=validator.operator)
     try:
         while True:
+            validator.maybe_catch_up()
             validator.try_propose()
             time.sleep(args.interval)
     except KeyboardInterrupt:

@@ -311,6 +311,33 @@ class Node:
         app.block_time = meta["block_time"]
         return app
 
+    def restore_from_snapshot(self, payload: dict,
+                              trusted_app_hash: bytes | str | None = None,
+                              **app_kwargs) -> None:
+        """In-place state sync: swap this node's app for one restored
+        from a peer snapshot (same verification as state_sync_from).
+        For a live node catching up — the RPC server and consensus
+        layer keep their references to this Node object."""
+        app = self._restore_app(payload, bytes.fromhex(payload["state"]),
+                                **app_kwargs)
+        computed = app.store.app_hashes[app.store.version]
+        expected = trusted_app_hash if trusted_app_hash is not None \
+            else payload["app_hash"]
+        if isinstance(expected, bytes):
+            expected = expected.hex()
+        if computed.hex() != expected:
+            raise ValueError(
+                "snapshot app hash mismatch: expected "
+                f"{expected}, state restores to {computed.hex()}"
+            )
+        with self._lock:
+            self.app = app
+            if self.home:
+                self.save_snapshot()
+        log.info("state synced in place", height=app.height,
+                 app_hash=computed,
+                 authenticated=trusted_app_hash is not None)
+
     @classmethod
     def state_sync_from(cls, payload: dict, home: str | None = None,
                         trusted_app_hash: bytes | str | None = None,
