@@ -226,19 +226,47 @@ class ValidatorNode:
         ahead, state-sync from it in place. This is what un-strands a
         validator that missed one commit POST (handle_commit refuses
         height gaps by design) and what lets a restarted process rejoin.
-        Returns True when a sync happened."""
+
+        Authentication: the snapshot's app hash is cross-verified
+        against every OTHER ahead peer's stored block at the snapshot
+        height before it is adopted — one lying peer cannot replace our
+        state while any honest ahead peer is reachable. With a single
+        peer the restore trusts it alone (the crash-fault devnet
+        assumption, and the peer count is operator-configured). Returns
+        True when a sync happened."""
+        if self.halted:
+            # a divergence halt preserves the forked local state for
+            # forensics — never paper over it with a peer's state
+            return False
         if time.monotonic() - self._last_commit < self.liveness_timeout:
             return False
         our_height = self.node.app.height
+        ahead = []
         for peer in self.peers:
             try:
-                status = peer.status()
-                if status.get("height", 0) <= our_height:
-                    continue
+                if peer.status().get("height", 0) > our_height:
+                    ahead.append(peer)
+            except Exception:  # noqa: BLE001 — dead peer
+                continue
+        for peer in ahead:
+            try:
                 snap = peer.snapshot()
                 if snap.get("height", 0) <= our_height:
                     continue  # peer is ahead but its snapshot is not
-                self.node.restore_from_snapshot(snap)
+                for other in ahead:
+                    if other is peer:
+                        continue
+                    blk = other.block(snap["height"])
+                    if blk and blk.get("app_hash") != snap["app_hash"]:
+                        log.error(
+                            "catch-up abort: peers disagree on app hash",
+                            height=snap["height"], peer=peer.base_url,
+                            other=other.base_url,
+                        )
+                        return False
+                self.node.restore_from_snapshot(
+                    snap, trusted_app_hash=snap["app_hash"]
+                )
                 with self._vote_lock:
                     self._voted = {
                         h: v for h, v in self._voted.items()
@@ -247,7 +275,8 @@ class ValidatorNode:
                 self._my_proposal = None
                 self._last_commit = time.monotonic()
                 log.info("caught up from peer", peer=peer.base_url,
-                         height=self.node.app.height)
+                         height=self.node.app.height,
+                         corroborated_by=len(ahead) - 1)
                 return True
             except Exception as e:  # noqa: BLE001 — try the next peer
                 log.info("catch-up skip", peer=peer.base_url, error=str(e))
@@ -303,7 +332,12 @@ class ValidatorNode:
             if prior is not None and prior[0] != ph:
                 if time.monotonic() - prior[1] < self.liveness_timeout:
                     return None
-            self._voted[height] = (ph, time.monotonic())
+            if prior is None or prior[0] != ph:
+                # stamp once per proposal, NOT per retry tick: refreshing
+                # the timestamp on every retry would make our own vote
+                # record never age out, permanently refusing a competing
+                # proposal at this height (mutual refusal = liveness halt)
+                self._voted[height] = (ph, time.monotonic())
         votes = [
             make_vote(self.key, self.operator, app.chain_id, height, ph, True)
         ]

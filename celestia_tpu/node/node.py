@@ -311,44 +311,17 @@ class Node:
         app.block_time = meta["block_time"]
         return app
 
-    def restore_from_snapshot(self, payload: dict,
-                              trusted_app_hash: bytes | str | None = None,
-                              **app_kwargs) -> None:
-        """In-place state sync: swap this node's app for one restored
-        from a peer snapshot (same verification as state_sync_from).
-        For a live node catching up — the RPC server and consensus
-        layer keep their references to this Node object."""
-        app = self._restore_app(payload, bytes.fromhex(payload["state"]),
-                                **app_kwargs)
-        computed = app.store.app_hashes[app.store.version]
-        expected = trusted_app_hash if trusted_app_hash is not None \
-            else payload["app_hash"]
-        if isinstance(expected, bytes):
-            expected = expected.hex()
-        if computed.hex() != expected:
-            raise ValueError(
-                "snapshot app hash mismatch: expected "
-                f"{expected}, state restores to {computed.hex()}"
-            )
-        with self._lock:
-            self.app = app
-            if self.home:
-                self.save_snapshot()
-        log.info("state synced in place", height=app.height,
-                 app_hash=computed,
-                 authenticated=trusted_app_hash is not None)
-
     @classmethod
-    def state_sync_from(cls, payload: dict, home: str | None = None,
-                        trusted_app_hash: bytes | str | None = None,
-                        **app_kwargs) -> "Node":
-        """Bootstrap a fresh node from a peer's snapshot payload.
-
-        Pass `trusted_app_hash` (from a source you already trust — a
-        verified header, a checkpoint) to authenticate the snapshot the
-        way real state sync does. Without it, the payload's own app_hash
-        is checked, which only detects transport corruption — a
-        malicious peer controls both fields."""
+    def _verified_restore(cls, payload: dict,
+                          trusted_app_hash: bytes | str | None,
+                          **app_kwargs) -> App:
+        """Restore an App from a snapshot payload and verify its
+        recomputed app hash — the single verification point for both
+        state-sync spellings. Pass `trusted_app_hash` (from a source you
+        already trust — a verified header, a corroborating peer set, a
+        checkpoint) to authenticate; without it the payload's own
+        app_hash is checked, which only detects transport corruption (a
+        malicious peer controls both fields)."""
         app = cls._restore_app(payload, bytes.fromhex(payload["state"]),
                                **app_kwargs)
         computed = app.store.app_hashes[app.store.version]
@@ -361,7 +334,35 @@ class Node:
                 "snapshot app hash mismatch: expected "
                 f"{expected}, state restores to {computed.hex()}"
             )
-        log.info("state synced", height=app.height, app_hash=computed,
+        return app
+
+    def restore_from_snapshot(self, payload: dict,
+                              trusted_app_hash: bytes | str | None = None,
+                              **app_kwargs) -> None:
+        """In-place state sync: swap this node's app for one restored
+        from a peer snapshot (same verification as state_sync_from).
+        For a live node catching up — the RPC server and consensus
+        layer keep their references to this Node object."""
+        app = self._verified_restore(payload, trusted_app_hash, **app_kwargs)
+        with self._lock:
+            self.app = app
+            if self.home:
+                self.save_snapshot()
+        log.info("state synced in place", height=app.height,
+                 app_hash=app.store.app_hashes[app.store.version],
+                 authenticated=trusted_app_hash is not None)
+
+    @classmethod
+    def state_sync_from(cls, payload: dict, home: str | None = None,
+                        trusted_app_hash: bytes | str | None = None,
+                        **app_kwargs) -> "Node":
+        """Bootstrap a fresh node from a peer's snapshot payload.
+
+        Verification semantics live in `_verified_restore` (shared with
+        the in-place `restore_from_snapshot`)."""
+        app = cls._verified_restore(payload, trusted_app_hash, **app_kwargs)
+        log.info("state synced", height=app.height,
+                 app_hash=app.store.app_hashes[app.store.version],
                  authenticated=trusted_app_hash is not None)
         return cls(app, home=home)
 

@@ -55,8 +55,6 @@ def initialize(coordinator: str, num_processes: int, process_id: int,
 
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    else:
-        import jax  # noqa: F401 — platform resolved by the environment
 
     import jax
 
