@@ -324,6 +324,48 @@ def bench_batched_throughput(k: int, batch: int = 8):
     }
 
 
+def bench_square_construct(tx_count: int, blob_size: int):
+    """The reference's own square-construction benchmark shape
+    (pkg/square/square_benchmark_test.go:16-56: Build over txCount PFB
+    txs of blobSize bytes). Host-only in both builds — square packing
+    is orchestration, not codec work — recorded so the harness parity
+    with the reference's bench surface is complete."""
+    from celestia_tpu import blob as blob_pkg
+    from celestia_tpu import namespace as ns
+    from celestia_tpu import square as square_pkg
+    from celestia_tpu.appconsts import square_size_upper_bound
+    from celestia_tpu.crypto import PrivateKey
+    from celestia_tpu.tx import Fee, sign_tx
+    from celestia_tpu.x.blob.types import estimate_gas, new_msg_pay_for_blobs
+
+    key = PrivateKey.from_secret(b"bench-square")
+    signer_addr = key.bech32_address()
+    txs = []
+    for i in range(tx_count):
+        b = blob_pkg.new_blob(
+            ns.new_v0(b"bench" + i.to_bytes(5, "big")), bytes([i & 0xFF]) * blob_size, 0
+        )
+        msg = new_msg_pay_for_blobs(signer_addr, b)
+        gas = estimate_gas([blob_size])
+        tx = sign_tx(key, [msg], "bench", 0, i, Fee(amount=gas, gas_limit=gas))
+        txs.append(blob_pkg.marshal_blob_tx(tx.marshal(), [b]))
+
+    best = float("inf")
+    kept = 0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        square, kept_txs = square_pkg.build(txs, 1, square_size_upper_bound(1))
+        best = min(best, time.perf_counter() - t0)
+        kept = len(kept_txs)
+    return {
+        "tx_count": tx_count,
+        "blob_size": blob_size,
+        "build_ms": round(best * 1e3, 3),
+        "txs_kept": kept,
+        "square_size": square_pkg.square_size(len(square)),
+    }
+
+
 def bench_node_path(k: int):
     """Node-path ExtendBlock: the same square -> EDS -> DAH hot path, but
     through App._extend_and_hash (the code `cli start` actually runs:
@@ -442,6 +484,10 @@ def main():
     configs[f"7b_batched_throughput_k{headline_k}"] = \
         bench_batched_throughput(headline_k)
     configs[f"8_node_path_k{headline_k}"] = bench_node_path(headline_k)
+    configs["9_square_construct"] = {
+        f"tx{n}_blob{s}": bench_square_construct(n, s)
+        for n, s in ((10, 10_000), (100, 1_000), (1_000, 100))
+    }
 
     for name, cfg in configs.items():
         if "parity" in cfg:

@@ -165,7 +165,10 @@ class ValidatorNode:
                 self.key, self.operator, self.node.app.chain_id, height, ph,
                 accept,
             )
-            if accept:
+            if accept and (prior is None or prior[0] != ph):
+                # stamp once per proposal, not per retry delivery — a
+                # proposer re-POSTing its cached round must not keep our
+                # vote record eternally fresh (see try_propose)
                 self._voted[height] = (ph, time.monotonic())
         return {"vote": vote.to_json()}
 
@@ -227,13 +230,14 @@ class ValidatorNode:
         validator that missed one commit POST (handle_commit refuses
         height gaps by design) and what lets a restarted process rejoin.
 
-        Authentication: the snapshot's app hash is cross-verified
-        against every OTHER ahead peer's stored block at the snapshot
-        height before it is adopted — one lying peer cannot replace our
-        state while any honest ahead peer is reachable. With a single
-        peer the restore trusts it alone (the crash-fault devnet
-        assumption, and the peer count is operator-configured). Returns
-        True when a sync happened."""
+        Authentication: the snapshot's app hash must be corroborated by
+        at least one OTHER ahead peer's stored block at the snapshot
+        height whenever other ahead peers exist (a liar can always
+        advertise the highest height, so "no one can check it" refuses
+        rather than trusts); any explicit hash disagreement aborts. With
+        a single configured peer the restore trusts it alone — the
+        crash-fault devnet assumption, logged as authenticated=False.
+        Returns True when a sync happened."""
         if self.halted:
             # a divergence halt preserves the forked local state for
             # forensics — never paper over it with a peer's state
@@ -253,19 +257,37 @@ class ValidatorNode:
                 snap = peer.snapshot()
                 if snap.get("height", 0) <= our_height:
                     continue  # peer is ahead but its snapshot is not
-                for other in ahead:
-                    if other is peer:
-                        continue
+                others = [q for q in ahead if q is not peer]
+                corroborations = 0
+                for other in others:
                     blk = other.block(snap["height"])
-                    if blk and blk.get("app_hash") != snap["app_hash"]:
+                    if blk is None:
+                        continue  # peer lacks that block (state-synced)
+                    if blk.get("app_hash") != snap["app_hash"]:
                         log.error(
                             "catch-up abort: peers disagree on app hash",
                             height=snap["height"], peer=peer.base_url,
                             other=other.base_url,
                         )
                         return False
+                    corroborations += 1
+                if others and corroborations == 0:
+                    # a liar can always ADVERTISE the highest height; it
+                    # must not win by default just because no honest peer
+                    # holds its fabricated block. Require at least one
+                    # real corroboration whenever other ahead peers
+                    # exist; maybe another candidate's snapshot (at a
+                    # height others do hold) verifies instead.
+                    log.info(
+                        "catch-up skip: snapshot uncorroborated",
+                        peer=peer.base_url, height=snap["height"],
+                    )
+                    continue
                 self.node.restore_from_snapshot(
-                    snap, trusted_app_hash=snap["app_hash"]
+                    snap,
+                    trusted_app_hash=(
+                        snap["app_hash"] if corroborations else None
+                    ),
                 )
                 with self._vote_lock:
                     self._voted = {
@@ -276,7 +298,7 @@ class ValidatorNode:
                 self._last_commit = time.monotonic()
                 log.info("caught up from peer", peer=peer.base_url,
                          height=self.node.app.height,
-                         corroborated_by=len(ahead) - 1)
+                         corroborated_by=corroborations)
                 return True
             except Exception as e:  # noqa: BLE001 — try the next peer
                 log.info("catch-up skip", peer=peer.base_url, error=str(e))
