@@ -232,7 +232,7 @@ def _spawn(genesis_path, index, ports, home, interval=0.3,
     )
 
 
-def _wait_status(client, timeout=60.0):
+def _wait_status(client, timeout=120.0):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         try:
@@ -242,7 +242,7 @@ def _wait_status(client, timeout=60.0):
     raise TimeoutError(f"node at {client.base_url} never came up")
 
 
-def _wait_height(client, height, timeout=60.0):
+def _wait_height(client, height, timeout=120.0):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         try:
@@ -290,7 +290,7 @@ class TestMultiProcessDevnet:
 
             res = signer.submit_tx([MsgSend(signer.address(), bob, 12_345)])
             assert res.code == 0, res.log
-            deadline = time.monotonic() + 60
+            deadline = time.monotonic() + 120
             while time.monotonic() < deadline:
                 if all((c.balance(bob) or 0) == 12_345 for c in clients):
                     break
