@@ -100,3 +100,40 @@ class RpcClient:
 
     def snapshot(self) -> dict:
         return self._get("/snapshot")
+
+    # --- IBC relayer surface (light-client mode, specs/ibc.md) ---
+
+    def state_proof(self, key: bytes) -> dict:
+        """(value|None, app_hash, smt.Proof, height) verifiable with
+        StateStore.verify_proof — the commitment-proof source for a
+        remote relayer."""
+        from celestia_tpu import smt as smt_mod
+
+        res = self._get(f"/proof/state/{key.hex()}")
+        return {
+            "value": bytes.fromhex(res["value"]) if res["value"] else None,
+            "app_hash": bytes.fromhex(res["app_hash"]),
+            "height": res["height"],
+            "proof": smt_mod.Proof.unmarshal(res["proof"]),
+        }
+
+    def ibc_header(self):
+        """Unsigned light-client header for the chain's latest state
+        (decoded through Header.from_json — one schema, no drift)."""
+        from celestia_tpu.x.lightclient import Header
+
+        return Header.from_json(self._get("/ibc/header"))
+
+    def ibc_pending_packets(self, port_id: str, channel_id: str) -> list:
+        from celestia_tpu.x.ibc import Packet
+
+        res = self._get(f"/ibc/packets/{port_id}/{channel_id}")
+        return [Packet.from_json(p) for p in res["packets"]]
+
+    def ibc_ack(self, port_id: str, channel_id: str, seq: int):
+        from celestia_tpu.x.ibc import Acknowledgement
+
+        res = self._get(f"/ibc/ack/{port_id}/{channel_id}/{seq}")
+        if res is None:
+            return None
+        return Acknowledgement.unmarshal(json.dumps(res["ack"]).encode())

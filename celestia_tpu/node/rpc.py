@@ -131,6 +131,44 @@ def _handler_for(node: Node):
                     self._reply(
                         {"balance": node.app.bank.get_balance(parts[1], parts[2])}
                     )
+                elif parts == ["ibc", "header"]:
+                    # unsigned light-client header material for the
+                    # latest committed state — what a relayer has the
+                    # chain's validators sign for MsgUpdateClient.
+                    # Serialized THROUGH Header.to_json so the wire can
+                    # never drift from the sign-bytes schema.
+                    from celestia_tpu.node.consensus import consensus_valset
+                    from celestia_tpu.x.lightclient import (
+                        Header,
+                        ValidatorInfo,
+                    )
+
+                    app = node.app
+                    block = node.get_block(app.height)
+                    header = Header(
+                        chain_id=app.chain_id,
+                        height=app.height,
+                        time=block.time if block else 0.0,
+                        app_hash=app.store.app_hashes[app.store.version],
+                        validators=[
+                            ValidatorInfo(v.pubkey, v.power)
+                            for v in consensus_valset(app.staking)
+                        ],
+                    )
+                    self._reply(header.to_json())
+                elif len(parts) == 4 and parts[:2] == ["ibc", "packets"]:
+                    # /ibc/packets/<port>/<channel> — the relayer work
+                    # queue (commitments not yet acknowledged)
+                    packets = node.app.ibc.pending_packets(parts[2], parts[3])
+                    self._reply({"packets": [p.to_json() for p in packets]})
+                elif len(parts) == 5 and parts[:2] == ["ibc", "ack"]:
+                    ack = node.app.ibc.get_acknowledgement(
+                        parts[2], parts[3], int(parts[4])
+                    )
+                    if ack is None:
+                        self._reply({"error": "no acknowledgement"}, 404)
+                    else:
+                        self._reply({"ack": json.loads(ack.marshal())})
                 elif len(parts) == 3 and parts[0] == "proof" and parts[1] == "state":
                     # /proof/state/<hex-key> — SMT inclusion/absence proof
                     # against the committed app hash (IAVL store-proof

@@ -525,3 +525,69 @@ class TestLightClientE2E:
         before = node_a.app.bank.get_balance(alice)
         relayer.relay(50.0, 50.0)
         assert node_a.app.bank.get_balance(alice) == before + 1_000
+
+
+class TestRemoteRelayer:
+    """The relayer as a real out-of-process actor: everything it needs
+    (pending packets, acks, header material, commitment proofs, tx
+    submission) crosses the public HTTP API — no in-process store
+    access anywhere in the relay path."""
+
+    def test_voucher_round_trip_fully_remote(self):
+        from celestia_tpu.node.client import RpcClient
+        from celestia_tpu.node.rpc import RpcServer
+        from celestia_tpu.testutil.ibc import RemoteLightClientRelayer
+
+        node_a = new_chain("chain-a", [VAL_A1, VAL_A2])
+        node_b = new_chain("chain-b", [VAL_B1, VAL_B2, VAL_B3])
+        open_client_channel(node_a, node_b)
+        alice, bob = ALICE.bech32_address(), BOB.bech32_address()
+        esc = escrow_address("transfer", "channel-0")
+        node_a.app.bank.mint(esc, 7_000, "utia")
+        node_b.app.bank.mint(bob, 7_000, "transfer/channel-0/utia")
+        node_a.app.store.commit_hash_refresh()
+        node_b.app.store.commit_hash_refresh()
+
+        srv_a = RpcServer(node_a, port=0)
+        srv_b = RpcServer(node_b, port=0)
+        srv_a.start()
+        srv_b.start()
+        try:
+            client_a = RpcClient(f"http://127.0.0.1:{srv_a.port}")
+            client_b = RpcClient(f"http://127.0.0.1:{srv_b.port}")
+
+            b_signer = Signer.setup_single(BOB, client_b)
+            res = b_signer.submit_tx(
+                [MsgTransfer("transfer", "channel-0",
+                             "transfer/channel-0/utia", 7_000, bob, alice)]
+            )
+            assert res.code == 0, res.log
+            node_b.produce_block(30.0)
+
+            times = {"a": 40.0, "b": 40.0}
+
+            def produce_a():
+                times["a"] += 5.0
+                node_a.produce_block(times["a"])
+
+            def produce_b():
+                times["b"] += 5.0
+                node_b.produce_block(times["b"])
+
+            relayer = RemoteLightClientRelayer(
+                client_a, client_b, RELAYER_A, RELAYER_B,
+                [VAL_A1, VAL_A2], [VAL_B1, VAL_B2, VAL_B3],
+            )
+            before = client_a.balance(alice)
+            delivered = relayer.relay(produce_a, produce_b)
+            assert delivered == 1
+            assert client_a.balance(alice) == before + 7_000
+            # the module escrow address contains '/' (not URL-safe for
+            # the balance route) — assert it directly; the relay path
+            # itself never touched the nodes in-process
+            assert node_a.app.bank.get_balance(esc) == 0
+            # commitment cleared on B (queried remotely too)
+            assert client_b.ibc_pending_packets("transfer", "channel-0") == []
+        finally:
+            srv_a.stop()
+            srv_b.stop()
