@@ -288,9 +288,11 @@ def bench_batched_throughput(k: int, batch: int = 8):
     """Supplementary: multi-square throughput (state sync / replay / many
     proposals), vmapped batch on one chip. The HEADLINE stays the
     unbatched single-call number. Measured honestly both ways: batching
-    amortizes dispatch for small squares (k=32: ~0.65 vs 0.76 ms/square)
-    but HURTS at k=128 (batch x 32 MB EDS working set pressures HBM:
-    ~7.7 vs ~5 ms/square) — the per-block path is already the fast one."""
+    amortizes dispatch for small squares (k=32: ~0.74 vs ~1.0 ms/square)
+    but HURTS at k=128 even roots-only (~7.6 vs ~5.0 ms/square — the
+    vmapped working set pressures HBM), so the node's replay verifier
+    batches only at k <= 64 and runs large squares as sequential jitted
+    single dispatches (node.py _batch_verify_data_availability)."""
     import jax
     import jax.numpy as jnp
 
@@ -317,8 +319,19 @@ def bench_batched_throughput(k: int, batch: int = 8):
     per_batch_ms = _slope(lambda i: run(devs[i % 4]), fetch, n1=4, n2=24)
     if per_batch_ms <= 0:
         return {"batch": batch, "note": "below tunnel measurement noise"}
+
+    # roots-only: no B x EDS output buffers — the replay verifier's path
+    roots_fn = extend_tpu._jitted_batched_roots(k)
+
+    def fetch_roots(r):
+        return _np.asarray(r[0])
+
+    roots_ms = _slope(lambda i: roots_fn(devs[i % 4]), fetch_roots, n1=4, n2=24)
     return {
         "batch": batch,
+        "roots_only_ms_per_square": (
+            round(roots_ms / batch, 3) if roots_ms > 0 else None
+        ),
         "tpu_ms_per_batch": round(per_batch_ms, 3),
         "tpu_ms_per_square": round(per_batch_ms / batch, 3),
     }

@@ -110,8 +110,13 @@ class RpcClient:
         from celestia_tpu import smt as smt_mod
 
         res = self._get(f"/proof/state/{key.hex()}")
+        # `is not None`, not truthiness: an EMPTY committed value
+        # (value="") is an inclusion, not an absence
         return {
-            "value": bytes.fromhex(res["value"]) if res["value"] else None,
+            "value": (
+                bytes.fromhex(res["value"])
+                if res["value"] is not None else None
+            ),
             "app_hash": bytes.fromhex(res["app_hash"]),
             "height": res["height"],
             "proof": smt_mod.Proof.unmarshal(res["proof"]),

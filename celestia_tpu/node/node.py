@@ -472,12 +472,9 @@ class Node:
         for k, items in groups.items():
             backend = app.resolve_extend_backend(k)
             if backend == "tpu" and len(items) > 1:
-                import jax.numpy as jnp
-
                 from celestia_tpu import da as da_pkg
-                from celestia_tpu.ops import extend_tpu, rs_tpu
+                from celestia_tpu.ops import extend_tpu
 
-                m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
                 batch = np.stack(
                     [
                         np.frombuffer(
@@ -486,10 +483,19 @@ class Node:
                         for _b, sq in items
                     ]
                 )
-                _eds, rows, cols, _dah = (
-                    extend_tpu.extend_and_root_batched(jnp.asarray(batch), m2)
-                )
-                rows, cols = np.asarray(rows), np.asarray(cols)
+                # jitted roots-only: the verifier never needs the EDS
+                # bytes. Batching amortizes dispatch for small squares
+                # but loses to sequential single-square dispatches at
+                # large k where the vmapped working set pressures HBM
+                # (bench 7a/7b/7c: k=32 batched ~0.74 vs single ~1.0
+                # ms/square; k=128 batched ~7.6 vs single ~5.0) — pick
+                # per size.
+                if k <= 64:
+                    rows, cols = extend_tpu.batched_roots_device(batch)
+                else:
+                    outs = [extend_tpu.roots_device(sq) for sq in batch]
+                    rows = np.stack([o[0] for o in outs])
+                    cols = np.stack([o[1] for o in outs])
                 for i, (block, _sq) in enumerate(items):
                     dah = da_pkg.DataAvailabilityHeader(
                         [r.tobytes() for r in rows[i]],

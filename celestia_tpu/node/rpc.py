@@ -144,17 +144,23 @@ def _handler_for(node: Node):
                     )
 
                     app = node.app
-                    block = node.get_block(app.height)
-                    header = Header(
-                        chain_id=app.chain_id,
-                        height=app.height,
-                        time=block.time if block else 0.0,
-                        app_hash=app.store.app_hashes[app.store.version],
-                        validators=[
-                            ValidatorInfo(v.pubkey, v.power)
-                            for v in consensus_valset(app.staking)
-                        ],
-                    )
+                    # one snapshot under the node lock: a commit racing
+                    # these reads could pair height H with H+1's app
+                    # hash — validators would then sign a header no
+                    # proof at H can ever satisfy
+                    with node._lock:
+                        height = app.height
+                        block = node.get_block(height)
+                        header = Header(
+                            chain_id=app.chain_id,
+                            height=height,
+                            time=block.time if block else 0.0,
+                            app_hash=app.store.app_hashes[app.store.version],
+                            validators=[
+                                ValidatorInfo(v.pubkey, v.power)
+                                for v in consensus_valset(app.staking)
+                            ],
+                        )
                     self._reply(header.to_json())
                 elif len(parts) == 4 and parts[:2] == ["ibc", "packets"]:
                     # /ibc/packets/<port>/<channel> — the relayer work
@@ -175,14 +181,22 @@ def _handler_for(node: Node):
                     # analogue; ref: baseapp "store" query with prove=true)
                     key = bytes.fromhex(parts[2])
                     # atomic triple: the value is the one this proof
-                    # proves against this root, even under racing commits
-                    value, root, proof = node.app.store.query_with_proof(key)
+                    # proves against this root, even under racing
+                    # commits. The node lock extends that atomicity to
+                    # the HEIGHT: a commit landing between the proof and
+                    # the height read would pair H's root with H+1 —
+                    # breaking remote relayers' (proof, height) race
+                    # detection. Commits hold the same lock for their
+                    # whole pipeline, so the pair is one snapshot.
+                    with node._lock:
+                        value, root, proof = node.app.store.query_with_proof(key)
+                        height = node.app.height
                     self._reply(
                         {
                             "key": key.hex(),
                             "value": value.hex() if value is not None else None,
                             "app_hash": root.hex(),
-                            "height": node.app.height,
+                            "height": height,
                             "proof": proof.marshal(),
                         }
                     )

@@ -200,6 +200,64 @@ def extend_and_root_batched(shares: jnp.ndarray, m2: jnp.ndarray):
     return jax.vmap(lambda s: extend_and_root(s, m2))(shares)
 
 
+def roots_only_batched(shares: jnp.ndarray, m2: jnp.ndarray):
+    """(B, k, k, 512) -> batched (row_roots, col_roots) — NO EDS output.
+
+    The replay/state-sync verifier only compares DAH roots, and keeping
+    B full EDS buffers (B × 32 MB at k=128) out of the program's outputs
+    lets XLA treat the extended square as a consumable intermediate
+    instead of allocating and writing every byte of it to HBM — the
+    difference between batched throughput being worse than single-square
+    latency and better (bench config 7c vs 7b)."""
+
+    def one(s):
+        _eds, rows, cols = _roots_of(s, m2)
+        return rows, cols
+
+    return jax.vmap(one)(shares)
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_batched_roots(k: int):
+    m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
+
+    @jax.jit
+    def run(shares):
+        return roots_only_batched(shares, m2)
+
+    return run
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_roots_noeds(k: int):
+    """Single-square (row_roots, col_roots) with NO EDS output — the
+    large-k replay verifier's shape (the EDS stays an XLA intermediate)."""
+    m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
+
+    @jax.jit
+    def run(shares):
+        _eds, rows, cols = _roots_of(shares, m2)
+        return rows, cols
+
+    return run
+
+
+def roots_device(shares: np.ndarray):
+    """Host entry: (k,k,512) uint8 -> numpy (row_roots, col_roots),
+    jit-cached, EDS never materialized as an output."""
+    k = int(shares.shape[0])
+    rows, cols = _jitted_roots_noeds(k)(jnp.asarray(shares))
+    return np.asarray(rows), np.asarray(cols)
+
+
+def batched_roots_device(shares: np.ndarray):
+    """Host entry for the replay verifier: (B,k,k,512) uint8 ->
+    numpy (row_roots, col_roots), jit-cached per square size."""
+    k = int(shares.shape[1])
+    rows, cols = _jitted_batched_roots(k)(jnp.asarray(shares))
+    return np.asarray(rows), np.asarray(cols)
+
+
 def extend_and_root_device(shares: np.ndarray):
     """Host entry: (k,k,512) uint8 numpy -> numpy (eds, row_roots, col_roots, dah)."""
     k = shares.shape[0]

@@ -335,3 +335,90 @@ class TestMultiProcessDevnet:
                     p.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     p.kill()
+
+
+class TestDevnetAdversarial:
+    """Forged consensus messages over the real HTTP boundary are
+    rejected by certificate verification, not by transport trust."""
+
+    def _one_validator_devnet(self, tmp_path):
+        """A live single-validator devnet process target + our local
+        in-process replica of the same chain (so we can craft
+        well-formed-but-unauthorized messages against it)."""
+        from celestia_tpu.node.devnet import build_validator
+
+        node, validator, server = build_validator(
+            DEVNET_GENESIS, 0, 0, [], home=None,
+        )
+        server.start()
+        return node, validator, server
+
+    def test_forged_commit_rejected(self, tmp_path):
+        from celestia_tpu.node.consensus import (
+            CommitCert,
+            make_vote,
+            proposal_hash,
+        )
+        from celestia_tpu.node.devnet import PeerClient
+
+        node, _validator, server = self._one_validator_devnet(tmp_path)
+        try:
+            client = PeerClient(f"http://127.0.0.1:{server.port}")
+            attacker = PrivateKey.from_secret(b"devnet-attacker")
+            height = node.app.height + 1
+            body = {
+                "height": height,
+                "time": 99.0,
+                "proposer": attacker.bech32_address(),
+                "square_size": 1,
+                "data_hash": "00" * 32,
+                "txs": [],
+            }
+            ph = proposal_hash(
+                node.app.chain_id, height, 99.0,
+                attacker.bech32_address(), bytes(32), 1, [],
+            )
+            # attacker signs its own "commit certificate"
+            cert = CommitCert(height, ph, [
+                make_vote(attacker, attacker.bech32_address(),
+                          node.app.chain_id, height, ph, True)
+            ])
+            res = client.consensus_commit(
+                {**body, "cert": cert.to_json(), "app_hash": "ff" * 32}
+            )
+            assert "error" in res and "commit certificate carries" in res["error"]
+            assert node.app.height == height - 1  # nothing applied
+
+            # votes forged in the name of a REAL validator but signed by
+            # the attacker's key carry no power either
+            v1 = PrivateKey.from_secret(b"devnet-val-1")
+            cert = CommitCert(height, ph, [
+                make_vote(attacker, v1.bech32_address(),
+                          node.app.chain_id, height, ph, True)
+            ])
+            res = client.consensus_commit(
+                {**body, "cert": cert.to_json(), "app_hash": "ff" * 32}
+            )
+            assert "error" in res and "commit certificate carries" in res["error"]
+        finally:
+            server.stop()
+
+    def test_unbonded_proposer_gets_no_vote(self, tmp_path):
+        from celestia_tpu.node.devnet import PeerClient
+
+        node, _validator, server = self._one_validator_devnet(tmp_path)
+        try:
+            client = PeerClient(f"http://127.0.0.1:{server.port}")
+            attacker = PrivateKey.from_secret(b"devnet-attacker")
+            body = {
+                "height": node.app.height + 1,
+                "time": 99.0,
+                "proposer": attacker.bech32_address(),
+                "square_size": 1,
+                "data_hash": "00" * 32,
+                "txs": [],
+            }
+            res = client.consensus_proposal(body)
+            assert "error" in res and "not bonded" in res["error"]
+        finally:
+            server.stop()
