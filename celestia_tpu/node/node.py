@@ -475,25 +475,26 @@ class Node:
                 from celestia_tpu import da as da_pkg
                 from celestia_tpu.ops import extend_tpu
 
-                batch = np.stack(
-                    [
-                        np.frombuffer(
-                            b"".join(s.data for s in sq), dtype=np.uint8
-                        ).reshape(k, k, SHARE_SIZE)
-                        for _b, sq in items
-                    ]
-                )
+                squares = [
+                    np.frombuffer(
+                        b"".join(s.data for s in sq), dtype=np.uint8
+                    ).reshape(k, k, SHARE_SIZE)
+                    for _b, sq in items
+                ]
                 # jitted roots-only: the verifier never needs the EDS
                 # bytes. Batching amortizes dispatch for small squares
                 # but loses to sequential single-square dispatches at
                 # large k where the vmapped working set pressures HBM
                 # (bench 7a/7b/7c: k=32 batched ~0.74 vs single ~1.0
                 # ms/square; k=128 batched ~7.6 vs single ~5.0) — pick
-                # per size.
+                # per size. Only the batched path needs the contiguous
+                # stacked copy.
                 if k <= 64:
-                    rows, cols = extend_tpu.batched_roots_device(batch)
+                    rows, cols = extend_tpu.batched_roots_device(
+                        np.stack(squares)
+                    )
                 else:
-                    outs = [extend_tpu.roots_device(sq) for sq in batch]
+                    outs = [extend_tpu.roots_device(sq) for sq in squares]
                     rows = np.stack([o[0] for o in outs])
                     cols = np.stack([o[1] for o in outs])
                 for i, (block, _sq) in enumerate(items):

@@ -200,46 +200,37 @@ def extend_and_root_batched(shares: jnp.ndarray, m2: jnp.ndarray):
     return jax.vmap(lambda s: extend_and_root(s, m2))(shares)
 
 
+def _rows_cols_only(shares: jnp.ndarray, m2: jnp.ndarray):
+    """The ONE roots-only core: (k,k,512) -> (row_roots, col_roots)
+    with no EDS in the outputs — the EDS stays an XLA intermediate.
+    Every roots-only spelling (single, batched, their jit caches)
+    derives from this function so root computation cannot diverge
+    between the replay verifier and the proposer path."""
+    _eds, rows, cols = _roots_of(shares, m2)
+    return rows, cols
+
+
 def roots_only_batched(shares: jnp.ndarray, m2: jnp.ndarray):
     """(B, k, k, 512) -> batched (row_roots, col_roots) — NO EDS output.
 
     The replay/state-sync verifier only compares DAH roots, and keeping
     B full EDS buffers (B × 32 MB at k=128) out of the program's outputs
     lets XLA treat the extended square as a consumable intermediate
-    instead of allocating and writing every byte of it to HBM — the
-    difference between batched throughput being worse than single-square
-    latency and better (bench config 7c vs 7b)."""
-
-    def one(s):
-        _eds, rows, cols = _roots_of(s, m2)
-        return rows, cols
-
-    return jax.vmap(one)(shares)
+    instead of allocating and writing every byte of it to HBM
+    (bench config 7c vs 7b)."""
+    return jax.vmap(lambda s: _rows_cols_only(s, m2))(shares)
 
 
 @functools.lru_cache(maxsize=8)
 def _jitted_batched_roots(k: int):
     m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
-
-    @jax.jit
-    def run(shares):
-        return roots_only_batched(shares, m2)
-
-    return run
+    return jax.jit(lambda shares: roots_only_batched(shares, m2))
 
 
 @functools.lru_cache(maxsize=8)
 def _jitted_roots_noeds(k: int):
-    """Single-square (row_roots, col_roots) with NO EDS output — the
-    large-k replay verifier's shape (the EDS stays an XLA intermediate)."""
     m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
-
-    @jax.jit
-    def run(shares):
-        _eds, rows, cols = _roots_of(shares, m2)
-        return rows, cols
-
-    return run
+    return jax.jit(lambda shares: _rows_cols_only(shares, m2))
 
 
 def roots_device(shares: np.ndarray):

@@ -100,10 +100,17 @@ class CodecBackend:
 
     def repair(self, k: int, share_size: int, eds_bytes: bytes,
                present: bytes) -> bytes:
-        from celestia_tpu.da.repair import repair
-
         arr = self._to_array(eds_bytes, 2 * k, share_size)
         mask = np.frombuffer(present, dtype=np.uint8).reshape(2 * k, 2 * k) != 0
+        if self.use_tpu and share_size == SHARE_SIZE:
+            # same backend ordering as encode: the accelerated
+            # host-planned/device-swept decode (bench config 4), byte-
+            # exact vs the host path (tests pin all implementations)
+            from celestia_tpu.ops.repair_tpu import repair_tpu
+
+            return repair_tpu(arr, mask).tobytes()
+        from celestia_tpu.da.repair import repair
+
         return repair(arr, mask).tobytes()
 
 
