@@ -379,6 +379,50 @@ def bench_square_construct(tx_count: int, blob_size: int):
     }
 
 
+def bench_sha256_kernels(n: int = 65536, length: int = 571):
+    """Supplementary: the two SHA-256 spellings head-to-head on the
+    k=128 leaf workload, HBM-resident input (where the Pallas kernel
+    wins; inside the fused pipeline XLA's leaf-construction fusion wins
+    instead — see ops/sha256_pallas.py's docstring for both numbers)."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        # Mosaic kernels don't lower on the CPU backend; every other
+        # config still runs there, so skip rather than abort the suite
+        return {"skipped": "no TPU device (pallas kernels need Mosaic)"}
+    import jax.numpy as jnp
+
+    from celestia_tpu.ops import sha256_jax, sha256_pallas
+
+    rng = np.random.default_rng(9)
+    devs = [
+        jax.device_put(
+            jnp.asarray(
+                rng.integers(0, 256, size=(n, length), dtype=np.uint8)
+            )
+        )
+        for _ in range(4)
+    ]
+    jit_x = jax.jit(sha256_jax.sha256_fixed)
+    jit_p = jax.jit(sha256_pallas.sha256_fixed)
+
+    def fetch(r):
+        return np.asarray(r)
+
+    xla_ms = _slope(lambda i: jit_x(devs[i % 4]), fetch, n1=8, n2=48)
+    pallas_ms = _slope(lambda i: jit_p(devs[i % 4]), fetch, n1=8, n2=48)
+    ok = np.asarray(jit_p(devs[0])).tobytes() == np.asarray(
+        jit_x(devs[0])
+    ).tobytes()
+    return {
+        "messages": n,
+        "length": length,
+        "xla_ms": round(xla_ms, 3) if xla_ms > 0 else None,
+        "pallas_ms": round(pallas_ms, 3) if pallas_ms > 0 else None,
+        "parity": bool(ok),
+    }
+
+
 def bench_node_path(k: int):
     """Node-path ExtendBlock: the same square -> EDS -> DAH hot path, but
     through App._extend_and_hash (the code `cli start` actually runs:
@@ -501,6 +545,7 @@ def main():
         f"tx{n}_blob{s}": bench_square_construct(n, s)
         for n, s in ((10, 10_000), (100, 1_000), (1_000, 100))
     }
+    configs["10_sha256_kernels"] = bench_sha256_kernels()
 
     for name, cfg in configs.items():
         if "parity" in cfg:

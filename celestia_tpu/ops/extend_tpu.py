@@ -43,6 +43,17 @@ import numpy as np
 from celestia_tpu import namespace as ns
 from celestia_tpu.appconsts import NAMESPACE_SIZE, SHARE_SIZE
 from celestia_tpu.ops import rs_tpu
+# The pipeline's hasher is the XLA scan spelling. A Pallas alternative
+# exists (ops/sha256_pallas.py) and measures 1.8x FASTER standalone on
+# the k=128 leaf workload (3.0 vs 5.5 ms for 65k x 571 B messages) —
+# but swapping it into THIS fused pipeline measured SLOWER end-to-end
+# (k=128 extend 5.97 vs 4.98 ms, NMT-only 4.02 vs 2.7 ms): the
+# pallas_call boundary forces the padded/transposed message tensor
+# (~38 MB) to materialize in HBM, while XLA fuses leaf construction
+# straight into the hash rounds and never builds it. Same lesson as
+# ops/rs_pallas (see its docstring): on this pipeline, fusion beats
+# hand-tiling — both kernels stay as explicitly-invoked, bit-exact
+# alternatives for workloads that feed from HBM anyway.
 from celestia_tpu.ops.sha256_jax import sha256_fixed
 
 _PARITY_NS = np.frombuffer(ns.PARITY_SHARES_NAMESPACE.bytes, dtype=np.uint8)

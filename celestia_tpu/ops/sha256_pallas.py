@@ -1,0 +1,159 @@
+"""Pallas TPU kernel for batched SHA-256 (the NMT hashing hot loop).
+
+The XLA spelling (ops/sha256_jax.py) expresses the 64-round compression
+as `lax.scan` over rounds with the message schedule materialized as a
+(64, batch) tensor — structurally clean, but the scan carries and the
+schedule round-trip through memory between fusion boundaries. This
+kernel unrolls the whole compression per batch tile in VMEM: the
+schedule lives in registers/VMEM scratch, each grid step hashes
+`_TILE_N` messages in lock-step lanes, and HBM sees only the padded
+message words in and the 8-word digests out.
+
+Layout contract: `sha256_words(words)` takes the big-endian message
+words TRANSPOSED to (16·n_blocks, N) — lanes are the batch axis, the
+shape the VPU wants — and returns (8, N) digest words. The byte-level
+convenience wrapper `sha256_fixed` matches ops/sha256_jax.sha256_fixed
+bit-for-bit (asserted by tests/test_extend_tpu.py's parity suite).
+
+Measured on v5e (65,536 × 571 B messages, the k=128 EDS leaf set):
+**3.0 ms vs 5.5 ms for the XLA spelling — 1.8× faster standalone**,
+where the input already lives in HBM. Swapped INTO the fused extend
+pipeline it measured SLOWER end-to-end (k=128 extend 5.97 vs 4.98 ms):
+the pallas_call boundary materializes the padded/transposed message
+tensor (~38 MB) that XLA's fusion of leaf-construction-into-rounds
+never builds. So — like ops/rs_pallas — this stays an explicitly-
+invoked alternative for HBM-resident hash workloads, and the fused
+pipeline keeps the XLA spelling (see extend_tpu.py's import comment)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from celestia_tpu.ops.sha256_jax import (
+    _H0,
+    _K,
+    bytes_to_words,
+    pad_tail,
+    words_to_bytes,
+)
+
+_TILE_N = 512  # batch lanes per grid step (4 vector registers wide)
+
+
+def _rotr(x, n: int):
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _sha_core(words: jnp.ndarray) -> list[jnp.ndarray]:
+    """The unrolled compression math: (16·nb, T) uint32 -> 8 state
+    vectors of shape (T,). Pure jnp — this EXACT function body is what
+    the pallas kernel executes on its VMEM tile, and what the CPU
+    parity tests run eagerly (pallas interpret mode internally jits,
+    and XLA:CPU takes minutes to compile the unrolled straight-line
+    graph; eager execution of the same ops is instant)."""
+    nb = words.shape[0] // 16
+    state = [
+        jnp.full((words.shape[1],), _H0[i], dtype=jnp.uint32)
+        for i in range(8)
+    ]
+    for blk in range(nb):
+        w = [words[blk * 16 + i, :] for i in range(16)]
+        for t in range(16, 64):
+            wm15, wm2 = w[t - 15], w[t - 2]
+            s0 = _rotr(wm15, 7) ^ _rotr(wm15, 18) ^ (wm15 >> np.uint32(3))
+            s1 = _rotr(wm2, 17) ^ _rotr(wm2, 19) ^ (wm2 >> np.uint32(10))
+            w.append(w[t - 16] + s0 + w[t - 7] + s1)
+        a, b, c, d, e, f, g, h = state
+        for t in range(64):
+            s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+            ch = (e & f) ^ (~e & g)
+            t1 = h + s1 + ch + np.uint32(_K[t]) + w[t]
+            s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+            maj = (a & b) ^ (a & c) ^ (b & c)
+            t2 = s0 + maj
+            a, b, c, d, e, f, g, h = t1 + t2, a, b, c, d + t1, e, f, g
+        state = [
+            state[0] + a, state[1] + b, state[2] + c, state[3] + d,
+            state[4] + e, state[5] + f, state[6] + g, state[7] + h,
+        ]
+    return state
+
+
+def _sha_kernel(words_ref, out_ref):
+    """words (16·nb, T) uint32 -> out (8, T) uint32."""
+    state = _sha_core(words_ref[...])
+    for i in range(8):
+        out_ref[i, :] = state[i]
+
+
+def sha_core_reference(words: jnp.ndarray) -> jnp.ndarray:
+    """Host-testable spelling of the kernel math: (16·nb, N) -> (8, N).
+    Run it eagerly (outside jit) on CPU — see _sha_core's docstring."""
+    return jnp.stack(_sha_core(words))
+
+
+def _sha256_words_impl(words: jnp.ndarray, interpret: bool,
+                       tile: int) -> jnp.ndarray:
+    wlen, n = words.shape
+    n_pad = -n % tile
+    if n_pad:
+        words = jnp.pad(words, ((0, 0), (0, n_pad)))
+    n_total = n + n_pad
+    grid = (n_total // tile,)
+    out = pl.pallas_call(
+        _sha_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, n_total), jnp.uint32),
+        grid=grid,
+        in_specs=[pl.BlockSpec((wlen, tile), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((8, tile), lambda i: (0, i)),
+        interpret=interpret,
+    )(words)
+    return out[:, :n]
+
+
+_sha256_words_jit = jax.jit(
+    functools.partial(_sha256_words_impl, interpret=False),
+    static_argnames=("tile",),
+)
+
+
+def sha256_words(words: jnp.ndarray, interpret: bool = False,
+                 tile: int = _TILE_N) -> jnp.ndarray:
+    """(16·nb, N) uint32 padded message words -> (8, N) digest words.
+
+    N is padded up to a `tile` multiple internally (zero lanes hash
+    garbage that is sliced away). The interpret path runs EAGERLY —
+    wrapping the interpret-lowered unrolled kernel in jit hands XLA:CPU
+    a ~1000-statement graph it takes minutes to compile; eager
+    execution of the same ops is seconds. `tile` exists for those
+    parity tests; the device default is _TILE_N."""
+    if interpret:
+        return _sha256_words_impl(words, interpret=True, tile=tile)
+    return _sha256_words_jit(words, tile=tile)
+
+
+def message_words(msgs: jnp.ndarray) -> jnp.ndarray:
+    """The kernel's input-layout contract in ONE place: uint8 (N, L)
+    messages -> (16·nb, N) big-endian padded words, lanes = batch.
+    Used by sha256_fixed and by the parity tests, so the layout the
+    tests exercise can never drift from the one the device runs."""
+    msg_len = msgs.shape[-1]
+    tail = pad_tail(msg_len)
+    tail = jnp.broadcast_to(jnp.asarray(tail), (msgs.shape[0], tail.shape[0]))
+    return bytes_to_words(jnp.concatenate([msgs, tail], axis=-1)).T
+
+
+def sha256_fixed(msgs: jnp.ndarray, interpret: bool = False,
+                 tile: int = _TILE_N) -> jnp.ndarray:
+    """Drop-in for sha256_jax.sha256_fixed: uint8 (..., L) -> (..., 32)."""
+    batch_shape = msgs.shape[:-1]
+    flat = msgs.reshape(-1, msgs.shape[-1])
+    digests = sha256_words(
+        message_words(flat), interpret=interpret, tile=tile
+    )  # (8, N)
+    return words_to_bytes(digests.T).reshape(*batch_shape, 32)
