@@ -16,9 +16,13 @@ convenience wrapper `sha256_fixed` matches ops/sha256_jax.sha256_fixed
 bit-for-bit (asserted by tests/test_extend_tpu.py's parity suite).
 
 Measured on v5e (65,536 × 571 B messages, the k=128 EDS leaf set):
-**3.0 ms vs 5.5 ms for the XLA spelling — 1.8× faster standalone**,
-where the input already lives in HBM. Swapped INTO the fused extend
-pipeline it measured SLOWER end-to-end (k=128 extend 5.97 vs 4.98 ms):
+**3.0 ms vs 5.5 ms for the XLA spelling — 1.8× faster standalone** on
+an unloaded chip, where the input already lives in HBM (the margin is
+load-sensitive: inside a full bench sweep the two spellings measure
+within noise of each other — bench config 10 records the per-run
+numbers rather than this module re-asserting a fixed ratio). Swapped
+INTO the fused extend pipeline it measured SLOWER end-to-end (k=128
+extend 5.97 vs 4.98 ms):
 the pallas_call boundary materializes the padded/transposed message
 tensor (~38 MB) that XLA's fusion of leaf-construction-into-rounds
 never builds. So — like ops/rs_pallas — this stays an explicitly-
