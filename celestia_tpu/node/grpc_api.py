@@ -168,7 +168,11 @@ class NodeGrpcServer:
 
         def state_proof(req: bytes) -> bytes:
             key = _get_bytes(req, 1)
-            value, root, proof = node.app.store.query_with_proof(key)
+            # height under the node lock, same atomicity as the HTTP
+            # route: a racing commit must not pair H's root with H+1
+            with node._lock:
+                value, root, proof = node.app.store.query_with_proof(key)
+                height = node.app.height
             out = b""
             if value is not None:
                 out += _field_bytes(1, value)
@@ -178,7 +182,36 @@ class NodeGrpcServer:
             )
             if value is not None:
                 out += _field_uint(4, 1)
+            out += _field_uint(5, height)
             return out
+
+        def ibc_header(_req: bytes) -> bytes:
+            # assembly + lock-snapshot semantics shared with the HTTP
+            # route via Node.ibc_light_client_header (one sign-bytes
+            # schema, one source)
+            header = node.ibc_light_client_header()
+            return _field_bytes(
+                1, json.dumps(header.to_json(), sort_keys=True).encode()
+            )
+
+        def ibc_packets(req: bytes) -> bytes:
+            packets = node.app.ibc.pending_packets(
+                _get_str(req, 1), _get_str(req, 2)
+            )
+            return _field_bytes(
+                1,
+                json.dumps(
+                    [p.to_json() for p in packets], sort_keys=True
+                ).encode(),
+            )
+
+        def ibc_ack(req: bytes) -> bytes:
+            ack = node.app.ibc.get_acknowledgement(
+                _get_str(req, 1), _get_str(req, 2), _get_uint(req, 3)
+            )
+            if ack is None:
+                return b""
+            return _field_bytes(1, ack.marshal())
 
         methods = {
             "Status": status,
@@ -187,6 +220,9 @@ class NodeGrpcServer:
             "Params": params,
             "GetTx": get_tx,
             "StateProof": state_proof,
+            "IbcHeader": ibc_header,
+            "IbcPackets": ibc_packets,
+            "IbcAck": ibc_ack,
         }
         handlers = {
             name: self._wrap(fn) for name, fn in methods.items()
@@ -320,8 +356,9 @@ class GrpcClient:
         return json.loads(_get_str(raw, 1))
 
     def state_proof(self, key: bytes) -> dict:
-        """(value|None, app_hash, smt.Proof) — verifiable against the
-        returned root with StateStore.verify_proof."""
+        """(value|None, app_hash, smt.Proof, height) — verifiable
+        against the returned root with StateStore.verify_proof; the
+        (proof, height) pair is one node-lock snapshot."""
         from celestia_tpu import smt as smt_mod
 
         raw = self._call(NODE_SERVICE, "StateProof", _field_bytes(1, key))
@@ -329,8 +366,40 @@ class GrpcClient:
         return {
             "value": value,
             "app_hash": _get_bytes(raw, 2),
+            "height": _get_uint(raw, 5),
             "proof": smt_mod.Proof.unmarshal(json.loads(_get_str(raw, 3))),
         }
+
+    # --- IBC relayer surface (mirrors RpcClient's, so the SAME
+    # RemoteLightClientRelayer runs over either transport) ---
+
+    def ibc_header(self):
+        from celestia_tpu.x.lightclient import Header
+
+        raw = self._call(NODE_SERVICE, "IbcHeader", b"")
+        return Header.from_json(json.loads(_get_str(raw, 1)))
+
+    def ibc_pending_packets(self, port_id: str, channel_id: str) -> list:
+        from celestia_tpu.x.ibc import Packet
+
+        req = _field_bytes(1, port_id.encode()) + _field_bytes(
+            2, channel_id.encode()
+        )
+        raw = self._call(NODE_SERVICE, "IbcPackets", req)
+        return [Packet.from_json(p) for p in json.loads(_get_str(raw, 1))]
+
+    def ibc_ack(self, port_id: str, channel_id: str, seq: int):
+        from celestia_tpu.x.ibc import Acknowledgement
+
+        req = (
+            _field_bytes(1, port_id.encode())
+            + _field_bytes(2, channel_id.encode())
+            + _field_uint(3, seq)
+        )
+        raw = self._call(NODE_SERVICE, "IbcAck", req)
+        if not raw:
+            return None
+        return Acknowledgement.unmarshal(_get_bytes(raw, 1))
 
     def cosmos_get_tx(self, key: bytes) -> dict:
         """The cosmos.tx.v1beta1.Service/GetTx spelling (hex-string

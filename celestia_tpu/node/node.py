@@ -273,6 +273,30 @@ class Node:
     def latest_height(self) -> int:
         return self.app.height
 
+    def ibc_light_client_header(self):
+        """Unsigned light-client header material for this chain's latest
+        committed state, read as ONE snapshot under the node lock (a
+        racing commit must never pair height H with H+1's app hash —
+        validators would sign a header no proof at H can satisfy).
+        The single source for both transports' ibc-header routes, so
+        the sign-bytes schema cannot drift between them."""
+        from celestia_tpu.node.consensus import consensus_valset
+        from celestia_tpu.x.lightclient import Header, ValidatorInfo
+
+        with self._lock:
+            height = self.app.height
+            block = self.get_block(height)
+            return Header(
+                chain_id=self.app.chain_id,
+                height=height,
+                time=block.time if block else 0.0,
+                app_hash=self.app.store.app_hashes[self.app.store.version],
+                validators=[
+                    ValidatorInfo(v.pubkey, v.power)
+                    for v in consensus_valset(self.app.staking)
+                ],
+            )
+
     # --- state sync (serve + bootstrap) ---
 
     def snapshot_payload(self) -> dict:

@@ -135,33 +135,12 @@ def _handler_for(node: Node):
                     # unsigned light-client header material for the
                     # latest committed state — what a relayer has the
                     # chain's validators sign for MsgUpdateClient.
-                    # Serialized THROUGH Header.to_json so the wire can
-                    # never drift from the sign-bytes schema.
-                    from celestia_tpu.node.consensus import consensus_valset
-                    from celestia_tpu.x.lightclient import (
-                        Header,
-                        ValidatorInfo,
-                    )
-
-                    app = node.app
-                    # one snapshot under the node lock: a commit racing
-                    # these reads could pair height H with H+1's app
-                    # hash — validators would then sign a header no
-                    # proof at H can ever satisfy
-                    with node._lock:
-                        height = app.height
-                        block = node.get_block(height)
-                        header = Header(
-                            chain_id=app.chain_id,
-                            height=height,
-                            time=block.time if block else 0.0,
-                            app_hash=app.store.app_hashes[app.store.version],
-                            validators=[
-                                ValidatorInfo(v.pubkey, v.power)
-                                for v in consensus_valset(app.staking)
-                            ],
-                        )
-                    self._reply(header.to_json())
+                    # Assembly + lock-snapshot semantics live in
+                    # Node.ibc_light_client_header (shared with the
+                    # gRPC route); serialized THROUGH Header.to_json so
+                    # the wire can never drift from the sign-bytes
+                    # schema.
+                    self._reply(node.ibc_light_client_header().to_json())
                 elif len(parts) == 4 and parts[:2] == ["ibc", "packets"]:
                     # /ibc/packets/<port>/<channel> — the relayer work
                     # queue (commitments not yet acknowledged)

@@ -530,11 +530,15 @@ class TestLightClientE2E:
 class TestRemoteRelayer:
     """The relayer as a real out-of-process actor: everything it needs
     (pending packets, acks, header material, commitment proofs, tx
-    submission) crosses the public HTTP API — no in-process store
-    access anywhere in the relay path."""
+    submission) crosses the public node API — no in-process store
+    access anywhere in the relay path. Parametrized over BOTH remote
+    transports: the same RemoteLightClientRelayer runs unchanged over
+    HTTP (RpcClient) and gRPC (GrpcClient)."""
 
-    def test_voucher_round_trip_fully_remote(self):
+    @pytest.mark.parametrize("transport", ["http", "grpc"])
+    def test_voucher_round_trip_fully_remote(self, transport):
         from celestia_tpu.node.client import RpcClient
+        from celestia_tpu.node.grpc_api import GrpcClient, NodeGrpcServer
         from celestia_tpu.node.rpc import RpcServer
         from celestia_tpu.testutil.ibc import RemoteLightClientRelayer
 
@@ -548,13 +552,19 @@ class TestRemoteRelayer:
         node_a.app.store.commit_hash_refresh()
         node_b.app.store.commit_hash_refresh()
 
-        srv_a = RpcServer(node_a, port=0)
-        srv_b = RpcServer(node_b, port=0)
+        if transport == "http":
+            srv_a = RpcServer(node_a, port=0)
+            srv_b = RpcServer(node_b, port=0)
+            mk = lambda srv: RpcClient(f"http://127.0.0.1:{srv.port}")  # noqa: E731
+        else:
+            srv_a = NodeGrpcServer(node_a, port=0)
+            srv_b = NodeGrpcServer(node_b, port=0)
+            mk = lambda srv: GrpcClient(f"127.0.0.1:{srv.port}")  # noqa: E731
         srv_a.start()
         srv_b.start()
         try:
-            client_a = RpcClient(f"http://127.0.0.1:{srv_a.port}")
-            client_b = RpcClient(f"http://127.0.0.1:{srv_b.port}")
+            client_a = mk(srv_a)
+            client_b = mk(srv_b)
 
             b_signer = Signer.setup_single(BOB, client_b)
             res = b_signer.submit_tx(
@@ -589,5 +599,8 @@ class TestRemoteRelayer:
             # commitment cleared on B (queried remotely too)
             assert client_b.ibc_pending_packets("transfer", "channel-0") == []
         finally:
+            if transport == "grpc":
+                client_a.close()
+                client_b.close()
             srv_a.stop()
             srv_b.stop()
