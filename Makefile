@@ -1,0 +1,35 @@
+# celestia_tpu build/test surface (the reference's Makefile test tiers,
+# /root/reference/Makefile:124-131, mapped to this repo).
+
+PY ?= python
+
+.PHONY: test test-all test-slow bench dryrun native
+
+# Fast developer loop: the default tier skips the slow multi-process
+# suites (devnet, gRPC, multihost, network, race storms). ~3-5 min with
+# a warm .jax_cache; the first run compiles and is slower.
+test:
+	$(PY) -m pytest tests/ -q
+
+# Everything, including the slow tier (3-OS-process devnet, live gRPC,
+# multi-host DCN backend, RPC race storms). ~8-15 min warm.
+test-all:
+	$(PY) -m pytest tests/ --all -q
+
+# Only the slow tier.
+test-slow:
+	$(PY) -m pytest tests/ --all -m slow -q
+
+# The BASELINE benchmark suite on the real TPU chip (one JSON line).
+bench:
+	$(PY) bench.py
+
+# The driver's multichip compile/execute check on a virtual CPU mesh.
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# Build the native C++ runtime (CPU codec baseline + sidecar).
+# (auto-compiles on first import; this just forces it eagerly)
+native:
+	$(PY) -c "from celestia_tpu import native; assert native.available(); print('native runtime ready')"

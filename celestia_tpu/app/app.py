@@ -41,8 +41,20 @@ from celestia_tpu.x.distribution import (
 from celestia_tpu.x.gov import GovKeeper, MsgDeposit, MsgSubmitProposal, MsgVote
 from celestia_tpu.x.mint import MintKeeper
 from celestia_tpu.x.paramfilter import apply_param_changes
+from celestia_tpu.x.connection import (
+    ConnectionKeeper,
+    MsgConnectionOpenAck,
+    MsgConnectionOpenConfirm,
+    MsgConnectionOpenInit,
+    MsgConnectionOpenTry,
+)
 from celestia_tpu.x.ibc import (
+    ChannelKeeper,
     MsgAcknowledgement,
+    MsgChannelOpenAck,
+    MsgChannelOpenConfirm,
+    MsgChannelOpenInit,
+    MsgChannelOpenTry,
     MsgRecvPacket,
     MsgTimeout,
     packet_ack_key,
@@ -65,7 +77,11 @@ from celestia_tpu.x.transfer import (
     TransferKeeper,
 )
 from celestia_tpu.x.upgrade import MsgVersionChange, UpgradeKeeper
-from celestia_tpu.x.vesting import MsgCreateVestingAccount, VestingKeeper
+from celestia_tpu.x.vesting import (
+    MsgCreatePeriodicVestingAccount,
+    MsgCreateVestingAccount,
+    VestingKeeper,
+)
 
 from celestia_tpu.log import logger
 
@@ -269,33 +285,68 @@ class App:
             self._active_backend = backend
         return backend
 
-    def _extend_and_hash(self, data_square) -> tuple:
-        """The hot path: square -> EDS -> DAH. ref: app/prepare_proposal.go:95
+    def _square_array(self, data_square, k: int):
+        import numpy as np
 
-        Backend per resolve_extend_backend; all byte-identical.
+        return np.frombuffer(
+            b"".join(s.data for s in data_square), dtype=np.uint8
+        ).reshape(k, k, appconsts.SHARE_SIZE)
+
+    def _proposal_dah(self, data_square) -> "da.DataAvailabilityHeader":
+        """Roots-only hot path for Prepare/ProcessProposal and replay
+        verification: square -> DAH, the EDS never leaves the device.
+
+        ref: app/prepare_proposal.go:95-115 / process_proposal.go — the
+        proposal flow only needs the DataAvailabilityHeader hash. On the
+        TPU backend the EDS is an XLA intermediate of the roots program
+        (ops/extend_tpu.roots_device): only 2·2k·90 bytes of axis roots
+        cross back to host instead of the full (2k)²·512 square."""
+        from celestia_tpu import native
+
+        k = square_pkg.square_size(len(data_square))
+        backend = self.resolve_extend_backend(k)
+        if backend == "tpu":
+            from celestia_tpu.ops import extend_tpu
+
+            rows, cols = extend_tpu.roots_device(self._square_array(data_square, k))
+            return da.DataAvailabilityHeader(
+                [r.tobytes() for r in rows], [c.tobytes() for c in cols]
+            )
+        if backend == "native":
+            _eds, rows, cols, native_dah = native.extend_and_root_native(
+                self._square_array(data_square, k)
+            )
+            return da.DataAvailabilityHeader(rows, cols, _hash=native_dah)
+        eds = da.extend_shares(to_bytes(data_square))
+        return da.new_data_availability_header(eds)
+
+    def _extend_and_hash(self, data_square) -> tuple:
+        """The EDS-producing path: square -> EDS + DAH (ExtendBlock /
+        block storage; proposal flows use _proposal_dah and skip the EDS).
+
+        On the TPU backend the EDS stays DEVICE-RESIDENT: the returned
+        ExtendedDataSquare holds the device buffer and fetches host bytes
+        lazily only if shares are actually served (32 MB at k=128 —
+        pure waste on the proposal path, deferred on this one).
         """
         from celestia_tpu import native
 
         k = square_pkg.square_size(len(data_square))
         backend = self.resolve_extend_backend(k)
         if backend in ("tpu", "native"):
-            import numpy as np
-
-            arr = np.frombuffer(
-                b"".join(s.data for s in data_square), dtype=np.uint8
-            ).reshape(k, k, appconsts.SHARE_SIZE)
+            arr = self._square_array(data_square, k)
             if backend == "tpu":
                 from celestia_tpu.ops import extend_tpu
 
                 # Device computes EDS + axis roots; the tiny DAH merkle tree
                 # over the roots is host-side (latency-bound on device).
-                eds_arr, rows, cols = extend_tpu.extend_roots_device(arr)
+                eds_dev, rows, cols = extend_tpu.extend_roots_device_resident(arr)
                 dah = da.DataAvailabilityHeader(
                     [r.tobytes() for r in rows], [c.tobytes() for c in cols]
                 )
-            else:
-                eds_arr, rows, cols, native_dah = native.extend_and_root_native(arr)
-                dah = da.DataAvailabilityHeader(rows, cols, _hash=native_dah)
+                return da.ExtendedDataSquare.from_device(eds_dev, k), dah
+            eds_arr, rows, cols, native_dah = native.extend_and_root_native(arr)
+            dah = da.DataAvailabilityHeader(rows, cols, _hash=native_dah)
             return da.ExtendedDataSquare(eds_arr, k), dah
         eds = da.extend_shares(to_bytes(data_square))
         return eds, da.new_data_availability_header(eds)
@@ -382,7 +433,7 @@ class App:
         data_square, txs = square_pkg.build(
             txs, self.app_version, self.gov_square_size_upper_bound()
         )
-        _eds, dah = self._extend_and_hash(data_square)
+        dah = self._proposal_dah(data_square)
         return ProposalBlockData(
             txs=txs,
             square_size=square_pkg.square_size(len(data_square)),
@@ -477,7 +528,7 @@ class App:
         )
         if square_pkg.square_size(len(data_square)) != block_data.square_size:
             return False
-        _eds, dah = self._extend_and_hash(data_square)
+        dah = self._proposal_dah(data_square)
         return dah.hash() == block_data.hash
 
     # ------------------------------------------------------------------ #
@@ -621,6 +672,12 @@ class App:
                 ctx, msg.from_address, msg.to_address, msg.amount,
                 msg.end_time, msg.delayed,
             )
+        elif isinstance(msg, MsgCreatePeriodicVestingAccount):
+            VestingKeeper(
+                ctx.store, BankKeeper(ctx.store)
+            ).create_periodic_vesting_account(
+                ctx, msg.from_address, msg.to_address, msg.periods
+            )
         elif isinstance(msg, MsgGrantAllowance):
             FeegrantKeeper(ctx.store, BankKeeper(ctx.store)).grant_allowance(
                 msg.to_allowance()
@@ -655,11 +712,49 @@ class App:
             ClientKeeper(ctx.store).create_client(msg.initial_header)
         elif isinstance(msg, MsgUpdateClient):
             ClientKeeper(ctx.store).update_client(
-                msg.client_id, msg.signed_header
+                msg.client_id, msg.signed_header, now=ctx.block_time
             )
         elif isinstance(msg, MsgSubmitMisbehaviour):
             ClientKeeper(ctx.store).submit_misbehaviour(
                 msg.client_id, msg.header_a, msg.header_b
+            )
+        elif isinstance(msg, MsgConnectionOpenInit):
+            ConnectionKeeper(ctx.store).open_init(
+                msg.client_id, msg.counterparty_client_id
+            )
+        elif isinstance(msg, MsgConnectionOpenTry):
+            ConnectionKeeper(ctx.store).open_try(
+                msg.client_id, msg.counterparty_client_id,
+                msg.counterparty_connection_id, msg.proof_init,
+                msg.proof_height,
+            )
+        elif isinstance(msg, MsgConnectionOpenAck):
+            ConnectionKeeper(ctx.store).open_ack(
+                msg.connection_id, msg.counterparty_connection_id,
+                msg.proof_try, msg.proof_height,
+            )
+        elif isinstance(msg, MsgConnectionOpenConfirm):
+            ConnectionKeeper(ctx.store).open_confirm(
+                msg.connection_id, msg.proof_ack, msg.proof_height
+            )
+        elif isinstance(msg, MsgChannelOpenInit):
+            ChannelKeeper(ctx.store).chan_open_init(
+                msg.port_id, msg.connection_id, msg.counterparty_port_id
+            )
+        elif isinstance(msg, MsgChannelOpenTry):
+            ChannelKeeper(ctx.store).chan_open_try(
+                msg.port_id, msg.connection_id, msg.counterparty_port_id,
+                msg.counterparty_channel_id, msg.proof_init,
+                msg.proof_height,
+            )
+        elif isinstance(msg, MsgChannelOpenAck):
+            ChannelKeeper(ctx.store).chan_open_ack(
+                msg.port_id, msg.channel_id, msg.counterparty_channel_id,
+                msg.proof_try, msg.proof_height,
+            )
+        elif isinstance(msg, MsgChannelOpenConfirm):
+            ChannelKeeper(ctx.store).chan_open_confirm(
+                msg.port_id, msg.channel_id, msg.proof_ack, msg.proof_height
             )
         else:
             raise ValueError(f"unroutable message type {type(msg).__name__}")
@@ -679,13 +774,14 @@ class App:
         ch = channels.get_channel(port_id, channel_id)
         if ch is None:
             raise ValueError(f"channel {port_id}/{channel_id} is not open")
-        if ch.client_id:
+        client_id = channels.client_for_channel(ch)
+        if client_id:
             if msg.proof is None:
                 raise ValueError(
                     f"channel {port_id}/{channel_id} is bound to client "
-                    f"{ch.client_id}: packet messages must carry a proof"
+                    f"{client_id}: packet messages must carry a proof"
                 )
-            return ch.client_id
+            return client_id
         channels.require_relayer(msg.signer)
         return ""
 

@@ -24,7 +24,7 @@ SUPPORTED_SHARE_VERSIONS = (appconsts.SHARE_VERSION_ZERO,)
 # --- minimal proto3 wire codec (varint + length-delimited only) ---
 
 
-def uvarint(n: int) -> bytes:
+def _uvarint_slow(n: int) -> bytes:
     out = bytearray()
     while True:
         b = n & 0x7F
@@ -34,6 +34,17 @@ def uvarint(n: int) -> bytes:
         else:
             out.append(b)
             return bytes(out)
+
+
+# one- and two-byte encodings cover every length delimiter and share
+# index the builder emits in practice; table lookup beats the loop
+_UVARINT_TABLE = tuple(_uvarint_slow(i) for i in range(16384))
+
+
+def uvarint(n: int) -> bytes:
+    if 0 <= n < 16384:
+        return _UVARINT_TABLE[n]
+    return _uvarint_slow(n)
 
 
 def read_uvarint(data: bytes, pos: int) -> tuple[int, int]:
@@ -65,22 +76,86 @@ def _field_uint(tag: int, value: int) -> bytes:
 
 
 def _parse_fields(data: bytes):
-    """Yield (tag, wire_type, value) triples; value is int or bytes."""
+    """(tag, wire_type, value) triples; value is int or bytes.
+
+    Varint decoding is inlined with a single-byte fast path (field keys
+    are one byte for tags < 16, and most lengths/values fit 7 bits) —
+    this parser sits on the block-building hot path for every tx."""
+    out = []
     pos = 0
-    while pos < len(data):
-        key, pos = read_uvarint(data, pos)
-        tag, wt = key >> 3, key & 7
+    n = len(data)
+    while pos < n:
+        b = data[pos]
+        pos += 1
+        if b < 0x80:
+            key = b
+        else:
+            key = b & 0x7F
+            shift = 7
+            while True:
+                if pos >= n:
+                    raise ValueError("truncated varint")
+                b = data[pos]
+                pos += 1
+                key |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+                if shift > 63:
+                    raise ValueError("varint too long")
+        wt = key & 7
+        tag = key >> 3
         if wt == 0:
-            val, pos = read_uvarint(data, pos)
+            b = data[pos] if pos < n else None
+            if b is None:
+                raise ValueError("truncated varint")
+            pos += 1
+            if b < 0x80:
+                val = b
+            else:
+                val = b & 0x7F
+                shift = 7
+                while True:
+                    if pos >= n:
+                        raise ValueError("truncated varint")
+                    b = data[pos]
+                    pos += 1
+                    val |= (b & 0x7F) << shift
+                    if not b & 0x80:
+                        break
+                    shift += 7
+                    if shift > 63:
+                        raise ValueError("varint too long")
         elif wt == 2:
-            ln, pos = read_uvarint(data, pos)
-            if pos + ln > len(data):
+            b = data[pos] if pos < n else None
+            if b is None:
+                raise ValueError("truncated varint")
+            pos += 1
+            if b < 0x80:
+                ln = b
+            else:
+                ln = b & 0x7F
+                shift = 7
+                while True:
+                    if pos >= n:
+                        raise ValueError("truncated varint")
+                    b = data[pos]
+                    pos += 1
+                    ln |= (b & 0x7F) << shift
+                    if not b & 0x80:
+                        break
+                    shift += 7
+                    if shift > 63:
+                        raise ValueError("varint too long")
+            end = pos + ln
+            if end > n:
                 raise ValueError("truncated field")
-            val = data[pos : pos + ln]
-            pos += ln
+            val = data[pos:end]
+            pos = end
         else:
             raise ValueError(f"unsupported wire type {wt}")
-        yield tag, wt, val
+        out.append((tag, wt, val))
+    return out
 
 
 # --- Blob ---
@@ -143,10 +218,10 @@ def unmarshal_blob(raw: bytes) -> Blob:
     for tag, wt, val in _parse_fields(raw):
         if tag == 1:
             _require_wt(wt, 2, tag)
-            b.namespace_id = bytes(val)
+            b.namespace_id = val
         elif tag == 2:
             _require_wt(wt, 2, tag)
-            b.data = bytes(val)
+            b.data = val
         elif tag == 3:
             _require_wt(wt, 0, tag)
             b.share_version = int(val)
@@ -181,6 +256,12 @@ def marshal_blob_tx(tx: bytes, blobs: list[Blob]) -> bytes:
 
 def unmarshal_blob_tx(raw: bytes) -> tuple[BlobTx | None, bool]:
     """Returns (blob_tx, is_blob_tx). ref: pkg/blob/blob.go:58"""
+    # Sound fast-reject: the type_id field value "BLOB" must appear
+    # literally in the wire bytes, so its absence proves not-a-BlobTx
+    # without a varint-by-varint parse (the common case for ordinary sdk
+    # txs flowing through the builder/mempool).
+    if b"BLOB" not in raw:
+        return None, False
     try:
         tx = b""
         blobs: list[Blob] = []
@@ -188,13 +269,13 @@ def unmarshal_blob_tx(raw: bytes) -> tuple[BlobTx | None, bool]:
         for tag, wt, val in _parse_fields(raw):
             if tag == 1:
                 _require_wt(wt, 2, tag)
-                tx = bytes(val)
+                tx = val
             elif tag == 2:
                 _require_wt(wt, 2, tag)
-                blobs.append(unmarshal_blob(bytes(val)))
+                blobs.append(unmarshal_blob(val))
             elif tag == 3:
                 _require_wt(wt, 2, tag)
-                type_id = bytes(val).decode()
+                type_id = val.decode()
         if type_id != PROTO_BLOB_TX_TYPE_ID:
             return None, False
         return BlobTx(tx=tx, blobs=blobs), True
@@ -211,6 +292,16 @@ class IndexWrapper:
     share_indexes: list[int]
 
 
+def marshal_index_wrapper_size(tx: bytes, share_indexes: list[int]) -> int:
+    """len(marshal_index_wrapper(tx, share_indexes)) without building the
+    bytes — the builder's capacity accounting calls this per blob tx."""
+    packed_len = sum(len(uvarint(i)) for i in share_indexes)
+    size = 1 + len(uvarint(len(tx))) + len(tx) if tx else 0
+    if packed_len:
+        size += 1 + len(uvarint(packed_len)) + packed_len
+    return size + 1 + 1 + 4  # field 3: tag, len, "INDX"
+
+
 def marshal_index_wrapper(tx: bytes, share_indexes: list[int]) -> bytes:
     packed = b"".join(uvarint(i) for i in share_indexes)
     return (
@@ -221,6 +312,12 @@ def marshal_index_wrapper(tx: bytes, share_indexes: list[int]) -> bytes:
 
 
 def unmarshal_index_wrapper(raw: bytes) -> tuple[IndexWrapper | None, bool]:
+    # Same sound fast-reject as unmarshal_blob_tx: no literal "INDX"
+    # bytes -> cannot carry the type_id field -> not an IndexWrapper.
+    # The builder runs this on every blob tx's inner sdk tx (the
+    # double-wrap validity check), where rejection is the hot path.
+    if b"INDX" not in raw:
+        return None, False
     try:
         tx = b""
         indexes: list[int] = []
@@ -228,7 +325,7 @@ def unmarshal_index_wrapper(raw: bytes) -> tuple[IndexWrapper | None, bool]:
         for tag, wt, val in _parse_fields(raw):
             if tag == 1:
                 _require_wt(wt, 2, tag)
-                tx = bytes(val)
+                tx = val
             elif tag == 2 and wt == 2:
                 pos = 0
                 while pos < len(val):
@@ -238,7 +335,7 @@ def unmarshal_index_wrapper(raw: bytes) -> tuple[IndexWrapper | None, bool]:
                 indexes.append(int(val))
             elif tag == 3:
                 _require_wt(wt, 2, tag)
-                type_id = bytes(val).decode()
+                type_id = val.decode()
         if type_id != PROTO_INDEX_WRAPPER_TYPE_ID:
             return None, False
         return IndexWrapper(tx=tx, share_indexes=indexes), True

@@ -106,6 +106,11 @@ def cmd_start(args):
     if getattr(args, "extend_backend", None) is not None:
         flag_overrides["app.extend_backend"] = args.extend_backend
     cfg = load_config(home, flag_overrides)
+    # persistent XLA compile cache: a node restart pays disk-load, not a
+    # recompile, for the extend/repair device programs
+    from celestia_tpu.ops import enable_compile_cache
+
+    enable_compile_cache()
     # App.__init__ validates the backend string, so a config/env typo
     # fails loudly here instead of silently degrading to numpy
     node = _build_node(home, extend_backend=cfg.app.extend_backend)

@@ -34,11 +34,43 @@ MIN_EXTENDED_SQUARE_WIDTH = MIN_SQUARE_SIZE * 2
 
 
 class ExtendedDataSquare:
-    """2k×2k erasure-extended share matrix, row-major uint8 (2k, 2k, 512)."""
+    """2k×2k erasure-extended share matrix, row-major uint8 (2k, 2k, 512).
 
-    def __init__(self, squares: np.ndarray, original_width: int):
-        self.data = squares
+    The backing bytes may live on an accelerator: `from_device` wraps a
+    device buffer (jax array) and the host copy is fetched lazily on
+    first `.data` access. The node's TPU ExtendBlock path relies on this
+    — proposal/verify flows only ever need the DAH roots, so the 32 MB
+    EDS crosses the interconnect only when the block store actually
+    serves shares (ref: app/extend_block.go:14 recomputes the EDS
+    post-consensus for storage; here storage holds the device handle)."""
+
+    def __init__(self, squares: np.ndarray | None, original_width: int):
+        self._data = squares
+        self._device = None
         self.original_width = original_width
+
+    @classmethod
+    def from_device(cls, device_buffer, original_width: int) -> "ExtendedDataSquare":
+        """Wrap a (2k, 2k, 512) device array without fetching it."""
+        eds = cls(None, original_width)
+        eds._device = device_buffer
+        return eds
+
+    @property
+    def data(self) -> np.ndarray:
+        if self._data is None:
+            self._data = np.asarray(self._device)  # one lazy fetch
+        return self._data
+
+    @data.setter
+    def data(self, value: np.ndarray) -> None:
+        self._data = value
+
+    @property
+    def device_data(self):
+        """The device buffer when this EDS is device-resident (else None);
+        repair consumes this handle directly to avoid a host round-trip."""
+        return self._device
 
     @property
     def width(self) -> int:

@@ -116,6 +116,33 @@ def repair(
     return eds
 
 
+def repair_eds(
+    square,
+    present: np.ndarray,
+    row_roots: list[bytes] | None = None,
+    col_roots: list[bytes] | None = None,
+):
+    """Repair an ExtendedDataSquare in its storage domain.
+
+    A device-resident square (the handle the TPU extend path produced —
+    da.ExtendedDataSquare.from_device) is repaired AND root-verified
+    wholly on device (ops/repair_tpu.repair_resident_verified); only the
+    axis roots cross the interconnect, and the result comes back as a
+    device-resident ExtendedDataSquare. Host-backed squares take the
+    host Leopard decode. Both paths are bit-exact (tests pin them)."""
+    from celestia_tpu import da
+
+    if square.device_data is not None:
+        from celestia_tpu.ops import repair_tpu
+
+        fixed = repair_tpu.repair_resident_verified(
+            square.device_data, present, row_roots, col_roots
+        )
+        return da.ExtendedDataSquare.from_device(fixed, square.original_width)
+    fixed = repair(square.data, present, row_roots, col_roots)
+    return da.ExtendedDataSquare(fixed, square.original_width)
+
+
 def _verify_roots(eds: np.ndarray, k: int, row_roots, col_roots) -> None:
     from celestia_tpu import da
 

@@ -25,6 +25,10 @@ def blob_min_square_size(share_count: int) -> int:
     return round_up_power_of_two(math.isqrt(max(share_count - 1, 0)) + 1 if share_count > 0 else 1)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=4096)
 def sub_tree_width(share_count: int, subtree_root_threshold: int) -> int:
     """Max leaves per commitment subtree. ref: blob_share_commitment_rules.go:84"""
     s = share_count // subtree_root_threshold

@@ -147,11 +147,18 @@ def proposal_hash(
 
 
 def vote_sign_bytes(chain_id: str, height: int, prop_hash: bytes,
-                    accept: bool) -> bytes:
+                    accept: bool, round_: int = 0) -> bytes:
+    """Canonical vote payload. The ROUND is part of what a validator
+    signs (tendermint's Vote{Height, Round, BlockID}): an honest
+    validator signs at most one proposal per (height, round) — re-voting
+    after a leader crash happens in a HIGHER round — so two signed
+    accepts for different proposals at one (height, round) are
+    unambiguous equivocation, never the crash-fault re-vote path."""
     return json.dumps(
         {
             "chain_id": chain_id,
             "height": height,
+            "round": round_,
             "proposal": prop_hash.hex(),
             "accept": accept,
         },
@@ -165,19 +172,23 @@ class Vote:
     operator: str
     accept: bool
     signature: str  # hex, over vote_sign_bytes
+    round: int = 0
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
 
     @classmethod
     def from_json(cls, d: dict) -> "Vote":
-        return cls(d["operator"], bool(d["accept"]), d["signature"])
+        return cls(
+            d["operator"], bool(d["accept"]), d["signature"],
+            int(d.get("round", 0)),
+        )
 
 
 def make_vote(key, operator: str, chain_id: str, height: int,
-              prop_hash: bytes, accept: bool) -> Vote:
-    sig = key.sign(vote_sign_bytes(chain_id, height, prop_hash, accept))
-    return Vote(operator, accept, sig.hex())
+              prop_hash: bytes, accept: bool, round_: int = 0) -> Vote:
+    sig = key.sign(vote_sign_bytes(chain_id, height, prop_hash, accept, round_))
+    return Vote(operator, accept, sig.hex(), round_)
 
 
 @dataclasses.dataclass
@@ -187,11 +198,13 @@ class CommitCert:
     height: int
     prop_hash: bytes
     votes: list[Vote]
+    round: int = 0
 
     def to_json(self) -> dict:
         return {
             "height": self.height,
             "prop_hash": self.prop_hash.hex(),
+            "round": self.round,
             "votes": [v.to_json() for v in self.votes],
         }
 
@@ -201,13 +214,88 @@ class CommitCert:
             height=int(d["height"]),
             prop_hash=bytes.fromhex(d["prop_hash"]),
             votes=[Vote.from_json(v) for v in d["votes"]],
+            round=int(d.get("round", 0)),
         )
 
 
+@dataclasses.dataclass
+class VoteEvidence:
+    """Raw, independently-verifiable equivocation: two validly-signed
+    ACCEPT votes by one validator for two DIFFERENT proposals at one
+    (height, ROUND) — CometBFT's DuplicateVoteEvidence shape; the
+    reference routes it into its evidence keeper (app/app.go:387-392).
+
+    The round is what separates equivocation from the honest crash-fault
+    re-vote: a validator that re-votes after a leader stall does so in a
+    HIGHER round, so only same-round conflicts are slashable.
+
+    Anyone holding both votes can construct this; verification needs
+    only the bonded valset (the pubkeys) — no trust in the reporter."""
+
+    operator: str
+    height: int
+    round: int
+    prop_hash_a: bytes
+    sig_a: str  # over vote_sign_bytes(chain, height, prop_hash_a, True, round)
+    prop_hash_b: bytes
+    sig_b: str
+
+    def key(self) -> tuple[str, int, int]:
+        return (self.operator, self.height, self.round)
+
+    def to_json(self) -> dict:
+        return {
+            "operator": self.operator,
+            "height": self.height,
+            "round": self.round,
+            "prop_hash_a": self.prop_hash_a.hex(),
+            "sig_a": self.sig_a,
+            "prop_hash_b": self.prop_hash_b.hex(),
+            "sig_b": self.sig_b,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "VoteEvidence":
+        return cls(
+            operator=d["operator"],
+            height=int(d["height"]),
+            round=int(d.get("round", 0)),
+            prop_hash_a=bytes.fromhex(d["prop_hash_a"]),
+            sig_a=d["sig_a"],
+            prop_hash_b=bytes.fromhex(d["prop_hash_b"]),
+            sig_b=d["sig_b"],
+        )
+
+
+def verify_vote_evidence(
+    valset: list[ConsensusValidator], chain_id: str, ev: VoteEvidence
+) -> int:
+    """Raise unless the evidence proves equivocation by a CURRENT bonded
+    validator; returns the validator's power (for the Equivocation
+    record). Deterministic given (valset, evidence) — every replica
+    reaches the same verdict, so evidence handling cannot fork state."""
+    if ev.prop_hash_a == ev.prop_hash_b:
+        raise ValueError("votes endorse the same proposal — no conflict")
+    v = next((v for v in valset if v.operator == ev.operator), None)
+    if v is None:
+        raise ValueError(f"{ev.operator} is not a bonded validator")
+    pubkey = bytes.fromhex(v.pubkey)
+    for ph, sig in ((ev.prop_hash_a, ev.sig_a), (ev.prop_hash_b, ev.sig_b)):
+        if not verify_signature(
+            pubkey,
+            vote_sign_bytes(chain_id, ev.height, ph, True, ev.round),
+            bytes.fromhex(sig),
+        ):
+            raise ValueError("evidence signature does not verify")
+    return v.power
+
+
 def tally(valset: list[ConsensusValidator], chain_id: str, height: int,
-          prop_hash: bytes, votes: list[Vote]) -> int:
+          prop_hash: bytes, votes: list[Vote], round_: int = 0) -> int:
     """Accepting power carried by valid, de-duplicated votes from the
-    valset. Invalid/unknown/duplicate entries contribute nothing."""
+    valset for (height, round_, prop_hash). Invalid/unknown/duplicate
+    entries — including votes signed for a different round — contribute
+    nothing (the sign bytes bind the round)."""
     power_of = {v.operator: v.power for v in valset}
     pubkey_of = {v.operator: v.pubkey for v in valset}
     seen: set[str] = set()
@@ -219,7 +307,7 @@ def tally(valset: list[ConsensusValidator], chain_id: str, height: int,
             continue
         if not verify_signature(
             bytes.fromhex(pubkey_of[vote.operator]),
-            vote_sign_bytes(chain_id, height, prop_hash, vote.accept),
+            vote_sign_bytes(chain_id, height, prop_hash, vote.accept, round_),
             bytes.fromhex(vote.signature),
         ):
             continue
@@ -242,7 +330,9 @@ def verify_commit_cert(
     total = total_power(valset)
     if total <= 0:
         raise ValueError("validator set has no power")
-    accepted = tally(valset, chain_id, cert.height, cert.prop_hash, cert.votes)
+    accepted = tally(
+        valset, chain_id, cert.height, cert.prop_hash, cert.votes, cert.round
+    )
     if not meets_quorum(accepted, total):
         raise ValueError(
             f"commit certificate carries {accepted}/{total} power "

@@ -58,6 +58,7 @@ from celestia_tpu.node.client import RpcClient
 from celestia_tpu.node.consensus import (
     CommitCert,
     ConsensusValidator,
+    VoteEvidence,
     consensus_valset,
     make_vote,
     meets_quorum,
@@ -66,6 +67,7 @@ from celestia_tpu.node.consensus import (
     tally,
     total_power,
     verify_commit_cert,
+    verify_vote_evidence,
 )
 from celestia_tpu.node.node import Node
 
@@ -80,6 +82,12 @@ class PeerClient(RpcClient):
 
     def consensus_commit(self, body: dict) -> dict:
         return self._post("/consensus/commit", body)
+
+    def consensus_evidence(self, body: dict) -> dict:
+        return self._post("/consensus/evidence", body)
+
+    def gossip_have(self, keys: list[bytes]) -> dict:
+        return self._post("/gossip/have", {"keys": [k.hex() for k in keys]})
 
     def gossip_tx(self, raw: bytes) -> dict:
         return self._post("/broadcast_tx", {"tx": raw.hex(), "forward": False})
@@ -98,15 +106,34 @@ class ValidatorNode:
         self.operator = key.bech32_address()
         self.peers = [PeerClient(p, timeout=5.0) for p in peers]
         self.liveness_timeout = liveness_timeout
-        # vote-once bookkeeping: height -> (prop_hash, voted_at)
-        self._voted: dict[int, tuple[bytes, float]] = {}
+        # vote-once bookkeeping: height -> (round, prop_hash, voted_at).
+        # The round discipline is what keeps honest validators
+        # slash-proof: NEVER sign two proposals at one (height, round);
+        # the crash-fault re-vote path moves to a strictly higher round.
+        self._voted: dict[int, tuple[int, bytes, float]] = {}
+        # equivocation watch: every ACCEPT vote this validator has seen
+        # (peer votes it collected as leader, certificate votes from
+        # commits) — height -> (operator, round) -> (prop_hash, sig).
+        # Two entries for one (height, round, operator) with different
+        # proposal hashes ARE double-sign evidence.
+        self._seen_votes: dict[int, dict[tuple[str, int], tuple[bytes, str]]] = {}
+        # verified evidence awaiting inclusion in a block this node leads
+        self._pending_evidence: dict[tuple[str, int, int], VoteEvidence] = {}
+        # next round to propose with per height (bumped on failed rounds
+        # so a takeover proposal eventually exceeds every peer's prior
+        # vote round — the liveness ladder)
+        self._round_attempt: dict[int, int] = {}
+        # CAT gossip accounting: raw tx bytes actually sent vs bytes the
+        # want/have handshake avoided sending (plus the tiny have keys)
+        self.gossip_stats = {"raw_bytes": 0, "have_bytes": 0,
+                             "deduped_bytes": 0}
         self._vote_lock = threading.Lock()
         self._last_commit = time.monotonic()
         # cached own proposal per height: a failed round (missing peer
         # vote) retries the IDENTICAL body next tick — regenerating with
         # a fresh timestamp would trip everyone's vote-once rule and
         # stall the height for a full liveness window
-        self._my_proposal: tuple | None = None  # (height, body, ph, proposal)
+        self._my_proposal: tuple | None = None  # (height, body, ph, proposal, created)
         self.halted: str | None = None  # set on app-hash divergence
         node.validator = self
 
@@ -116,7 +143,9 @@ class ValidatorNode:
         return consensus_valset(self.node.app.staking)
 
     def _prop_hash(self, body: dict) -> bytes:
-        return proposal_hash(
+        import hashlib
+
+        ph = proposal_hash(
             self.node.app.chain_id,
             int(body["height"]),
             float(body["time"]),
@@ -125,6 +154,132 @@ class ValidatorNode:
             int(body["square_size"]),
             [bytes.fromhex(t) for t in body["txs"]],
         )
+        ev = body.get("evidence") or []
+        if ev:
+            # evidence is state-affecting (BeginBlock slashing), so votes
+            # must bind it — a leader cannot vary evidence post-vote
+            # without producing a different proposal hash
+            ev_digest = hashlib.sha256(
+                json.dumps(ev, sort_keys=True, separators=(",", ":")).encode()
+            ).digest()
+            ph = hashlib.sha256(ph + ev_digest).digest()
+        round_ = int(body.get("round", 0))
+        if round_:
+            # the round also binds the hash (round 0 keeps the legacy
+            # bytes), so one proposal body cannot be replayed as a
+            # different round
+            ph = hashlib.sha256(ph + round_.to_bytes(8, "big")).digest()
+        return ph
+
+    # ---- equivocation detection / evidence pool ----
+
+    def _body_evidence(self, body: dict) -> list:
+        """Verify and convert a proposal body's evidence entries to
+        slashing Equivocations. Deterministic given the committed valset
+        — every replica converts identically, so state cannot fork.
+        Raises on any invalid entry (an honest leader only includes
+        verified evidence, so an invalid entry means a bad proposal)."""
+        from celestia_tpu.x.slashing import Equivocation
+
+        out = []
+        for d in body.get("evidence") or []:
+            ev = VoteEvidence.from_json(d)
+            power = verify_vote_evidence(
+                self._valset(), self.node.app.chain_id, ev
+            )
+            out.append(Equivocation(ev.operator, ev.height, power))
+        return out
+
+    def _record_accept_vote(
+        self, height: int, round_: int, operator: str, ph: bytes,
+        signature: str,
+    ) -> None:
+        """Watch every accept vote; a second vote by the same validator
+        at the same (height, ROUND) for a DIFFERENT proposal becomes
+        verified VoteEvidence, pooled for the next block and gossiped to
+        peers (CometBFT's DuplicateVoteEvidence detection; the reference
+        receives it as ABCI ByzantineValidators). Cross-round conflicts
+        are NOT evidence — that is the honest crash-fault re-vote.
+
+        The signature is verified BEFORE the vote is recorded: commit
+        certificates can carry rider entries with garbage signatures
+        (tally just skips them), and recording one unverified would
+        poison the (height, round, operator) slot — the later REAL
+        conflicting vote would pair with the garbage entry, fail
+        evidence verification, and the actual double-sign would escape
+        detection."""
+        from celestia_tpu.node.consensus import (
+            verify_signature,
+            vote_sign_bytes,
+        )
+
+        pubkey = next(
+            (v.pubkey for v in self._valset() if v.operator == operator), None
+        )
+        if pubkey is None:
+            return
+        try:
+            ok = verify_signature(
+                bytes.fromhex(pubkey),
+                vote_sign_bytes(
+                    self.node.app.chain_id, height, ph, True, round_
+                ),
+                bytes.fromhex(signature),
+            )
+        except ValueError:
+            ok = False
+        if not ok:
+            return  # forged/garbage rider — never let it into the watch
+        with self._vote_lock:
+            seen = self._seen_votes.setdefault(height, {})
+            prior = seen.get((operator, round_))
+            if prior is None:
+                seen[(operator, round_)] = (ph, signature)
+                return
+            if prior[0] == ph:
+                return
+            ev = VoteEvidence(
+                operator=operator, height=height, round=round_,
+                prop_hash_a=prior[0], sig_a=prior[1],
+                prop_hash_b=ph, sig_b=signature,
+            )
+            try:
+                verify_vote_evidence(
+                    self._valset(), self.node.app.chain_id, ev
+                )
+            except ValueError as e:
+                log.info("discarding unverifiable double-vote", error=str(e))
+                return
+            if ev.key() in self._pending_evidence:
+                return
+            self._pending_evidence[ev.key()] = ev
+            log.info("EQUIVOCATION detected", operator=operator, height=height)
+        for peer in self.peers:
+            try:
+                peer.consensus_evidence({"evidence": ev.to_json()})
+            except Exception as e:  # noqa: BLE001 — a dead peer is fine
+                log.info("evidence gossip skip", peer=peer.base_url,
+                         error=str(e))
+
+    def handle_evidence(self, body: dict) -> dict:
+        """Accept gossiped double-sign evidence after independent
+        verification (no trust in the reporter)."""
+        ev = VoteEvidence.from_json(body["evidence"])
+        verify_vote_evidence(self._valset(), self.node.app.chain_id, ev)
+        with self._vote_lock:
+            self._pending_evidence.setdefault(ev.key(), ev)
+        return {"ok": True}
+
+    def _prune_evidence(self, committed_height: int) -> None:
+        """Drop vote records at committed heights and evidence already
+        included (the equivocator is tombstoned — further evidence for
+        it is redundant)."""
+        with self._vote_lock:
+            self._seen_votes = {
+                h: v
+                for h, v in self._seen_votes.items()
+                if h > committed_height
+            }
 
     # ---- peer-facing handlers (RPC threads) ----
 
@@ -141,17 +296,33 @@ class ValidatorNode:
         if body["proposer"] not in {v.operator for v in valset}:
             raise ValueError(f"proposer {body['proposer']} is not bonded")
         ph = self._prop_hash(body)
+        round_ = int(body.get("round", 0))
 
         with self._vote_lock:
             prior = self._voted.get(height)
-            if prior is not None and prior[0] != ph:
-                if time.monotonic() - prior[1] < self.liveness_timeout:
+            if prior is not None:
+                p_round, p_ph, p_ts = prior
+                if round_ == p_round and ph != p_ph:
+                    # NEVER sign two proposals at one (height, round) —
+                    # doing so is slashable equivocation by definition
                     raise ValueError(
-                        f"already voted at height {height} for a different "
-                        "proposal"
+                        f"already voted at height {height} round {round_} "
+                        "for a different proposal"
                     )
-                # stale vote from a leader that died before committing —
-                # crash-fault liveness: free the height for re-proposal
+                if round_ < p_round:
+                    raise ValueError(
+                        f"stale round {round_} at height {height} "
+                        f"(already voted in round {p_round})"
+                    )
+                if round_ > p_round and (
+                    time.monotonic() - p_ts < self.liveness_timeout
+                ):
+                    # the prior round's leader may still commit — only a
+                    # stale vote frees us to endorse a later round
+                    raise ValueError(
+                        f"round {p_round} vote at height {height} is "
+                        "still fresh"
+                    )
             from celestia_tpu.app.app import ProposalBlockData
 
             proposal = ProposalBlockData(
@@ -161,15 +332,24 @@ class ValidatorNode:
             )
             with self.node._lock:
                 accept = self.node.app.process_proposal(proposal)
+            if accept and body.get("evidence"):
+                # evidence is state-affecting: refuse to endorse a
+                # proposal carrying entries we cannot verify
+                try:
+                    self._body_evidence(body)
+                except ValueError as e:
+                    log.info("rejecting proposal with bad evidence",
+                             error=str(e))
+                    accept = False
             vote = make_vote(
                 self.key, self.operator, self.node.app.chain_id, height, ph,
-                accept,
+                accept, round_,
             )
-            if accept and (prior is None or prior[0] != ph):
+            if accept and (prior is None or (prior[0], prior[1]) != (round_, ph)):
                 # stamp once per proposal, not per retry delivery — a
                 # proposer re-POSTing its cached round must not keep our
                 # vote record eternally fresh (see try_propose)
-                self._voted[height] = (ph, time.monotonic())
+                self._voted[height] = (round_, ph, time.monotonic())
         return {"vote": vote.to_json()}
 
     def handle_commit(self, body: dict) -> dict:
@@ -189,7 +369,17 @@ class ValidatorNode:
         ph = self._prop_hash(body)
         if cert.prop_hash != ph:
             raise ValueError("certificate does not match the proposal")
+        if cert.round != int(body.get("round", 0)):
+            raise ValueError("certificate round does not match the proposal")
         verify_commit_cert(self._valset(), self.node.app.chain_id, cert)
+        # certificate votes are publicly visible accept votes — feed the
+        # equivocation watch (a validator that voted for a competing
+        # proposal in the SAME round is caught right here)
+        for v in cert.votes:
+            if v.accept:
+                self._record_accept_vote(
+                    height, cert.round, v.operator, ph, v.signature
+                )
         # expected_height re-checks under node._lock: two concurrent
         # commit handlers both passing the height gate above must not
         # stack — the second would apply a block its certificate does
@@ -200,8 +390,22 @@ class ValidatorNode:
             bytes.fromhex(body["data_hash"]),
             float(body["time"]),
             expected_height=height,
+            evidence=self._body_evidence(body),
         )
         self._last_commit = time.monotonic()
+        with self._vote_lock:
+            # committed heights can never be voted again — drop their
+            # records (unbounded growth in a long-running validator)
+            self._voted = {h: v for h, v in self._voted.items() if h > height}
+            self._round_attempt = {
+                h: r for h, r in self._round_attempt.items() if h > height
+            }
+            for d in body.get("evidence") or []:
+                self._pending_evidence.pop(
+                    (d["operator"], int(d["height"]), int(d.get("round", 0))),
+                    None,
+                )
+        self._prune_evidence(height)
         if block.app_hash.hex() != body["app_hash"]:
             # deterministic state machines diverged — halt loudly, never
             # keep signing on a forked state
@@ -214,10 +418,23 @@ class ValidatorNode:
         return {"app_hash": block.app_hash.hex(), "height": block.height}
 
     def gossip_tx(self, raw: bytes) -> None:
-        """Forward a freshly-admitted tx to every peer once."""
+        """Forward a freshly-admitted tx to every peer, CAT-style
+        (specs/src/specs/cat_pool.md): offer the 32-byte tx KEY first
+        (want/have); raw bytes travel only to peers that do not already
+        hold or recently processed the tx. `gossip_stats` records the
+        measured bytes-on-wire either way."""
+        from celestia_tpu.node.node import tx_hash
+
+        key = tx_hash(raw)
         for peer in self.peers:
             try:
-                peer.gossip_tx(raw)
+                res = peer.gossip_have([key])
+                self.gossip_stats["have_bytes"] += len(key)
+                if key.hex() in res.get("want", []):
+                    peer.gossip_tx(raw)
+                    self.gossip_stats["raw_bytes"] += len(raw)
+                else:
+                    self.gossip_stats["deduped_bytes"] += len(raw)
             except Exception as e:  # noqa: BLE001 — a dead peer is fine
                 log.info("gossip skip", peer=peer.base_url, error=str(e))
 
@@ -329,39 +546,75 @@ class ValidatorNode:
 
         cached = self._my_proposal
         if cached is not None and cached[0] == height:
-            _h, body, ph, proposal = cached  # retry the identical round
+            _h, body, ph, proposal, _created = cached  # retry identical round
         else:
             block_time = block_time if block_time is not None else time.time()
             with self.node._lock:
                 proposal = app.prepare_proposal(self.node.mempool.reap())
+            with self._vote_lock:
+                # drop pooled evidence that no longer verifies (e.g. the
+                # operator fully unbonded) — peers vote down proposals
+                # carrying unverifiable entries, and an unprunable entry
+                # would wedge every future proposal (liveness)
+                for k, ev in list(self._pending_evidence.items()):
+                    try:
+                        verify_vote_evidence(
+                            self._valset(), app.chain_id, ev
+                        )
+                    except ValueError as e:
+                        log.info("dropping stale evidence", key=str(k),
+                                 error=str(e))
+                        del self._pending_evidence[k]
+                pending_ev = sorted(
+                    self._pending_evidence.values(), key=lambda e: e.key()
+                )
+                prior = self._voted.get(height)
+                # round selection: strictly above our own prior vote
+                # round (never re-sign a (height, round)), and above any
+                # round we already burned in a failed attempt
+                round_ = self._round_attempt.get(height, 0)
+                if prior is not None and prior[0] >= round_:
+                    round_ = prior[0] + 1
             body = {
                 "height": height,
                 "time": block_time,
+                "round": round_,
                 "proposer": self.operator,
                 "square_size": proposal.square_size,
                 "data_hash": proposal.hash.hex(),
                 "txs": [t.hex() for t in proposal.txs],
             }
+            if pending_ev:
+                body["evidence"] = [e.to_json() for e in pending_ev]
             ph = self._prop_hash(body)
-            self._my_proposal = (height, body, ph, proposal)
+            self._my_proposal = (height, body, ph, proposal, time.monotonic())
+        round_ = int(body.get("round", 0))
         valset = self._valset()
 
         with self._vote_lock:
             # the vote-once rule binds the proposer too: having voted
             # for another leader's fresh proposal at this height, we
-            # must not sign a conflicting one of our own
+            # must not sign a conflicting one of our own (same round),
+            # nor abandon a fresh later-round vote
             prior = self._voted.get(height)
-            if prior is not None and prior[0] != ph:
-                if time.monotonic() - prior[1] < self.liveness_timeout:
+            if prior is not None and (prior[0], prior[1]) != (round_, ph):
+                if prior[0] == round_ or prior[0] > round_:
+                    # our cached round collided with a vote we since
+                    # cast — regenerate at a higher round next tick
+                    self._round_attempt[height] = prior[0] + 1
+                    self._my_proposal = None
                     return None
-            if prior is None or prior[0] != ph:
+                if time.monotonic() - prior[2] < self.liveness_timeout:
+                    return None
+            if prior is None or (prior[0], prior[1]) != (round_, ph):
                 # stamp once per proposal, NOT per retry tick: refreshing
                 # the timestamp on every retry would make our own vote
                 # record never age out, permanently refusing a competing
                 # proposal at this height (mutual refusal = liveness halt)
-                self._voted[height] = (ph, time.monotonic())
+                self._voted[height] = (round_, ph, time.monotonic())
         votes = [
-            make_vote(self.key, self.operator, app.chain_id, height, ph, True)
+            make_vote(self.key, self.operator, app.chain_id, height, ph,
+                      True, round_)
         ]
         for peer in self.peers:
             try:
@@ -369,24 +622,70 @@ class ValidatorNode:
                 if "vote" in res:
                     from celestia_tpu.node.consensus import Vote
 
-                    votes.append(Vote.from_json(res["vote"]))
+                    v = Vote.from_json(res["vote"])
+                    votes.append(v)
+                    if v.accept:
+                        # feed the equivocation watch with every peer
+                        # accept vote this leader collects
+                        self._record_accept_vote(
+                            height, round_, v.operator, ph, v.signature
+                        )
             except Exception as e:  # noqa: BLE001
                 log.info("peer vote skip", peer=peer.base_url, error=str(e))
 
-        accepted = tally(valset, app.chain_id, height, ph, votes)
+        accepted = tally(valset, app.chain_id, height, ph, votes, round_)
         total = total_power(valset)
         if not meets_quorum(accepted, total):
-            log.info("round failed", height=height, power=f"{accepted}/{total}")
+            log.info("round failed", height=height, round=round_,
+                     power=f"{accepted}/{total}")
+            # once this attempt has aged past the liveness window, burn
+            # the round: peers that voted elsewhere only endorse a LATER
+            # round, so retrying round_ forever would stall the height
+            created = self._my_proposal[4] if self._my_proposal else 0.0
+            if time.monotonic() - created > self.liveness_timeout:
+                with self._vote_lock:
+                    self._round_attempt[height] = round_ + 1
+                self._my_proposal = None
             return None
-        cert = CommitCert(height, ph, votes)
+        cert = CommitCert(height, ph, votes, round_)
 
-        block = self.node.apply_external_block(
-            proposal.txs, proposal.square_size, proposal.hash,
-            float(body["time"]),
-            expected_height=height,
-        )
+        try:
+            # evidence re-verification sits INSIDE the race guard: a
+            # takeover commit landing between the tally and here can
+            # change the valset (even unbond the equivocator), making
+            # _body_evidence raise — that is the same benign race as the
+            # expected_height guard below, not a fault
+            block = self.node.apply_external_block(
+                proposal.txs, proposal.square_size, proposal.hash,
+                float(body["time"]),
+                expected_height=height,
+                evidence=self._body_evidence(body),
+            )
+        except ValueError as e:
+            if self.node.app.height + 1 == height:
+                raise  # deterministic rejection of our OWN block — halt
+            # benign race: a takeover leader's commit landed between the
+            # vote tally and our apply. Abandon the round and continue
+            # at the new height — the validator process must survive.
+            log.info("round overtaken", height=height, error=str(e))
+            self._my_proposal = None
+            return None
         self._my_proposal = None  # round closed
         self._last_commit = time.monotonic()
+        with self._vote_lock:
+            self._voted = {
+                h: v for h, v in self._voted.items() if h > block.height
+            }
+            self._round_attempt = {
+                h: r for h, r in self._round_attempt.items()
+                if h > block.height
+            }
+            for d in body.get("evidence") or []:
+                self._pending_evidence.pop(
+                    (d["operator"], int(d["height"]), int(d.get("round", 0))),
+                    None,
+                )
+        self._prune_evidence(block.height)
         commit_body = {**body, "cert": cert.to_json(),
                        "app_hash": block.app_hash.hex()}
         peer_hashes = {}

@@ -40,6 +40,11 @@ class Mempool:
         self.txs: dict[bytes, MempoolTx] = {}
         self.ttl_blocks = ttl_blocks
         self.max_tx_bytes = max_tx_bytes
+        # every key this pool has ever admitted (height-bounded): the
+        # CAT want/have answer — a peer offering a tx we hold OR already
+        # processed gets "don't send" instead of the raw bytes
+        # (specs/src/specs/cat_pool.md's SeenTx role)
+        self._seen: dict[bytes, int] = {}
 
     def add(self, raw: bytes, priority: int, height: int) -> bytes:
         if len(raw) > self.max_tx_bytes:
@@ -47,10 +52,16 @@ class Mempool:
         key = tx_hash(raw)
         if key not in self.txs:
             self.txs[key] = MempoolTx(raw=raw, priority=priority, height_added=height)
+        self._seen[key] = height
         return key
 
     def remove(self, key: bytes) -> None:
         self.txs.pop(key, None)
+
+    def has_seen(self, key: bytes) -> bool:
+        """True when this pool holds or recently processed the tx — the
+        want/have reply (want = NOT seen)."""
+        return key in self.txs or key in self._seen
 
     def reap(self, max_bytes: int | None = None) -> list[bytes]:
         """Highest-priority txs first (stable within equal priority)."""
@@ -73,6 +84,15 @@ class Mempool:
         ]
         for k in expired:
             del self.txs[k]
+        # seen records outlive the pool entry by one extra TTL window so
+        # late duplicate offers are still deduplicated, then age out
+        # (bounded memory in a long-running node)
+        stale = [
+            k for k, h in self._seen.items()
+            if height - h >= 2 * self.ttl_blocks
+        ]
+        for k in stale:
+            del self._seen[k]
         return len(expired)
 
     def __len__(self) -> int:
@@ -161,7 +181,8 @@ class Node:
 
     def apply_external_block(self, txs: list[bytes], square_size: int,
                              data_hash: bytes, block_time: float,
-                             expected_height: int | None = None) -> Block:
+                             expected_height: int | None = None,
+                             evidence: list | None = None) -> Block:
         """Apply a block decided elsewhere (a devnet peer's committed
         proposal): full ProcessProposal validation, then the normal
         deliver/commit pipeline. The caller (node/devnet.py) has already
@@ -183,10 +204,12 @@ class Node:
             proposal = ProposalBlockData(
                 txs=list(txs), square_size=square_size, hash=data_hash
             )
-            return self._apply_block_locked(proposal, block_time, own=False)
+            return self._apply_block_locked(
+                proposal, block_time, own=False, evidence=evidence
+            )
 
     def _apply_block_locked(self, proposal, block_time: float,
-                            own: bool) -> Block:
+                            own: bool, evidence: list | None = None) -> Block:
         t0 = time.perf_counter()
         if not self.app.process_proposal(proposal):
             if own:
@@ -197,7 +220,7 @@ class Node:
                 "ProcessProposal"
             )
 
-        self.app.begin_block(block_time)
+        self.app.begin_block(block_time, evidence=evidence)
         results = [self.app.deliver_tx(t) for t in proposal.txs]
         self.app.end_block()
         app_hash = self.app.commit()
@@ -454,7 +477,7 @@ class Node:
     @staticmethod
     def _verify_block_data_hash(app: App, block: "Block") -> None:
         square = Node._rebuild_square(app, block)
-        _eds, dah = app._extend_and_hash(square)
+        dah = app._proposal_dah(square)
         if dah.hash() != block.data_hash:
             raise ValueError(
                 f"replayed block {block.height} data hash mismatch — "
@@ -506,21 +529,11 @@ class Node:
                     for _b, sq in items
                 ]
                 # jitted roots-only: the verifier never needs the EDS
-                # bytes. Batching amortizes dispatch for small squares
-                # but loses to sequential single-square dispatches at
-                # large k where the vmapped working set pressures HBM
-                # (bench 7a/7b/7c: k=32 batched ~0.74 vs single ~1.0
-                # ms/square; k=128 batched ~7.6 vs single ~5.0) — pick
-                # per size. Only the batched path needs the contiguous
-                # stacked copy.
-                if k <= 64:
-                    rows, cols = extend_tpu.batched_roots_device(
-                        np.stack(squares)
-                    )
-                else:
-                    outs = [extend_tpu.roots_device(sq) for sq in squares]
-                    rows = np.stack([o[0] for o in outs])
-                    cols = np.stack([o[1] for o in outs])
+                # bytes. One entry point at every size: small squares
+                # ride one vmapped dispatch, large squares an async-
+                # pipelined queue of single-square dispatches (the list
+                # is passed as-is — no stacked copy at large k).
+                rows, cols = extend_tpu.batched_roots_device(squares)
                 for i, (block, _sq) in enumerate(items):
                     dah = da_pkg.DataAvailabilityHeader(
                         [r.tobytes() for r in rows[i]],
@@ -532,7 +545,7 @@ class Node:
                          backend=backend)
             else:
                 for block, sq in items:
-                    _eds, dah = app._extend_and_hash(sq)
+                    dah = app._proposal_dah(sq)
                     if dah.hash() == block.data_hash:
                         verified.add(block.height)
         return verified

@@ -493,6 +493,22 @@ def _handler_for(node: Node):
                         self._reply({"error": "not a devnet validator"}, 404)
                     else:
                         self._reply(validator.handle_commit(body))
+                elif parts == ["gossip", "have"]:
+                    # CAT want/have (specs/src/specs/cat_pool.md): a
+                    # gossiping peer offers tx KEYS; we answer with the
+                    # subset we actually want the bytes for
+                    keys = [bytes.fromhex(k) for k in body.get("keys", [])]
+                    want = [
+                        k.hex() for k in keys
+                        if not node.mempool.has_seen(k)
+                    ]
+                    self._reply({"want": want})
+                elif parts == ["consensus", "evidence"]:
+                    validator = getattr(node, "validator", None)
+                    if validator is None:
+                        self._reply({"error": "not a devnet validator"}, 404)
+                    else:
+                        self._reply(validator.handle_evidence(body))
                 else:
                     self._reply({"error": "unknown route"}, 404)
             except Exception as e:  # noqa: BLE001

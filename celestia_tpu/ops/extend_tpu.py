@@ -201,6 +201,40 @@ def extend_roots_device(shares: np.ndarray):
     return np.asarray(eds), np.asarray(rows), np.asarray(cols)
 
 
+def extend_roots_device_resident(shares: np.ndarray):
+    """(k,k,512) uint8 -> (eds_device, rows_np, cols_np).
+
+    The EDS stays a DEVICE buffer — only the tiny axis roots (2·2k·90
+    bytes) cross back to host. The node's ExtendBlock path wraps the
+    handle in a lazy ExtendedDataSquare and fetches bytes only if the
+    block store actually serves shares; the repair path consumes the
+    handle directly (ops/repair_tpu.stage_resident_repair) with no
+    host round-trip. ref: app/extend_block.go:14."""
+    k = int(shares.shape[0])
+    eds, rows, cols = _jitted_roots_for_k(k)(jnp.asarray(shares))
+    return eds, np.asarray(rows), np.asarray(cols)
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_eds_roots(k: int):
+    @jax.jit
+    def run(eds):
+        leaf_ns = _leaf_namespaces(eds[:k, :k, :NAMESPACE_SIZE], k)
+        return nmt_roots_of_eds(eds, leaf_ns)
+
+    return run
+
+
+def eds_roots_device(eds):
+    """NMT axis roots of an EXISTING (2k,2k,512) EDS (host or device
+    array) -> numpy (row_roots, col_roots). Leaf namespaces are read
+    from Q0 on device, so a device-resident EDS (repair output, extend
+    handle) is verified without fetching a single share byte."""
+    k = int(eds.shape[0]) // 2
+    rows, cols = _jitted_eds_roots(k)(jnp.asarray(eds))
+    return np.asarray(rows), np.asarray(cols)
+
+
 def extend_and_root_batched(shares: jnp.ndarray, m2: jnp.ndarray):
     """(B, k, k, 512) -> batched (eds, row_roots, col_roots, dah).
 
@@ -221,15 +255,50 @@ def _rows_cols_only(shares: jnp.ndarray, m2: jnp.ndarray):
     return rows, cols
 
 
-def roots_only_batched(shares: jnp.ndarray, m2: jnp.ndarray):
+def _batch_chunk(k: int, b: int) -> int:
+    """Concurrency width for a batched roots dispatch.
+
+    Small squares vmap the whole batch (dispatch amortization wins);
+    large squares bound the HBM working set by mapping sequentially over
+    the batch inside ONE program — a k=128 square's fused extend+hash
+    intermediates already saturate HBM bandwidth, so lanes-across-squares
+    buys nothing and the B× working set evicts everything (bench 7b
+    round 3: vmapped k=128 = 7.99 ms/square vs 5.03 single). Returns the
+    largest divisor of b not exceeding the per-size cap so reshape is
+    exact."""
+    cap = b if k <= 64 else 1
+    chunk = min(cap, b)
+    while b % chunk:
+        chunk -= 1
+    return chunk
+
+
+def roots_only_batched(shares: jnp.ndarray, m2: jnp.ndarray, chunk: int | None = None):
     """(B, k, k, 512) -> batched (row_roots, col_roots) — NO EDS output.
 
     The replay/state-sync verifier only compares DAH roots, and keeping
     B full EDS buffers (B × 32 MB at k=128) out of the program's outputs
     lets XLA treat the extended square as a consumable intermediate
-    instead of allocating and writing every byte of it to HBM
-    (bench config 7c vs 7b)."""
-    return jax.vmap(lambda s: _rows_cols_only(s, m2))(shares)
+    instead of allocating and writing every byte of it to HBM.
+
+    The batch rides lax.map over vmapped chunks of _batch_chunk(k, B)
+    squares: one dispatch regardless of size, with the HBM working set
+    bounded at chunk× a single square's — this is what makes k=128
+    batching match the single-dispatch ms/square instead of regressing.
+    """
+    b = shares.shape[0]
+    if chunk is None:
+        chunk = _batch_chunk(shares.shape[1], b)
+    if chunk >= b:
+        return jax.vmap(lambda s: _rows_cols_only(s, m2))(shares)
+    groups = shares.reshape(b // chunk, chunk, *shares.shape[1:])
+    rows, cols = jax.lax.map(
+        lambda g: jax.vmap(lambda s: _rows_cols_only(s, m2))(g), groups
+    )
+    return (
+        rows.reshape(b, *rows.shape[2:]),
+        cols.reshape(b, *cols.shape[2:]),
+    )
 
 
 @functools.lru_cache(maxsize=8)
@@ -252,12 +321,31 @@ def roots_device(shares: np.ndarray):
     return np.asarray(rows), np.asarray(cols)
 
 
-def batched_roots_device(shares: np.ndarray):
-    """Host entry for the replay verifier: (B,k,k,512) uint8 ->
-    numpy (row_roots, col_roots), jit-cached per square size."""
-    k = int(shares.shape[1])
-    rows, cols = _jitted_batched_roots(k)(jnp.asarray(shares))
-    return np.asarray(rows), np.asarray(cols)
+def batched_roots_device(shares):
+    """Host entry for the replay verifier: B squares of (k,k,512) uint8
+    (a list, or a stacked (B,k,k,512) array) -> numpy
+    (row_roots, col_roots), jit-cached per square size.
+
+    Small squares ride ONE vmapped dispatch (amortizes dispatch
+    overhead); large squares dispatch the cached single-square program
+    per item — JAX's async dispatch pipelines the queue, so wall time
+    matches the single-dispatch ms/square (bench 7b), while the vmapped
+    k=128 spelling pays HBM-working-set and gather overheads. Accepting
+    a list means the large-k branch never builds the contiguous B×8 MB
+    stacked copy it would immediately re-slice. Both branches are the
+    same `_rows_cols_only` core, so results cannot diverge."""
+    b = len(shares)
+    k = int(shares[0].shape[0])
+    if _batch_chunk(k, b) >= b:
+        stacked = shares if isinstance(shares, np.ndarray) else np.stack(shares)
+        rows, cols = _jitted_batched_roots(k)(jnp.asarray(stacked))
+        return np.asarray(rows), np.asarray(cols)
+    fn = _jitted_roots_noeds(k)
+    outs = [fn(jnp.asarray(shares[i])) for i in range(b)]  # async queue
+    return (
+        np.stack([np.asarray(r) for r, _c in outs]),
+        np.stack([np.asarray(c) for _r, c in outs]),
+    )
 
 
 def extend_and_root_device(shares: np.ndarray):

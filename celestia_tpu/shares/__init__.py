@@ -202,15 +202,26 @@ class Builder:
 # --- Padding shares (ref: pkg/shares/padding.go) ---
 
 
-def namespace_padding_share(namespace: Namespace, share_version: int) -> Share:
-    b = Builder(namespace, share_version, True)
+import functools
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_padding_share(ns_bytes: bytes, share_version: int) -> Share:
+    b = Builder(ns_pkg.from_bytes(ns_bytes), share_version, True)
     b.write_sequence_len(0)
     b.add_data(bytes(appconsts.FIRST_SPARSE_SHARE_CONTENT_SIZE))
     return b.build()
 
 
+def namespace_padding_share(namespace: Namespace, share_version: int) -> Share:
+    # Padding shares are constant per (namespace, version); Share is
+    # frozen so one cached instance serves every occurrence — a square
+    # can contain thousands of identical tail-padding shares.
+    return _cached_padding_share(namespace.bytes, share_version)
+
+
 def namespace_padding_shares(namespace: Namespace, share_version: int, n: int) -> list[Share]:
-    return [namespace_padding_share(namespace, share_version) for _ in range(n)]
+    return [namespace_padding_share(namespace, share_version)] * n
 
 
 def reserved_padding_share() -> Share:
@@ -220,7 +231,7 @@ def reserved_padding_share() -> Share:
 
 
 def reserved_padding_shares(n: int) -> list[Share]:
-    return [reserved_padding_share() for _ in range(n)]
+    return [reserved_padding_share()] * n
 
 
 def tail_padding_share() -> Share:
@@ -230,7 +241,7 @@ def tail_padding_share() -> Share:
 
 
 def tail_padding_shares(n: int) -> list[Share]:
-    return [tail_padding_share() for _ in range(n)]
+    return [tail_padding_share()] * n
 
 
 def is_power_of_two(n: int) -> bool:

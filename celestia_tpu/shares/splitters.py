@@ -68,6 +68,88 @@ class CompactShareSplitter:
         self._write(raw)
         self.share_ranges[tx_key(tx)] = Range(start, self.count())
 
+    def write_txs_bulk(self, txs: list[bytes], track_ranges: bool = True) -> None:
+        """Write ALL txs and finalize in one vectorized pass.
+
+        Byte-identical to sequential write_tx() calls followed by
+        export() (pinned by tests): the whole delimited unit stream is
+        laid into a (n_shares, 512) numpy buffer with strided writes —
+        namespace/info columns broadcast, content region reshaped from
+        the stream, reserved-byte pointers computed for every share at
+        once from the unit-start offsets. Requires a fresh splitter;
+        leaves it in exported state. This is the builder's hot path
+        (ref: pkg/square/builder.go:146-199 lays out the square per
+        block; the per-share Python loop was the round-3 bottleneck,
+        bench config 9)."""
+        if self.shares or not self.builder.is_empty_share() or self.done:
+            raise ValueError("write_txs_bulk requires a fresh splitter")
+        if not txs:
+            return
+        import numpy as np
+
+        first = appconsts.FIRST_COMPACT_SHARE_CONTENT_SIZE
+        cont = appconsts.CONTINUATION_COMPACT_SHARE_CONTENT_SIZE
+        share_size = appconsts.SHARE_SIZE
+        delimited = [uvarint(len(t)) + t for t in txs]
+        stream = b"".join(delimited)
+        total = len(stream)
+        n = 1 if total <= first else 1 + (total - first + cont - 1) // cont
+
+        buf = np.zeros((n, share_size), np.uint8)
+        buf[:, : appconsts.NAMESPACE_SIZE] = np.frombuffer(
+            self.namespace.bytes, np.uint8
+        )
+        info_col = appconsts.NAMESPACE_SIZE  # 29
+        buf[0, info_col] = (self.share_version << 1) | 1
+        if n > 1:
+            buf[1:, info_col] = self.share_version << 1
+        # sequence length (== total stream bytes) at 30..34 of share 0
+        buf[0, 30:34] = np.frombuffer(total.to_bytes(4, "big"), np.uint8)
+
+        # content regions: share 0 at byte 38 (ns+info+seqlen+reserved),
+        # continuations at byte 34 (ns+info+reserved)
+        sarr = np.frombuffer(stream, np.uint8)
+        head = sarr[:first]
+        buf[0, 38 : 38 + len(head)] = head
+        if n > 1:
+            rest = sarr[first:]
+            padded = np.zeros((n - 1) * cont, np.uint8)
+            padded[: len(rest)] = rest
+            buf[1:, 34:] = padded.reshape(n - 1, cont)
+
+        # reserved-byte pointers: in-share offset of the first unit that
+        # STARTS in each share (0 when none does)
+        lens = np.array([len(d) for d in delimited], np.int64)
+        starts = np.concatenate([[0], np.cumsum(lens)[:-1]])
+        share_of = np.where(starts < first, 0, 1 + (starts - first) // cont)
+        in_share = np.where(starts < first, 38 + starts, 34 + (starts - first) % cont)
+        ptr = np.zeros(n, np.int64)
+        with_units, first_idx = np.unique(share_of, return_index=True)
+        ptr[with_units] = in_share[first_idx]
+        buf[0, 34:38] = np.frombuffer(int(ptr[0]).to_bytes(4, "big"), np.uint8)
+        if n > 1:
+            buf[1:, 32] = ptr[1:] >> 8
+            buf[1:, 33] = ptr[1:] & 0xFF
+
+        if track_ranges:
+            # per-tx share ranges (same Range semantics as write_tx);
+            # the square builder passes False — nothing on that path
+            # reads them, and tx_key is a sha256 per tx
+            last_byte = starts + lens - 1
+            end_share = np.where(
+                last_byte < first, 0, 1 + (last_byte - first) // cont
+            )
+            for i, tx in enumerate(txs):
+                self.share_ranges[tx_key(tx)] = Range(
+                    int(share_of[i]), int(end_share[i]) + 1
+                )
+
+        raw = buf.tobytes()
+        self.shares = [
+            Share(raw[i * share_size : (i + 1) * share_size]) for i in range(n)
+        ]
+        self.done = True
+
     def _write(self, raw: bytes) -> None:
         if self.done:
             # writing after Export: re-open the last (padded) share
@@ -149,21 +231,56 @@ class SparseShareSplitter:
         self.shares: list[Share] = []
 
     def write(self, blob: blob_pkg.Blob) -> None:
-        blob.validate()
+        # inlined Blob.validate() with the namespace constructed ONCE
+        # (new_namespace validates version/id; validate() would build it
+        # a second time just to throw it away)
+        if len(blob.namespace_id) != ns_pkg.NAMESPACE_ID_SIZE:
+            raise ValueError(f"namespace id must be {ns_pkg.NAMESPACE_ID_SIZE} bytes")
+        if not blob.data:
+            raise ValueError("blob data can not be empty")
         if blob.share_version not in blob_pkg.SUPPORTED_SHARE_VERSIONS:
             raise ValueError(f"unsupported share version: {blob.share_version}")
+        namespace = ns_pkg.new_namespace(blob.namespace_version, blob.namespace_id)
+        if namespace.is_tx() or namespace.is_pay_for_blob():
+            # compact-namespace blobs (never valid in a real square, but
+            # the splitter must stay byte-compatible with the share
+            # Builder, which inserts reserved bytes for these namespaces)
+            raw: bytes | None = blob.data
+            b = Builder(namespace, blob.share_version, True)
+            b.write_sequence_len(len(blob.data))
+            while raw is not None:
+                leftover = b.add_data(raw)
+                if leftover is None:
+                    b.zero_pad_if_necessary()
+                self.shares.append(b.build())
+                b = Builder(namespace, blob.share_version, False)
+                raw = leftover
+            return
 
-        raw: bytes | None = blob.data
-        namespace = blob.namespace()
-        b = Builder(namespace, blob.share_version, True)
-        b.write_sequence_len(len(blob.data))
-        while raw is not None:
-            leftover = b.add_data(raw)
-            if leftover is None:
-                b.zero_pad_if_necessary()
-            self.shares.append(b.build())
-            b = Builder(namespace, blob.share_version, False)
-            raw = leftover
+        # Direct assembly (byte-identical to the share Builder, pinned by
+        # tests/test_shares fuzz round-trips): sparse layout is
+        #   ns ‖ info(start=1) ‖ seq_len(4) ‖ data[:F]   (first share)
+        #   ns ‖ info(start=0) ‖ data chunk of C         (continuations)
+        # with only the final share zero-padded.
+        data = blob.data
+        ns_bytes = namespace.bytes
+        first = appconsts.FIRST_SPARSE_SHARE_CONTENT_SIZE
+        cont = appconsts.CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+        prefix = (
+            ns_bytes
+            + bytes([(blob.share_version << 1) | 1])
+            + len(data).to_bytes(appconsts.SEQUENCE_LEN_BYTES, "big")
+        )
+        chunk = data[:first]
+        self.shares.append(
+            Share(prefix + chunk + bytes(first - len(chunk)))
+        )
+        cont_prefix = ns_bytes + bytes([blob.share_version << 1])
+        for pos in range(first, len(data), cont):
+            chunk = data[pos : pos + cont]
+            self.shares.append(
+                Share(cont_prefix + chunk + bytes(cont - len(chunk)))
+            )
 
     def write_namespace_padding_shares(self, count: int) -> None:
         if count < 0:
@@ -304,14 +421,12 @@ def compact_shares_needed(sequence_len: int) -> int:
 
 
 def sparse_shares_needed(sequence_len: int) -> int:
-    """ref: pkg/shares/share_sequence.go:124-141"""
+    """ref: pkg/shares/share_sequence.go:124-141 (closed form of the
+    reference's subtraction loop)"""
     if sequence_len == 0:
         return 0
-    if sequence_len < appconsts.FIRST_SPARSE_SHARE_CONTENT_SIZE:
+    first = appconsts.FIRST_SPARSE_SHARE_CONTENT_SIZE
+    if sequence_len < first:
         return 1
-    needed = 1
-    seq = sequence_len - appconsts.FIRST_SPARSE_SHARE_CONTENT_SIZE
-    while seq > 0:
-        seq -= appconsts.CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
-        needed += 1
-    return needed
+    cont = appconsts.CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+    return 1 + (sequence_len - first + cont - 1) // cont

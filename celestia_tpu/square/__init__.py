@@ -139,7 +139,7 @@ class Builder:
             tx=blob_tx.tx,
             share_indexes=_worst_case_share_indexes(len(blob_tx.blobs), self.app_version),
         )
-        size = len(blob_pkg.marshal_index_wrapper(iw.tx, iw.share_indexes))
+        size = blob_pkg.marshal_index_wrapper_size(iw.tx, iw.share_indexes)
         pfb_share_diff = self.pfb_counter.add(size)
 
         elements = [
@@ -167,12 +167,14 @@ class Builder:
 
         ss = inclusion.blob_min_square_size(self.current_size)
 
-        # stable sort by namespace preserves priority order within namespace
-        self.blobs.sort(key=lambda e: e.blob.namespace().bytes)
+        # stable sort by namespace preserves priority order within
+        # namespace; (version, id) tuple order == 29-byte namespace order
+        self.blobs.sort(
+            key=lambda e: (e.blob.namespace_version, e.blob.namespace_id)
+        )
 
         tx_writer = CompactShareSplitter(ns_pkg.TX_NAMESPACE, appconsts.SHARE_VERSION_ZERO)
-        for tx in self.txs:
-            tx_writer.write_tx(tx)
+        tx_writer.write_txs_bulk(self.txs, track_ranges=False)
 
         non_reserved_start = self.tx_counter.size() + self.pfb_counter.size()
         cursor = non_reserved_start
@@ -199,8 +201,13 @@ class Builder:
         pfb_writer = CompactShareSplitter(
             ns_pkg.PAY_FOR_BLOB_NAMESPACE, appconsts.SHARE_VERSION_ZERO
         )
-        for iw in self.pfbs:
-            pfb_writer.write_tx(blob_pkg.marshal_index_wrapper(iw.tx, iw.share_indexes))
+        pfb_writer.write_txs_bulk(
+            [
+                blob_pkg.marshal_index_wrapper(iw.tx, iw.share_indexes)
+                for iw in self.pfbs
+            ],
+            track_ranges=False,
+        )
 
         if self.pfb_counter.size() < pfb_writer.count():
             raise ValueError(

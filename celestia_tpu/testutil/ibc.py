@@ -280,6 +280,97 @@ class LightClientRelayer:
         src_node.produce_block(src_time)
         return len(packets)
 
+    def handshake(self, t_a: float, t_b: float, step: float = 15.0,
+                  port: str = PORT_ID_TRANSFER) -> tuple[str, str]:
+        """Establish a connection AND a channel purely via relayed
+        handshake messages, every step proving the counterparty's
+        recorded state with an SMT membership proof against a verified
+        header (ibc-go's ICS-3 ConnOpen* + ICS-4 ChanOpen* flow,
+        app/app.go:359-385 wiring). No direct store writes, no trusted
+        relayer. Returns (channel_id_a, channel_id_b) — packet relay
+        then runs over the connection-bound channels."""
+        from celestia_tpu.x.connection import (
+            ConnectionKeeper,
+            MsgConnectionOpenAck,
+            MsgConnectionOpenConfirm,
+            MsgConnectionOpenInit,
+            MsgConnectionOpenTry,
+            connection_key,
+        )
+        from celestia_tpu.x.ibc import (
+            MsgChannelOpenAck,
+            MsgChannelOpenConfirm,
+            MsgChannelOpenInit,
+            MsgChannelOpenTry,
+            channel_key,
+        )
+
+        a, b = self.node_a, self.node_b
+        sa, sb = self.signer_a, self.signer_b
+        client_a = self.client_on[id(a)]  # on A, tracking B
+        client_b = self.client_on[id(b)]  # on B, tracking A
+        times = {id(a): t_a, id(b): t_b}
+
+        def tick(node) -> float:
+            times[id(node)] += step
+            return times[id(node)]
+
+        def submit(node, signer, msg) -> None:
+            res = signer.submit_tx([msg])
+            if res.code != 0:
+                raise RuntimeError(
+                    f"handshake step {type(msg).__name__} failed: {res.log}"
+                )
+            node.produce_block(tick(node))
+
+        def prove(node, key: bytes):
+            _v, _root, proof = node.app.store.query_with_proof(key)
+            return proof
+
+        # ---- ICS-3 connection handshake ----
+        conn_a = ConnectionKeeper(a.app.store).next_connection_id()
+        submit(a, sa, MsgConnectionOpenInit(client_a, client_b, sa.address()))
+
+        h = self.update_client(a, b, sb, tick(b))
+        conn_b = ConnectionKeeper(b.app.store).next_connection_id()
+        submit(b, sb, MsgConnectionOpenTry(
+            client_b, client_a, conn_a,
+            prove(a, connection_key(conn_a)), h, sb.address(),
+        ))
+
+        h = self.update_client(b, a, sa, tick(a))
+        submit(a, sa, MsgConnectionOpenAck(
+            conn_a, conn_b, prove(b, connection_key(conn_b)), h, sa.address(),
+        ))
+
+        h = self.update_client(a, b, sb, tick(b))
+        submit(b, sb, MsgConnectionOpenConfirm(
+            conn_b, prove(a, connection_key(conn_a)), h, sb.address(),
+        ))
+
+        # ---- ICS-4 channel handshake over the connection ----
+        chan_a = a.app.ibc.next_channel_id()
+        submit(a, sa, MsgChannelOpenInit(port, conn_a, port, sa.address()))
+
+        h = self.update_client(a, b, sb, tick(b))
+        chan_b = b.app.ibc.next_channel_id()
+        submit(b, sb, MsgChannelOpenTry(
+            port, conn_b, port, chan_a,
+            prove(a, channel_key(port, chan_a)), h, sb.address(),
+        ))
+
+        h = self.update_client(b, a, sa, tick(a))
+        submit(a, sa, MsgChannelOpenAck(
+            port, chan_a, chan_b,
+            prove(b, channel_key(port, chan_b)), h, sa.address(),
+        ))
+
+        h = self.update_client(a, b, sb, tick(b))
+        submit(b, sb, MsgChannelOpenConfirm(
+            port, chan_b, prove(a, channel_key(port, chan_a)), h, sb.address(),
+        ))
+        return chan_a, chan_b
+
     def timeout(self, packet, src_node, dst_node, src_signer,
                 src_time: float) -> None:
         """Refund a timed-out packet the honest way: verified header past

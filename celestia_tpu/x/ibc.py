@@ -48,6 +48,10 @@ ACK_PREFIX = b"ibc/ack/"
 PACKET_PREFIX = b"ibc/packet/"  # full packet JSON, for relayers/queries
 RELAYER_PREFIX = b"ibc/relayer/"  # authorized relayer accounts
 
+CHANNEL_COUNTER_KEY = b"ibc/channel/nextSequence"
+
+CHANNEL_STATE_INIT = "INIT"
+CHANNEL_STATE_TRYOPEN = "TRYOPEN"
 CHANNEL_STATE_OPEN = "OPEN"
 CHANNEL_STATE_CLOSED = "CLOSED"
 
@@ -59,11 +63,16 @@ class Channel:
     counterparty_port_id: str
     counterparty_channel_id: str
     state: str = CHANNEL_STATE_OPEN
-    # 02-client binding: when set, packet messages on this channel must
-    # carry proofs verified by this light client (x/lightclient.py).
-    # Empty = legacy trusted-relayer substrate. (Divergence from ibc-go:
-    # no 03-connection indirection — the channel binds its client.)
+    # Trust binding, one of:
+    # - connection_id set (ibc-go's model): the channel was established
+    #   by the ICS-4 handshake over an ICS-3 connection; packet proofs
+    #   verify against the connection's client.
+    # - client_id set: direct client binding (shortcut for tests that
+    #   skip the handshake, kept for compatibility).
+    # - neither: legacy trusted-relayer substrate (documented weaker
+    #   trust; packet messages require relayer registration).
     client_id: str = ""
+    connection_id: str = ""
 
     def marshal(self) -> bytes:
         return json.dumps(dataclasses.asdict(self), sort_keys=True).encode()
@@ -151,6 +160,28 @@ def _unmarshal_proof(raw: bytes):
     from celestia_tpu import smt as smt_mod
 
     return smt_mod.Proof.unmarshal(json.loads(raw))
+
+
+def parse_handshake_fields(raw: bytes, str_tags, proof_tag: int,
+                           height_tag: int):
+    """Shared wire parser for the ICS-3/ICS-4 handshake messages: a set
+    of string fields plus an optional (proof, height) pair. Returns
+    ({tag: str}, proof | None, height)."""
+    from celestia_tpu.blob import _parse_fields, _require_wt
+
+    s = {t: "" for t in str_tags}
+    proof, height = None, 0
+    for tag, wt, val in _parse_fields(raw):
+        if tag in s:
+            _require_wt(wt, 2, tag)
+            s[tag] = bytes(val).decode()
+        elif tag == proof_tag:
+            _require_wt(wt, 2, tag)
+            proof = _unmarshal_proof(bytes(val))
+        elif tag == height_tag:
+            _require_wt(wt, 0, tag)
+            height = val
+    return s, proof, height
 
 
 def _register_packet_msgs():
@@ -338,6 +369,193 @@ def _register_packet_msgs():
 MsgRecvPacket, MsgAcknowledgement, MsgTimeout = _register_packet_msgs()
 
 
+URL_MSG_CHANNEL_OPEN_INIT = "/ibc.core.channel.v1.MsgChannelOpenInit"
+URL_MSG_CHANNEL_OPEN_TRY = "/ibc.core.channel.v1.MsgChannelOpenTry"
+URL_MSG_CHANNEL_OPEN_ACK = "/ibc.core.channel.v1.MsgChannelOpenAck"
+URL_MSG_CHANNEL_OPEN_CONFIRM = "/ibc.core.channel.v1.MsgChannelOpenConfirm"
+
+
+def _register_channel_msgs():
+    from celestia_tpu.blob import _field_bytes, _field_uint
+    from celestia_tpu.tx import register_msg
+
+    _strings = parse_handshake_fields
+
+    @register_msg(URL_MSG_CHANNEL_OPEN_INIT)
+    @dataclasses.dataclass
+    class MsgChannelOpenInit:
+        """Open a channel INIT end over a connection (ibc-go
+        MsgChannelOpenInit; channel id assigned server-side)."""
+
+        port_id: str
+        connection_id: str
+        counterparty_port_id: str
+        signer: str
+
+        def get_signers(self) -> list[str]:
+            return [self.signer]
+
+        def marshal(self) -> bytes:
+            return (
+                _field_bytes(1, self.port_id.encode())
+                + _field_bytes(2, self.connection_id.encode())
+                + _field_bytes(3, self.counterparty_port_id.encode())
+                + _field_bytes(4, self.signer.encode())
+            )
+
+        @classmethod
+        def unmarshal(cls, raw: bytes) -> "MsgChannelOpenInit":
+            s, _p, _h = _strings(raw, (1, 2, 3, 4), 0, 0)
+            return cls(s[1], s[2], s[3], s[4])
+
+        def validate_basic(self) -> None:
+            if not self.port_id or not self.connection_id:
+                raise ValueError("missing port/connection id")
+            if not self.counterparty_port_id:
+                raise ValueError("missing counterparty port id")
+            if not self.signer:
+                raise ValueError("missing signer")
+
+    @register_msg(URL_MSG_CHANNEL_OPEN_TRY)
+    @dataclasses.dataclass
+    class MsgChannelOpenTry:
+        """TRYOPEN with proof of the counterparty's INIT channel end."""
+
+        port_id: str
+        connection_id: str
+        counterparty_port_id: str
+        counterparty_channel_id: str
+        proof_init: object
+        proof_height: int
+        signer: str
+
+        def get_signers(self) -> list[str]:
+            return [self.signer]
+
+        def marshal(self) -> bytes:
+            return (
+                _field_bytes(1, self.port_id.encode())
+                + _field_bytes(2, self.connection_id.encode())
+                + _field_bytes(3, self.counterparty_port_id.encode())
+                + _field_bytes(4, self.counterparty_channel_id.encode())
+                + _field_bytes(5, _marshal_proof(self.proof_init))
+                + _field_uint(6, self.proof_height)
+                + _field_bytes(7, self.signer.encode())
+            )
+
+        @classmethod
+        def unmarshal(cls, raw: bytes) -> "MsgChannelOpenTry":
+            s, proof, height = _strings(raw, (1, 2, 3, 4, 7), 5, 6)
+            if proof is None:
+                raise ValueError("MsgChannelOpenTry without proof")
+            return cls(s[1], s[2], s[3], s[4], proof, height, s[7])
+
+        def validate_basic(self) -> None:
+            if not self.port_id or not self.connection_id:
+                raise ValueError("missing port/connection id")
+            if not self.counterparty_port_id or not self.counterparty_channel_id:
+                raise ValueError("missing counterparty ids")
+            if self.proof_height <= 0:
+                raise ValueError("proof without proof height")
+            if not self.signer:
+                raise ValueError("missing signer")
+
+    @register_msg(URL_MSG_CHANNEL_OPEN_ACK)
+    @dataclasses.dataclass
+    class MsgChannelOpenAck:
+        """INIT → OPEN with proof of the counterparty's TRYOPEN end."""
+
+        port_id: str
+        channel_id: str
+        counterparty_channel_id: str
+        proof_try: object
+        proof_height: int
+        signer: str
+
+        def get_signers(self) -> list[str]:
+            return [self.signer]
+
+        def marshal(self) -> bytes:
+            return (
+                _field_bytes(1, self.port_id.encode())
+                + _field_bytes(2, self.channel_id.encode())
+                + _field_bytes(3, self.counterparty_channel_id.encode())
+                + _field_bytes(4, _marshal_proof(self.proof_try))
+                + _field_uint(5, self.proof_height)
+                + _field_bytes(6, self.signer.encode())
+            )
+
+        @classmethod
+        def unmarshal(cls, raw: bytes) -> "MsgChannelOpenAck":
+            s, proof, height = _strings(raw, (1, 2, 3, 6), 4, 5)
+            if proof is None:
+                raise ValueError("MsgChannelOpenAck without proof")
+            return cls(s[1], s[2], s[3], proof, height, s[6])
+
+        def validate_basic(self) -> None:
+            if not self.port_id or not self.channel_id:
+                raise ValueError("missing port/channel id")
+            if not self.counterparty_channel_id:
+                raise ValueError("missing counterparty channel id")
+            if self.proof_height <= 0:
+                raise ValueError("proof without proof height")
+            if not self.signer:
+                raise ValueError("missing signer")
+
+    @register_msg(URL_MSG_CHANNEL_OPEN_CONFIRM)
+    @dataclasses.dataclass
+    class MsgChannelOpenConfirm:
+        """TRYOPEN → OPEN with proof of the counterparty's OPEN end."""
+
+        port_id: str
+        channel_id: str
+        proof_ack: object
+        proof_height: int
+        signer: str
+
+        def get_signers(self) -> list[str]:
+            return [self.signer]
+
+        def marshal(self) -> bytes:
+            return (
+                _field_bytes(1, self.port_id.encode())
+                + _field_bytes(2, self.channel_id.encode())
+                + _field_bytes(3, _marshal_proof(self.proof_ack))
+                + _field_uint(4, self.proof_height)
+                + _field_bytes(5, self.signer.encode())
+            )
+
+        @classmethod
+        def unmarshal(cls, raw: bytes) -> "MsgChannelOpenConfirm":
+            s, proof, height = _strings(raw, (1, 2, 5), 3, 4)
+            if proof is None:
+                raise ValueError("MsgChannelOpenConfirm without proof")
+            return cls(s[1], s[2], proof, height, s[5])
+
+        def validate_basic(self) -> None:
+            if not self.port_id or not self.channel_id:
+                raise ValueError("missing port/channel id")
+            if self.proof_height <= 0:
+                raise ValueError("proof without proof height")
+            if not self.signer:
+                raise ValueError("missing signer")
+
+    return (
+        MsgChannelOpenInit,
+        MsgChannelOpenTry,
+        MsgChannelOpenAck,
+        MsgChannelOpenConfirm,
+    )
+
+
+(
+    MsgChannelOpenInit,
+    MsgChannelOpenTry,
+    MsgChannelOpenAck,
+    MsgChannelOpenConfirm,
+) = _register_channel_msgs()
+
+
 def _chan_key(prefix: bytes, port_id: str, channel_id: str) -> bytes:
     return prefix + port_id.encode() + b"/" + channel_id.encode()
 
@@ -349,6 +567,12 @@ def _seq_key(prefix: bytes, port_id: str, channel_id: str, seq: int) -> bytes:
 # Public proof paths (23-commitment key scheme): both chains run this
 # framework, so a verifier can reconstruct the exact store key the
 # counterparty used and check the SMT proof against its app hash.
+
+def channel_key(port_id: str, channel_id: str) -> bytes:
+    """Proof path of a stored Channel — the ICS-4 handshake proves the
+    counterparty's channel end under this key."""
+    return _chan_key(CHANNEL_PREFIX, port_id, channel_id)
+
 
 def packet_commitment_key(port_id: str, channel_id: str, seq: int) -> bytes:
     return _seq_key(COMMITMENT_PREFIX, port_id, channel_id, seq)
@@ -398,6 +622,166 @@ class ChannelKeeper:
         )
         self.set_channel(ch)
         return ch
+
+    # --- ICS-4 channel handshake (over an ICS-3 connection) ---
+
+    def _next_channel_id(self) -> str:
+        raw = self.store.get(CHANNEL_COUNTER_KEY)
+        seq = int.from_bytes(raw, "big") if raw else 0
+        self.store.set(CHANNEL_COUNTER_KEY, (seq + 1).to_bytes(8, "big"))
+        return f"channel-{seq}"
+
+    def next_channel_id(self) -> str:
+        raw = self.store.get(CHANNEL_COUNTER_KEY)
+        return f"channel-{int.from_bytes(raw, 'big') if raw else 0}"
+
+    def _connections(self):
+        from celestia_tpu.x.connection import ConnectionKeeper
+
+        return ConnectionKeeper(self.store)
+
+    def chan_open_init(
+        self, port_id: str, connection_id: str, counterparty_port_id: str
+    ) -> Channel:
+        """ChanOpenInit: record our INIT end over an OPEN connection
+        (ibc-go 04-channel ChanOpenInit; channel id assigned
+        server-side)."""
+        self._connections().require_open(connection_id)
+        ch = Channel(
+            port_id=port_id,
+            channel_id=self._next_channel_id(),
+            counterparty_port_id=counterparty_port_id,
+            counterparty_channel_id="",
+            state=CHANNEL_STATE_INIT,
+            connection_id=connection_id,
+        )
+        self.set_channel(ch)
+        return ch
+
+    def chan_open_try(
+        self,
+        port_id: str,
+        connection_id: str,
+        counterparty_port_id: str,
+        counterparty_channel_id: str,
+        proof_init,
+        proof_height: int,
+    ) -> Channel:
+        """ChanOpenTry: verify the counterparty recorded the matching
+        INIT channel end (under ITS connection — the other end of ours),
+        then record our TRYOPEN end."""
+        conn = self._connections().require_open(connection_id)
+        expected = Channel(
+            port_id=counterparty_port_id,
+            channel_id=counterparty_channel_id,
+            counterparty_port_id=port_id,
+            counterparty_channel_id="",
+            state=CHANNEL_STATE_INIT,
+            connection_id=conn.counterparty_connection_id,
+        )
+        self._clients().verify_membership(
+            conn.client_id,
+            proof_height,
+            channel_key(counterparty_port_id, counterparty_channel_id),
+            expected.marshal(),
+            proof_init,
+        )
+        ch = Channel(
+            port_id=port_id,
+            channel_id=self._next_channel_id(),
+            counterparty_port_id=counterparty_port_id,
+            counterparty_channel_id=counterparty_channel_id,
+            state=CHANNEL_STATE_TRYOPEN,
+            connection_id=connection_id,
+        )
+        self.set_channel(ch)
+        return ch
+
+    def chan_open_ack(
+        self,
+        port_id: str,
+        channel_id: str,
+        counterparty_channel_id: str,
+        proof_try,
+        proof_height: int,
+    ) -> Channel:
+        """ChanOpenAck: our INIT end opens after verifying the
+        counterparty's TRYOPEN end references this very channel."""
+        ch = self.get_channel(port_id, channel_id)
+        if ch is None:
+            raise ValueError(f"unknown channel {port_id}/{channel_id}")
+        if ch.state != CHANNEL_STATE_INIT:
+            raise ValueError(
+                f"channel {port_id}/{channel_id} is {ch.state}, expected INIT"
+            )
+        conn = self._connections().require_open(ch.connection_id)
+        expected = Channel(
+            port_id=ch.counterparty_port_id,
+            channel_id=counterparty_channel_id,
+            counterparty_port_id=port_id,
+            counterparty_channel_id=channel_id,
+            state=CHANNEL_STATE_TRYOPEN,
+            connection_id=conn.counterparty_connection_id,
+        )
+        self._clients().verify_membership(
+            conn.client_id,
+            proof_height,
+            channel_key(ch.counterparty_port_id, counterparty_channel_id),
+            expected.marshal(),
+            proof_try,
+        )
+        ch.counterparty_channel_id = counterparty_channel_id
+        ch.state = CHANNEL_STATE_OPEN
+        self.set_channel(ch)
+        return ch
+
+    def chan_open_confirm(
+        self, port_id: str, channel_id: str, proof_ack, proof_height: int
+    ) -> Channel:
+        """ChanOpenConfirm: our TRYOPEN end opens after verifying the
+        counterparty's end is OPEN and bound to us."""
+        ch = self.get_channel(port_id, channel_id)
+        if ch is None:
+            raise ValueError(f"unknown channel {port_id}/{channel_id}")
+        if ch.state != CHANNEL_STATE_TRYOPEN:
+            raise ValueError(
+                f"channel {port_id}/{channel_id} is {ch.state}, "
+                "expected TRYOPEN"
+            )
+        conn = self._connections().require_open(ch.connection_id)
+        expected = Channel(
+            port_id=ch.counterparty_port_id,
+            channel_id=ch.counterparty_channel_id,
+            counterparty_port_id=port_id,
+            counterparty_channel_id=channel_id,
+            state=CHANNEL_STATE_OPEN,
+            connection_id=conn.counterparty_connection_id,
+        )
+        self._clients().verify_membership(
+            conn.client_id,
+            proof_height,
+            channel_key(ch.counterparty_port_id, ch.counterparty_channel_id),
+            expected.marshal(),
+            proof_ack,
+        )
+        ch.state = CHANNEL_STATE_OPEN
+        self.set_channel(ch)
+        return ch
+
+    def _clients(self):
+        from celestia_tpu.x.lightclient import ClientKeeper
+
+        return ClientKeeper(self.store)
+
+    def client_for_channel(self, ch: Channel) -> str:
+        """The light client packet proofs verify against: the channel's
+        direct client binding, else its connection's client, else ""
+        (legacy trusted-relayer substrate)."""
+        if ch.client_id:
+            return ch.client_id
+        if ch.connection_id:
+            return self._connections().require_open(ch.connection_id).client_id
+        return ""
 
     # --- relayer authorization (stand-in for commitment proofs) ---
 

@@ -29,14 +29,25 @@ commitment (celestia_tpu.smt) and secp256k1 validator keys
   Both chains run this framework, so store key schemes agree; the
   channel keeper's commitment/receipt/ack keys are the proof paths.
 
+Trust-window semantics (ibc-go parity):
+- each ClientState carries a `trusting_period`; `update_client` rejects
+  headers once the latest verified consensus state is older than it
+  (status Expired) — the long-range-attack guard;
+- `submit_misbehaviour` verifies each conflicting header against the
+  valset trusted at ITS height (stored epoch history), so equivocation
+  inside an earlier trusted epoch still freezes the client after later
+  valset rotations.
+
 Divergences from ibc-go (documented, deliberate):
-- no 03-connection layer: `Channel.client_id` binds the channel to its
-  client directly (the handshake machinery adds no DA capability here);
 - the header carries the full next validator set instead of a
   NextValidatorsHash + later reveal — same trust result, one fewer
   indirection;
 - update rule is >2/3 of *trusted* power (adjacent-style), so there is
-  no skipping trust-level parameter.
+  no skipping trust-level parameter;
+- no per-client max-clock-drift parameter: header time must be strictly
+  newer than the latest consensus state, but future-dated headers are
+  not bounded (both chains here run this framework's consensus with
+  shared wall clocks).
 """
 
 from __future__ import annotations
@@ -49,11 +60,23 @@ from celestia_tpu.crypto import verify_signature
 
 CLIENT_STATE_PREFIX = b"ibc/client/state/"
 CONSENSUS_STATE_PREFIX = b"ibc/client/consensus/"
+VALSET_PREFIX = b"ibc/client/valset/"
 CLIENT_COUNTER_KEY = b"ibc/client/nextSequence"
 CLIENT_TYPE = "07-tendermint"
 
 TRUST_NUMERATOR = 2
 TRUST_DENOMINATOR = 3
+
+# ibc-go 07-tendermint TrustingPeriod: updates are rejected once the
+# latest verified consensus state is older than this — validators who
+# unbonded on the counterparty but kept their keys can otherwise advance
+# a stale client to a forged state (the long-range attack). 14 days,
+# matching the common production choice of 2/3 of a 21-day unbonding.
+DEFAULT_TRUSTING_PERIOD = 14 * 24 * 3600.0
+
+# the app's consensus block-time key (celestia_tpu.x.bank.BLOCK_TIME_KEY;
+# duplicated literal to keep this module import-cycle-free)
+_BLOCK_TIME_KEY = b"ctx/blockTime"
 
 
 @dataclasses.dataclass
@@ -142,6 +165,7 @@ class ClientState:
     latest_height: int
     validators: list[ValidatorInfo]  # trusted set for the next update
     frozen: bool = False
+    trusting_period: float = DEFAULT_TRUSTING_PERIOD
 
     def marshal(self) -> bytes:
         return json.dumps(
@@ -151,6 +175,7 @@ class ClientState:
                 "latest_height": self.latest_height,
                 "validators": [v.to_json() for v in self.validators],
                 "frozen": self.frozen,
+                "trusting_period": self.trusting_period,
             },
             sort_keys=True,
         ).encode()
@@ -164,6 +189,9 @@ class ClientState:
             latest_height=int(d["latest_height"]),
             validators=[ValidatorInfo.from_json(v) for v in d["validators"]],
             frozen=bool(d["frozen"]),
+            trusting_period=float(
+                d.get("trusting_period", DEFAULT_TRUSTING_PERIOD)
+            ),
         )
 
 
@@ -197,6 +225,10 @@ def _consensus_key(client_id: str, height: int) -> bytes:
         + b"/"
         + height.to_bytes(8, "big")
     )
+
+
+def _valset_key(client_id: str, height: int) -> bytes:
+    return VALSET_PREFIX + client_id.encode() + b"/" + height.to_bytes(8, "big")
 
 
 def verify_commit(
@@ -393,7 +425,11 @@ class ClientKeeper:
 
     # --- client lifecycle ---
 
-    def create_client(self, initial: Header) -> ClientState:
+    def create_client(
+        self,
+        initial: Header,
+        trusting_period: float = DEFAULT_TRUSTING_PERIOD,
+    ) -> ClientState:
         """Create a client from an initial trusted header (the social
         genesis trust assumption every light client starts from —
         ibc-go MsgCreateClient with an initial consensus state).
@@ -412,17 +448,21 @@ class ClientKeeper:
         seq = int.from_bytes(seq_raw, "big") if seq_raw else 0
         client_id = f"{CLIENT_TYPE}-{seq}"
         self.store.set(CLIENT_COUNTER_KEY, (seq + 1).to_bytes(8, "big"))
+        if trusting_period <= 0:
+            raise ValueError("trusting period must be positive")
         cs = ClientState(
             client_id=client_id,
             chain_id=initial.chain_id,
             latest_height=initial.height,
             validators=list(initial.validators),
+            trusting_period=trusting_period,
         )
         self._set_client(cs)
         self.store.set(
             _consensus_key(client_id, initial.height),
             ConsensusState(initial.app_hash, initial.time).marshal(),
         )
+        self._store_valset(client_id, initial.height, initial.validators)
         return cs
 
     def next_client_id(self) -> str:
@@ -452,14 +492,100 @@ class ClientKeeper:
             raise ValueError(f"client {client_id} is frozen for misbehaviour")
         return cs
 
+    def _store_valset(
+        self, client_id: str, height: int, validators: list[ValidatorInfo]
+    ) -> None:
+        """Record the valset ADOPTED at a verified height — the epoch
+        history misbehaviour verification consults (ibc-go keeps the
+        analogous data as per-height consensus states with
+        NextValidatorsHash)."""
+        self.store.set(
+            _valset_key(client_id, height),
+            json.dumps([v.to_json() for v in validators], sort_keys=True).encode(),
+        )
+
+    def _valset_for_height(
+        self, cs: ClientState, height: int
+    ) -> list[ValidatorInfo]:
+        """The trusted set that verifies a header AT `height`: the valset
+        adopted at the greatest verified height strictly below it (an
+        update to height h is checked against exactly that set), falling
+        back to the current set for heights beyond the latest epoch.
+        Only the winning epoch is decoded (iter_prefix is key-sorted)."""
+        best_raw: bytes | None = None
+        prefix = VALSET_PREFIX + cs.client_id.encode() + b"/"
+        for key, raw in self.store.iter_prefix(prefix):
+            h = int.from_bytes(key[len(prefix):], "big")
+            if h < height:
+                best_raw = raw
+            else:
+                break
+        if best_raw is None:
+            return list(cs.validators)
+        return [ValidatorInfo.from_json(v) for v in json.loads(best_raw)]
+
+    def _prune_expired_epochs(self, cs: ClientState, now: float) -> None:
+        """Drop consensus states (and their valset epochs) that have
+        aged past the trusting period — they can no longer anchor any
+        proof or misbehaviour check the client would accept, so keeping
+        them is unbounded state growth (ibc-go prunes expired consensus
+        states the same way). The LATEST state is always kept."""
+        cons_prefix = CONSENSUS_STATE_PREFIX + cs.client_id.encode() + b"/"
+        for key, raw in self.store.iter_prefix(cons_prefix):
+            h = int.from_bytes(key[len(cons_prefix):], "big")
+            if h >= cs.latest_height:
+                break
+            cons = ConsensusState.unmarshal(raw)
+            if now - cons.timestamp > cs.trusting_period:
+                self.store.delete(key)
+                self.store.delete(_valset_key(cs.client_id, h))
+
+    def _block_now(self, now: float | None) -> float | None:
+        """Current consensus time for expiry checks: the caller's value,
+        else the app's committed block time, else None (direct keeper use
+        outside a block context — no clock to expire against)."""
+        if now is not None:
+            return now
+        raw = self.store.get(_BLOCK_TIME_KEY)
+        if raw:
+            try:
+                return float(raw.decode())
+            except ValueError:
+                return None
+        return None
+
     # --- update path ---
 
-    def update_client(self, client_id: str, signed: SignedHeader) -> ClientState:
+    def update_client(
+        self, client_id: str, signed: SignedHeader, now: float | None = None
+    ) -> ClientState:
         """Sequential header verification (07-tendermint CheckHeaderAnd
-        UpdateState): chain id match, height advance, > 2/3 trusted power
-        signed; then adopt the header's valset and consensus state."""
+        UpdateState): client not expired, chain id match, height advance,
+        monotonic header time, > 2/3 trusted power signed; then adopt the
+        header's valset and consensus state.
+
+        Expiry (ibc-go TrustingPeriod / status-Expired): when the latest
+        verified consensus state is older than the client's
+        trusting_period at `now` (consensus block time), the update is
+        rejected — otherwise validators who have since unbonded on the
+        counterparty but kept their keys could advance the stale client
+        to a forged state (the long-range attack). An expired client can
+        only be replaced by creating a new one from a fresh social-trust
+        header (ibc-go requires a governance client substitution)."""
         cs = self._require_active(client_id)
         header = signed.header
+        latest_cons = self.get_consensus_state(client_id, cs.latest_height)
+        t = self._block_now(now)
+        if (
+            t is not None
+            and latest_cons is not None
+            and t - latest_cons.timestamp > cs.trusting_period
+        ):
+            raise ValueError(
+                f"client {client_id} is expired: latest consensus state is "
+                f"{t - latest_cons.timestamp:.0f}s old, trusting period "
+                f"{cs.trusting_period:.0f}s"
+            )
         if header.chain_id != cs.chain_id:
             raise ValueError(
                 f"header chain id {header.chain_id!r} does not match "
@@ -469,6 +595,10 @@ class ClientKeeper:
             raise ValueError(
                 f"header height {header.height} is not newer than the "
                 f"client's latest {cs.latest_height}"
+            )
+        if latest_cons is not None and header.time <= latest_cons.timestamp:
+            raise ValueError(
+                "header time is not newer than the latest consensus state"
             )
         if not header.validators:
             raise ValueError("header carries no validator set")
@@ -480,13 +610,20 @@ class ClientKeeper:
             _consensus_key(client_id, header.height),
             ConsensusState(header.app_hash, header.time).marshal(),
         )
+        self._store_valset(client_id, header.height, header.validators)
+        self._prune_expired_epochs(cs, t if t is not None else header.time)
         return cs
 
     def submit_misbehaviour(
         self, client_id: str, a: SignedHeader, b: SignedHeader
     ) -> ClientState:
         """Freeze on two validly-signed conflicting headers at one height
-        (equivocation — 02-client misbehaviour)."""
+        (equivocation — 02-client misbehaviour).
+
+        Each header is verified against the valset trusted AT ITS OWN
+        height (the stored epoch history, ibc-go's per-trusted-height
+        check) — evidence of equivocation inside an earlier trusted epoch
+        freezes the client even after later updates rotated the set."""
         cs = self._require_active(client_id)
         if a.header.height != b.header.height:
             raise ValueError("misbehaviour headers are at different heights")
@@ -494,8 +631,9 @@ class ClientKeeper:
             raise ValueError("misbehaviour header chain id mismatch")
         if a.header.sign_bytes() == b.header.sign_bytes():
             raise ValueError("headers are identical — no conflict")
-        verify_commit(cs.validators, a.header, a.signatures)
-        verify_commit(cs.validators, b.header, b.signatures)
+        trusted = self._valset_for_height(cs, a.header.height)
+        verify_commit(trusted, a.header, a.signatures)
+        verify_commit(trusted, b.header, b.signatures)
         cs.frozen = True
         self._set_client(cs)
         return cs

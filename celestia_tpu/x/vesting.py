@@ -5,6 +5,9 @@ Supports the two schedule shapes celestia uses:
 
 - ContinuousVestingAccount: coins unlock linearly between start and end
 - DelayedVestingAccount: everything unlocks at end_time
+- PeriodicVestingAccount: coins unlock in discrete tranches — a list of
+  (length_seconds, amount) periods starting at start_time; a tranche
+  vests when its cumulative end time passes
 
 Locked (still-vesting) coins cannot be TRANSFERRED; they can be delegated
 (sdk semantics — staking locked coins is explicitly allowed). Enforcement
@@ -30,10 +33,23 @@ class VestingSchedule:
     start_time: float
     end_time: float
     delayed: bool = False  # True = DelayedVesting, False = Continuous
+    # PeriodicVestingAccount: [(length_seconds, amount), …] from
+    # start_time; when set it overrides the continuous/delayed shapes
+    # (sum of amounts == original_vesting, validated at creation)
+    periods: list | None = None
 
     def locked(self, now: float) -> int:
         """Still-vesting (untransferable) amount at time `now`.
-        ref: vesting types LockedCoins."""
+        ref: vesting types LockedCoins (continuous/delayed/periodic)."""
+        if self.periods is not None:
+            t = self.start_time
+            vested = 0
+            for length, amount in self.periods:
+                t += float(length)
+                if now < t:
+                    break
+                vested += int(amount)
+            return self.original_vesting - vested
         if now >= self.end_time:
             return 0
         if self.delayed:
@@ -50,7 +66,10 @@ class VestingSchedule:
 
     @classmethod
     def unmarshal(cls, raw: bytes) -> "VestingSchedule":
-        return cls(**json.loads(raw))
+        d = json.loads(raw)
+        if d.get("periods") is not None:
+            d["periods"] = [(float(ln), int(amt)) for ln, amt in d["periods"]]
+        return cls(**d)
 
 
 class VestingKeeper:
@@ -112,7 +131,47 @@ class VestingKeeper:
         )
 
 
+    def create_periodic_vesting_account(
+        self, ctx, funder: str, to_address: str, periods: list,
+    ) -> None:
+        """ref: vesting msg_server CreatePeriodicVestingAccount: fresh
+        target account; total = sum of tranche amounts, all locked at
+        creation; tranche i vests at start + Σ lengths[0..i]."""
+        from celestia_tpu.x.auth import AccountKeeper
+
+        if not periods:
+            raise ValueError("periodic vesting needs at least one period")
+        total = 0
+        for length, amount in periods:
+            if float(length) <= 0:
+                raise ValueError("vesting period length must be positive")
+            if int(amount) <= 0:
+                raise ValueError("vesting period amount must be positive")
+            total += int(amount)
+        accounts = AccountKeeper(self.store)
+        if accounts.get_account(to_address) is not None:
+            raise ValueError(f"account {to_address} already exists")
+        if self.get_schedule(to_address) is not None:
+            raise ValueError(f"account {to_address} already has a schedule")
+        self.bank.send(funder, to_address, total)
+        accounts.get_or_create(to_address)
+        start = ctx.block_time
+        self.store.set(
+            VESTING_PREFIX + to_address.encode(),
+            VestingSchedule(
+                address=to_address,
+                original_vesting=total,
+                start_time=start,
+                end_time=start + sum(float(ln) for ln, _a in periods),
+                periods=[(float(ln), int(amt)) for ln, amt in periods],
+            ).marshal(),
+        )
+
+
 URL_MSG_CREATE_VESTING_ACCOUNT = "/cosmos.vesting.v1beta1.MsgCreateVestingAccount"
+URL_MSG_CREATE_PERIODIC_VESTING_ACCOUNT = (
+    "/cosmos.vesting.v1beta1.MsgCreatePeriodicVestingAccount"
+)
 
 
 @register_msg(URL_MSG_CREATE_VESTING_ACCOUNT)
@@ -160,3 +219,55 @@ class MsgCreateVestingAccount:
             raise ValueError("from and to addresses required")
         if self.amount <= 0:
             raise ValueError("vesting amount must be positive")
+
+
+@register_msg(URL_MSG_CREATE_PERIODIC_VESTING_ACCOUNT)
+@dataclasses.dataclass
+class MsgCreatePeriodicVestingAccount:
+    """ref: cosmos.vesting.v1beta1.MsgCreatePeriodicVestingAccount
+    (wired through app/app.go:154's vesting module)."""
+
+    from_address: str
+    to_address: str
+    periods: list  # [(length_seconds, amount), …]
+
+    def get_signers(self) -> list[str]:
+        return [self.from_address]
+
+    def marshal(self) -> bytes:
+        return (
+            _field_bytes(1, self.from_address.encode())
+            + _field_bytes(2, self.to_address.encode())
+            + _field_bytes(
+                3,
+                json.dumps(
+                    [[float(ln), int(amt)] for ln, amt in self.periods],
+                    sort_keys=True,
+                    separators=(",", ":"),
+                ).encode(),
+            )
+        )
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgCreatePeriodicVestingAccount":
+        m = cls("", "", [])
+        for tag, wt, val in _parse_fields(raw):
+            _require_wt(wt, 2, tag)
+            if tag == 1:
+                m.from_address = bytes(val).decode()
+            elif tag == 2:
+                m.to_address = bytes(val).decode()
+            elif tag == 3:
+                m.periods = [
+                    (float(ln), int(amt)) for ln, amt in json.loads(bytes(val))
+                ]
+        return m
+
+    def validate_basic(self) -> None:
+        if not self.from_address or not self.to_address:
+            raise ValueError("from and to addresses required")
+        if not self.periods:
+            raise ValueError("at least one vesting period required")
+        for length, amount in self.periods:
+            if float(length) <= 0 or int(amount) <= 0:
+                raise ValueError("vesting periods must have positive length and amount")

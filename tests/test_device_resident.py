@@ -1,0 +1,199 @@
+"""Device-resident EDS flow: roots-only proposals, lazy fetch, repair
+from the device handle, chunked batched roots, bulk compact splitter.
+
+These pin the round-4 wall-clock changes (VERDICT r3 items 1-4): the
+proposal path must never materialize the EDS on host, ExtendBlock's EDS
+must stay a device buffer until shares are actually served, and repair
+must be able to consume the extend handle without a host round-trip —
+all byte-identical to the host oracles.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from celestia_tpu import da
+from celestia_tpu import namespace as ns
+from celestia_tpu.da import repair as repair_mod
+from celestia_tpu.ops import extend_tpu, repair_tpu
+
+
+def _square(k: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    flat = rng.integers(0, 256, size=(k * k, 512), dtype=np.uint8)
+    subs = sorted(rng.integers(0, 200, size=(k * k, 10), dtype=np.uint8).tolist())
+    for i, sub in enumerate(subs):
+        flat[i, :29] = np.frombuffer(ns.new_v0(bytes(sub)).bytes, dtype=np.uint8)
+    return flat.reshape(k, k, 512)
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    sq = _square(8)
+    eds = da.extend_shares(sq)
+    dah = da.new_data_availability_header(eds)
+    return sq, eds, dah
+
+
+class TestDeviceResidentExtend:
+    def test_resident_handle_matches_host(self, oracle):
+        sq, eds, dah = oracle
+        eds_dev, rows, cols = extend_tpu.extend_roots_device_resident(sq)
+        assert [r.tobytes() for r in rows] == dah.row_roots
+        assert [c.tobytes() for c in cols] == dah.column_roots
+        assert np.array_equal(np.asarray(eds_dev), eds.data)
+
+    def test_lazy_eds_fetches_once(self, oracle):
+        sq, eds, _ = oracle
+        eds_dev, _r, _c = extend_tpu.extend_roots_device_resident(sq)
+        lazy = da.ExtendedDataSquare.from_device(eds_dev, 8)
+        assert lazy.device_data is not None
+        first = lazy.data
+        assert np.array_equal(first, eds.data)
+        assert lazy.data is first  # cached, not re-fetched
+        # API parity with host-backed squares
+        assert lazy.row(0) == eds.row(0)
+        assert lazy.row_roots() == eds.row_roots()
+
+    def test_eds_roots_device_of_existing_square(self, oracle):
+        _sq, eds, dah = oracle
+        rows, cols = extend_tpu.eds_roots_device(eds.data)
+        assert [r.tobytes() for r in rows] == dah.row_roots
+        assert [c.tobytes() for c in cols] == dah.column_roots
+
+
+class TestDeviceResidentRepair:
+    def _mask(self, k: int, frac: float, seed: int) -> np.ndarray:
+        rng = np.random.default_rng(seed)
+        present = np.ones((2 * k, 2 * k), dtype=bool)
+        erased = rng.choice(
+            4 * k * k, size=int(frac * 4 * k * k), replace=False
+        )
+        present.reshape(-1)[erased] = False
+        return present
+
+    def test_repair_from_device_handle(self, oracle):
+        sq, eds, dah = oracle
+        eds_dev, _r, _c = extend_tpu.extend_roots_device_resident(sq)
+        present = self._mask(8, 0.25, 7)
+        square = da.ExtendedDataSquare.from_device(eds_dev, 8)
+        fixed = repair_mod.repair_eds(
+            square, present, dah.row_roots, dah.column_roots
+        )
+        assert fixed.device_data is not None  # stays device-resident
+        assert np.array_equal(fixed.data, eds.data)
+
+    def test_repair_eds_host_path(self, oracle):
+        _sq, eds, dah = oracle
+        present = self._mask(8, 0.25, 8)
+        square = da.ExtendedDataSquare(
+            np.where(present[..., None], eds.data, 0), 8
+        )
+        fixed = repair_mod.repair_eds(
+            square, present, dah.row_roots, dah.column_roots
+        )
+        assert np.array_equal(fixed.data, eds.data)
+
+    def test_resident_verification_rejects_wrong_roots(self, oracle):
+        sq, _eds, dah = oracle
+        eds_dev, _r, _c = extend_tpu.extend_roots_device_resident(sq)
+        present = self._mask(8, 0.25, 9)
+        bad = [bytes(90)] + dah.row_roots[1:]
+        with pytest.raises(ValueError, match="row roots"):
+            repair_tpu.repair_resident_verified(
+                eds_dev, present, bad, dah.column_roots
+            )
+
+    def test_stage_resident_accepts_device_input(self, oracle):
+        sq, eds, _ = oracle
+        eds_dev, _r, _c = extend_tpu.extend_roots_device_resident(sq)
+        present = self._mask(8, 0.2, 10)
+        run, n = repair_tpu.stage_resident_repair(eds_dev, present)
+        assert n >= 1
+        assert np.array_equal(np.asarray(run()), eds.data)
+
+
+class TestChunkedBatchedRoots:
+    def test_chunk_selection(self):
+        assert extend_tpu._batch_chunk(32, 8) == 8  # small: full vmap
+        assert extend_tpu._batch_chunk(64, 8) == 8
+        assert extend_tpu._batch_chunk(128, 8) == 1  # large: sequential map
+        assert extend_tpu._batch_chunk(128, 1) == 1
+
+    @pytest.mark.parametrize("chunk", [1, 2])
+    def test_chunked_equals_unchunked(self, chunk):
+        import jax.numpy as jnp
+
+        from celestia_tpu.ops import rs_tpu
+
+        k, b = 2, 4
+        batch = np.stack([_square(k, seed=i) for i in range(b)])
+        m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
+        rows_c, cols_c = extend_tpu.roots_only_batched(
+            jnp.asarray(batch), m2, chunk=chunk
+        )
+        rows_f, cols_f = extend_tpu.roots_only_batched(
+            jnp.asarray(batch), m2, chunk=b
+        )  # full vmap (the small-square path)
+        assert np.array_equal(np.asarray(rows_c), np.asarray(rows_f))
+        assert np.array_equal(np.asarray(cols_c), np.asarray(cols_f))
+
+    def test_batched_matches_host_dah(self):
+        batch = np.stack([_square(4, seed=10 + i) for i in range(3)])
+        rows, cols = extend_tpu.batched_roots_device(batch)
+        for i in range(3):
+            eds = da.extend_shares(batch[i])
+            dah = da.new_data_availability_header(eds)
+            assert [r.tobytes() for r in rows[i]] == dah.row_roots
+            assert [c.tobytes() for c in cols[i]] == dah.column_roots
+
+
+class TestBulkCompactSplitter:
+    def test_bulk_equals_sequential_fuzz(self):
+        from celestia_tpu import namespace as ns_pkg
+        from celestia_tpu.shares.splitters import CompactShareSplitter
+
+        rng = random.Random(42)
+        sizes = [1, 5, 100, 300, 473, 474, 475, 600, 2000]
+        for trial in range(60):
+            txs = [
+                rng.randbytes(rng.choice(sizes))
+                for _ in range(rng.randint(0, 30))
+            ]
+            seq = CompactShareSplitter(ns_pkg.TX_NAMESPACE, 0)
+            for t in txs:
+                seq.write_tx(t)
+            bulk = CompactShareSplitter(ns_pkg.TX_NAMESPACE, 0)
+            bulk.write_txs_bulk(txs)
+            assert [s.data for s in seq.export()] == [
+                s.data for s in bulk.export()
+            ], f"trial {trial}"
+            assert seq.share_ranges == bulk.share_ranges
+            assert seq.count() == bulk.count()
+
+    def test_bulk_requires_fresh_splitter(self):
+        from celestia_tpu import namespace as ns_pkg
+        from celestia_tpu.shares.splitters import CompactShareSplitter
+
+        s = CompactShareSplitter(ns_pkg.TX_NAMESPACE, 0)
+        s.write_tx(b"abc")
+        with pytest.raises(ValueError, match="fresh"):
+            s.write_txs_bulk([b"def"])
+
+
+class TestProposalPath:
+    def test_proposal_dah_matches_extend_and_hash(self):
+        from celestia_tpu.app.app import App
+        from celestia_tpu.shares import Share
+
+        sq = _square(8)
+        data_square = [Share(bytes(s)) for s in sq.reshape(64, 512)]
+        for backend in ("numpy", "tpu"):
+            app = App(extend_backend=backend)
+            dah_p = app._proposal_dah(data_square)
+            eds_sq, dah_e = app._extend_and_hash(data_square)
+            assert dah_p.hash() == dah_e.hash(), backend
+            if backend == "tpu":
+                # ExtendBlock's EDS stays device-resident
+                assert eds_sq.device_data is not None

@@ -19,6 +19,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow  # networked multi-process devnet — run with --all
+
 from celestia_tpu.crypto import PrivateKey
 from celestia_tpu.node.consensus import (
     CommitCert,

@@ -1,0 +1,127 @@
+"""CAT-style want/have tx gossip (VERDICT r3 item 9 —
+specs/src/specs/cat_pool.md): raw tx bytes travel only to peers that
+have not already seen the tx; duplicate offers cost 32 bytes, not the
+whole tx. Measured bytes-on-wire in a live 3-validator topology
+(in-process nodes, real HTTP servers)."""
+
+import pytest
+
+from celestia_tpu.app import App
+from celestia_tpu.crypto import PrivateKey
+from celestia_tpu.node import Node
+from celestia_tpu.node.devnet import ValidatorNode
+from celestia_tpu.node.node import tx_hash
+from celestia_tpu.node.rpc import RpcServer
+from celestia_tpu.testutil.ibc import add_consensus_validator
+from celestia_tpu.user import Signer
+
+ALICE = PrivateKey.from_secret(b"gossip-alice")
+VALS = [PrivateKey.from_secret(b"gossip-val-%d" % i) for i in range(3)]
+
+
+@pytest.fixture
+def trio():
+    nodes, servers, validators = [], [], []
+    for _i in range(3):
+        app = App(chain_id="gossip-1")
+        app.init_chain({ALICE.bech32_address(): 1_000_000_000},
+                       genesis_time=0.0)
+        for k in VALS:
+            add_consensus_validator(app, k, 1_000_000)
+        node = Node(app)
+        node.produce_block(15.0)
+        srv = RpcServer(node, port=0)
+        srv.start()
+        nodes.append(node)
+        servers.append(srv)
+    for i, node in enumerate(nodes):
+        peers = [
+            f"http://127.0.0.1:{servers[j].port}"
+            for j in range(3) if j != i
+        ]
+        validators.append(ValidatorNode(node, VALS[i], peers))
+    try:
+        yield nodes, validators
+    finally:
+        for srv in servers:
+            srv.stop()
+
+
+def _signed_tx(node) -> bytes:
+    from celestia_tpu.tx import Fee, sign_tx
+    from celestia_tpu.x.bank import MsgSend
+
+    signer = Signer.setup_single(ALICE, node)
+    msg = MsgSend(ALICE.bech32_address(), ALICE.bech32_address(), 1)
+    return sign_tx(
+        ALICE, [msg], node.app.chain_id, signer.account_number,
+        signer.sequence, Fee(amount=20_000, gas_limit=200_000),
+    ).marshal()
+
+
+class TestWantHaveGossip:
+    def test_first_gossip_sends_raw_once_then_dedupes(self, trio):
+        nodes, validators = trio
+        raw = _signed_tx(nodes[0])
+        assert nodes[0].broadcast_tx(raw).code == 0, "tx must enter A's pool"
+
+        # A gossips: B and C have never seen the tx -> raw bytes to both
+        validators[0].gossip_tx(raw)
+        s0 = validators[0].gossip_stats
+        assert s0["raw_bytes"] == 2 * len(raw)
+        assert s0["deduped_bytes"] == 0
+        key = tx_hash(raw)
+        assert nodes[1].mempool.has_seen(key)
+        assert nodes[2].mempool.has_seen(key)
+
+        # B re-gossips the same tx: every peer already has it — ZERO raw
+        # bytes on the wire, only two 32-byte have offers
+        validators[1].gossip_tx(raw)
+        s1 = validators[1].gossip_stats
+        assert s1["raw_bytes"] == 0
+        assert s1["deduped_bytes"] == 2 * len(raw)
+        assert s1["have_bytes"] == 2 * 32
+
+        # measured reduction across the whole exchange: without
+        # want/have, 4 raw transfers; with it, 2 — plus 4 tiny offers
+        total_raw = s0["raw_bytes"] + s1["raw_bytes"]
+        naive = 4 * len(raw)
+        overhead = s0["have_bytes"] + s1["have_bytes"]
+        # this ~300 B MsgSend is near the worst case for the handshake
+        # overhead; blob txs (KBs) approach a clean 50% in this topology
+        assert total_raw + overhead < naive * 0.65, (
+            f"want/have saved too little: {total_raw + overhead} vs {naive}"
+        )
+
+    def test_have_route_answers_want_correctly(self, trio):
+        nodes, validators = trio
+        raw = _signed_tx(nodes[0])
+        nodes[0].broadcast_tx(raw)
+        key = tx_hash(raw)
+        peer = validators[1].peers[0]  # some peer client of B
+        # ask B's peers (A or C) — A holds it, C does not yet
+        res_a = validators[1].peers[0].gossip_have([key])
+        res_c = validators[0].peers[1].gossip_have([key])
+        # exactly one of the two answers should want it (C), and the
+        # holder (A) must not
+        wants = [key.hex() in res_a.get("want", []),
+                 key.hex() in res_c.get("want", [])]
+        assert wants.count(True) == 1
+
+    def test_seen_survives_commit_but_ages_out(self):
+        """A committed tx's key stays deduplicated for the TTL window,
+        then ages out of the seen set (bounded memory)."""
+        app = App(chain_id="gossip-2")
+        app.init_chain({ALICE.bech32_address(): 1_000_000_000},
+                       genesis_time=0.0)
+        node = Node(app)
+        node.produce_block(15.0)
+        raw = _signed_tx(node)
+        assert node.broadcast_tx(raw).code == 0
+        key = tx_hash(raw)
+        node.produce_block(30.0)  # commits the tx, removes from pool
+        assert key not in node.mempool.txs
+        assert node.mempool.has_seen(key)  # still deduped
+        for _ in range(2 * node.mempool.ttl_blocks + 1):
+            node.produce_block()
+        assert not node.mempool.has_seen(key)  # aged out

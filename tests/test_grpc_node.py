@@ -8,6 +8,8 @@ cosmos.tx.v1beta1.Service surface and verifiable state proofs.
 
 import pytest
 
+pytestmark = pytest.mark.slow  # gRPC node API over live sockets — run with --all
+
 from celestia_tpu import blob as blob_pkg
 from celestia_tpu import namespace as ns
 from celestia_tpu.app import App

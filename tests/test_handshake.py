@@ -1,0 +1,231 @@
+"""ICS-3 connection + ICS-4 channel handshakes (VERDICT r3 item 5).
+
+The reference wires ibc-go's full core: clients → ICS-3 connection
+handshake → ICS-4 channel handshake → transfer stack
+(app/app.go:359-385). These tests establish a connection and channel
+purely via relayed handshake messages — every step proving the
+counterparty's recorded state with SMT membership proofs against
+verified light-client headers — then run the ICS-20 transfer E2E over
+the resulting channel.
+"""
+
+import pytest
+
+from celestia_tpu.app import App
+from celestia_tpu.crypto import PrivateKey
+from celestia_tpu.node import Node
+from celestia_tpu.testutil.ibc import (
+    LightClientRelayer,
+    add_consensus_validator,
+    make_header,
+)
+from celestia_tpu.user import Signer
+from celestia_tpu.x.connection import (
+    STATE_OPEN,
+    ConnectionKeeper,
+    MsgConnectionOpenAck,
+    MsgConnectionOpenTry,
+    connection_key,
+)
+from celestia_tpu.x.ibc import CHANNEL_STATE_OPEN
+from celestia_tpu.x.lightclient import ClientKeeper
+from celestia_tpu.x.transfer import MsgTransfer, escrow_address
+
+ALICE = PrivateKey.from_secret(b"hs-alice")
+BOB = PrivateKey.from_secret(b"hs-bob")
+RELAYER_A = PrivateKey.from_secret(b"hs-relayer-a")
+RELAYER_B = PrivateKey.from_secret(b"hs-relayer-b")
+VAL_A = PrivateKey.from_secret(b"hs-val-a")
+VAL_B = PrivateKey.from_secret(b"hs-val-b")
+BOND = 1_000_000
+
+
+def new_chain(chain_id: str, val_key) -> Node:
+    app = App(chain_id=chain_id)
+    app.init_chain(
+        {
+            ALICE.bech32_address(): 1_000_000_000,
+            BOB.bech32_address(): 1_000_000_000,
+            RELAYER_A.bech32_address(): 1_000_000_000,
+            RELAYER_B.bech32_address(): 1_000_000_000,
+        },
+        genesis_time=0.0,
+    )
+    add_consensus_validator(app, val_key, BOND)
+    node = Node(app)
+    node.produce_block(15.0)
+    return node
+
+
+def _setup():
+    node_a = new_chain("hs-chain-a", VAL_A)
+    node_b = new_chain("hs-chain-b", VAL_B)
+    # social-trust genesis: each chain gets a client for the other
+    cs_a = ClientKeeper(node_a.app.store).create_client(make_header(node_b))
+    cs_b = ClientKeeper(node_b.app.store).create_client(make_header(node_a))
+    node_a.app.store.commit_hash_refresh()
+    node_b.app.store.commit_hash_refresh()
+    relayer = LightClientRelayer(
+        node_a, node_b, RELAYER_A, RELAYER_B, [VAL_A], [VAL_B],
+        client_a=cs_a.client_id, client_b=cs_b.client_id,
+    )
+    return node_a, node_b, relayer
+
+
+class TestHandshake:
+    def test_connection_and_channel_establish(self):
+        """The four ConnOpen* steps then four ChanOpen* steps, each
+        proving counterparty state — both ends land OPEN and
+        cross-referenced."""
+        node_a, node_b, relayer = _setup()
+        chan_a, chan_b = relayer.handshake(100.0, 100.0)
+
+        conn_a = ConnectionKeeper(node_a.app.store).get_connection("connection-0")
+        conn_b = ConnectionKeeper(node_b.app.store).get_connection("connection-0")
+        assert conn_a.state == STATE_OPEN and conn_b.state == STATE_OPEN
+        assert conn_a.counterparty_connection_id == conn_b.connection_id
+        assert conn_b.counterparty_connection_id == conn_a.connection_id
+
+        ch_a = node_a.app.ibc.get_channel("transfer", chan_a)
+        ch_b = node_b.app.ibc.get_channel("transfer", chan_b)
+        assert ch_a.state == CHANNEL_STATE_OPEN
+        assert ch_b.state == CHANNEL_STATE_OPEN
+        assert ch_a.counterparty_channel_id == chan_b
+        assert ch_b.counterparty_channel_id == chan_a
+        assert ch_a.connection_id == conn_a.connection_id
+        assert ch_a.client_id == ""  # bound via the connection, not directly
+        # packet proofs resolve their client through the connection
+        assert (
+            node_a.app.ibc.client_for_channel(ch_a) == conn_a.client_id
+        )
+
+    def test_transfer_over_handshaken_channel(self):
+        """ICS-20 E2E across the handshake-established channel — the
+        voucher-coming-home flow the tokenfilter admits (a voucher of
+        A's native token returns from B; A releases escrow to the
+        receiver). All packet messages are proof-verified through the
+        connection's client; no relayer registration anywhere."""
+        node_a, node_b, relayer = _setup()
+        chan_a, chan_b = relayer.handshake(100.0, 100.0)
+
+        alice, bob = ALICE.bech32_address(), BOB.bech32_address()
+        esc = escrow_address("transfer", chan_a)
+        voucher = f"transfer/{chan_b}/utia"
+        # state after a (conceptual) earlier outbound transfer: escrow
+        # funded on A, matching voucher held by bob on B
+        node_a.app.bank.mint(esc, 5_000, "utia")
+        node_b.app.bank.mint(bob, 5_000, voucher)
+        node_a.app.store.commit_hash_refresh()
+        node_b.app.store.commit_hash_refresh()
+
+        b_signer = Signer.setup_single(BOB, node_b)
+        res = b_signer.submit_tx(
+            [MsgTransfer("transfer", chan_b, voucher, 5_000, bob, alice)]
+        )
+        assert res.code == 0, res.log
+        node_b.produce_block(700.0)
+
+        before = node_a.app.bank.get_balance(alice)
+        relayer.relay(800.0, 800.0, channel_a=chan_a, channel_b=chan_b)
+
+        assert node_a.app.bank.get_balance(esc) == 0
+        assert node_a.app.bank.get_balance(alice) == before + 5_000
+        ack = node_a.app.ibc.get_acknowledgement("transfer", chan_a, 1)
+        assert ack is not None and ack.success
+        # commitment cleared on B after the ack round
+        assert node_b.app.ibc.pending_packets("transfer", chan_b) == []
+
+    def test_try_with_wrong_counterparty_client_rejected(self):
+        """The INIT proof binds the client PAIR: a Try claiming a
+        different counterparty client cannot reconstruct the committed
+        bytes, so the membership proof fails."""
+        node_a, node_b, relayer = _setup()
+        sa, sb = relayer.signer_a, relayer.signer_b
+        from celestia_tpu.x.connection import MsgConnectionOpenInit
+
+        res = sa.submit_tx([
+            MsgConnectionOpenInit(
+                relayer.client_on[id(node_a)],
+                relayer.client_on[id(node_b)],
+                sa.address(),
+            )
+        ])
+        assert res.code == 0, res.log
+        node_a.produce_block(120.0)
+
+        h = relayer.update_client(node_a, node_b, sb, 130.0)
+        _v, _root, proof = node_a.app.store.query_with_proof(
+            connection_key("connection-0")
+        )
+        res = sb.submit_tx([
+            MsgConnectionOpenTry(
+                relayer.client_on[id(node_b)],
+                "07-tendermint-9",  # not the client A actually named
+                "connection-0", proof, h, sb.address(),
+            )
+        ])
+        assert res.code == 0, res.log  # CheckTx only runs the ante
+        block = node_b.produce_block(140.0)
+        failed = [r for r in block.tx_results if r.code != 0]
+        assert failed and "proof failed" in failed[0].log
+        # no TRYOPEN end was recorded
+        assert ConnectionKeeper(node_b.app.store).get_connection(
+            "connection-0"
+        ) is None
+
+    def test_ack_without_counterparty_try_rejected(self):
+        """A cannot open unilaterally: Ack requires a proof of B's
+        TRYOPEN end, which does not exist."""
+        node_a, node_b, relayer = _setup()
+        sa, sb = relayer.signer_a, relayer.signer_b
+        from celestia_tpu.x.connection import MsgConnectionOpenInit
+
+        res = sa.submit_tx([
+            MsgConnectionOpenInit(
+                relayer.client_on[id(node_a)],
+                relayer.client_on[id(node_b)],
+                sa.address(),
+            )
+        ])
+        assert res.code == 0, res.log
+        node_a.produce_block(120.0)
+
+        h = relayer.update_client(node_b, node_a, sa, 130.0)
+        # prove an unrelated (absent) key — the only proof A can get
+        _v, _root, proof = node_b.app.store.query_with_proof(
+            connection_key("connection-0")
+        )
+        res = sa.submit_tx([
+            MsgConnectionOpenAck(
+                "connection-0", "connection-0", proof, h, sa.address(),
+            )
+        ])
+        assert res.code == 0, res.log  # CheckTx only runs the ante
+        block = node_a.produce_block(140.0)
+        failed = [r for r in block.tx_results if r.code != 0]
+        assert failed, "Ack must fail without a real TRYOPEN proof"
+        conn = ConnectionKeeper(node_a.app.store).get_connection("connection-0")
+        assert conn.state == "INIT"  # never advanced
+
+    def test_channel_send_refused_before_open(self):
+        """A channel stuck in INIT (handshake not completed) refuses
+        sends — packets only flow on OPEN ends."""
+        node_a, node_b, relayer = _setup()
+        # run only the connection handshake + ChanOpenInit
+        from celestia_tpu.x.ibc import MsgChannelOpenInit
+
+        relayer_chan = relayer.handshake(100.0, 100.0)
+        # open a SECOND channel but stop at INIT
+        sa = relayer.signer_a
+        res = sa.submit_tx([
+            MsgChannelOpenInit("transfer", "connection-0", "transfer",
+                               sa.address())
+        ])
+        assert res.code == 0, res.log
+        node_a.produce_block(900.0)
+        stuck = node_a.app.ibc.get_channel("transfer", "channel-1")
+        assert stuck is not None and stuck.state == "INIT"
+        alice = ALICE.bech32_address()
+        with pytest.raises(ValueError, match="not open"):
+            node_a.app.ibc.send_packet("transfer", "channel-1", b"x")
+        assert relayer_chan  # the completed channel still works

@@ -79,11 +79,13 @@ def new_chain(chain_id: str, val_keys) -> Node:
 
 
 def _mk_header(height=5, chain_id="chain-x", app_hash=b"\xaa" * 32,
-               time=100.0, validators=None):
+               time=None, validators=None):
+    # header time tracks height by default: update_client enforces
+    # monotonic time against the latest consensus state (ibc-go parity)
     return Header(
         chain_id=chain_id,
         height=height,
-        time=time,
+        time=100.0 * height if time is None else time,
         app_hash=app_hash,
         validators=validators or [],
     )
@@ -202,6 +204,126 @@ class TestClientKeeper:
             keeper.update_client(
                 "07-tendermint-0", self._signed(h, [VAL_B1, VAL_B2, VAL_B3])
             )
+
+    def test_expired_client_rejects_update(self):
+        """ADVICE r3: a header signed by the trusted set is rejected once
+        the latest consensus state is older than the trusting period —
+        the long-range-attack guard (ibc-go TrustingPeriod/Expired)."""
+        _s, keeper, valset = self._keeper_with_client()
+        cs = keeper.get_client("07-tendermint-0")
+        # latest consensus timestamp is 10.0; step past the window
+        now = 10.0 + cs.trusting_period + 1.0
+        h2 = _mk_header(height=2, validators=valset, time=now - 5.0)
+        with pytest.raises(ValueError, match="expired"):
+            keeper.update_client(
+                "07-tendermint-0",
+                self._signed(h2, [VAL_B1, VAL_B2, VAL_B3]),
+                now=now,
+            )
+        # inside the window the same update passes
+        ok_now = 10.0 + cs.trusting_period - 1.0
+        keeper.update_client(
+            "07-tendermint-0",
+            self._signed(h2, [VAL_B1, VAL_B2, VAL_B3]),
+            now=ok_now,
+        )
+
+    def test_block_time_from_store_drives_expiry(self):
+        """With no explicit `now`, the keeper reads the app's committed
+        block time — the path DeliverTx runs."""
+        store, keeper, valset = self._keeper_with_client()
+        cs = keeper.get_client("07-tendermint-0")
+        stale = 10.0 + cs.trusting_period + 100.0
+        store.set(b"ctx/blockTime", repr(stale).encode())
+        h2 = _mk_header(height=2, validators=valset, time=stale - 5.0)
+        with pytest.raises(ValueError, match="expired"):
+            keeper.update_client(
+                "07-tendermint-0", self._signed(h2, [VAL_B1, VAL_B2, VAL_B3])
+            )
+
+    def test_header_time_must_advance(self):
+        _s, keeper, valset = self._keeper_with_client()
+        h2 = _mk_header(height=2, validators=valset, time=10.0)  # == initial
+        with pytest.raises(ValueError, match="time is not newer"):
+            keeper.update_client(
+                "07-tendermint-0", self._signed(h2, [VAL_B1, VAL_B2, VAL_B3])
+            )
+
+    def test_misbehaviour_in_earlier_epoch_freezes(self):
+        """ADVICE r3: equivocation signed by an EARLIER trusted epoch's
+        valset freezes the client even after the set rotated — each
+        misbehaviour header verifies against the valset stored for its
+        own height."""
+        _s, keeper, old_set = self._keeper_with_client()
+        new_set = [ValidatorInfo(VAL_A1.public_key().hex(), 10)]
+        h2 = _mk_header(height=2, validators=new_set)
+        keeper.update_client(
+            "07-tendermint-0", self._signed(h2, [VAL_B1, VAL_B2, VAL_B3])
+        )
+        # conflicting headers at height 2 — the epoch verified by the
+        # ORIGINAL set (the valset adopted below height 2), which the
+        # current client set (VAL_A1) can no longer vouch for
+        ha = _mk_header(height=2, validators=new_set, app_hash=b"\x01" * 32)
+        hb = _mk_header(height=2, validators=new_set, app_hash=b"\x02" * 32)
+        cs = keeper.submit_misbehaviour(
+            "07-tendermint-0",
+            self._signed(ha, [VAL_B1, VAL_B2, VAL_B3]),
+            self._signed(hb, [VAL_B1, VAL_B2, VAL_B3]),
+        )
+        assert cs.frozen
+
+    def test_misbehaviour_rejects_wrong_epoch_signers(self):
+        """Evidence at a height must be signed by THAT height's trusted
+        epoch — the current set signing for an old epoch is refused."""
+        _s, keeper, _old = self._keeper_with_client()
+        new_set = [ValidatorInfo(VAL_A1.public_key().hex(), 10)]
+        h2 = _mk_header(height=2, validators=new_set)
+        keeper.update_client(
+            "07-tendermint-0", self._signed(h2, [VAL_B1, VAL_B2, VAL_B3])
+        )
+        ha = _mk_header(height=2, validators=new_set, app_hash=b"\x01" * 32)
+        hb = _mk_header(height=2, validators=new_set, app_hash=b"\x02" * 32)
+        with pytest.raises(ValueError, match="insufficient voting power"):
+            keeper.submit_misbehaviour(
+                "07-tendermint-0",
+                self._signed(ha, [VAL_A1]),
+                self._signed(hb, [VAL_A1]),
+            )
+
+    def test_expired_epochs_pruned_on_update(self):
+        """Consensus states (and valset epochs) older than the trusting
+        period are deleted at update time — client state stays bounded
+        (ibc-go's expired-consensus-state pruning)."""
+        _s, keeper, valset = self._keeper_with_client()
+        cs = keeper.get_client("07-tendermint-0")
+        # heights 2..4 at closely spaced times
+        for h in (2, 3, 4):
+            keeper.update_client(
+                "07-tendermint-0",
+                self._signed(
+                    _mk_header(height=h, validators=valset, time=10.0 + h),
+                    [VAL_B1, VAL_B2, VAL_B3],
+                ),
+                now=20.0 + h,
+            )
+        assert keeper.get_consensus_state("07-tendermint-0", 2) is not None
+        # an update near the end of the trust window (client NOT yet
+        # expired relative to h=4's timestamp 14.0) ages out the older
+        # epochs but keeps the still-trusted tip
+        far = 13.5 + cs.trusting_period
+        keeper.update_client(
+            "07-tendermint-0",
+            self._signed(
+                _mk_header(height=9, validators=valset, time=far - 0.25),
+                [VAL_B1, VAL_B2, VAL_B3],
+            ),
+            now=far,
+        )
+        for h in (1, 2, 3):  # timestamps 10..13: older than the window
+            assert keeper.get_consensus_state("07-tendermint-0", h) is None
+        # h=4 (ts 14.0) is still inside the window; the tip always stays
+        assert keeper.get_consensus_state("07-tendermint-0", 4) is not None
+        assert keeper.get_consensus_state("07-tendermint-0", 9) is not None
 
     def test_valset_rotation(self):
         """An update signed by the old set installs the new set; the next
@@ -371,6 +493,7 @@ class TestLightClientE2E:
         attacker = Signer.setup_single(ATTACKER, node_a)
         fake = make_header(node_b)
         fake.height += 1
+        fake.time += 1.0  # pass the monotonic-time gate; fail on power
         fake.app_hash = b"\xee" * 32
         fake.validators = [ValidatorInfo(ATTACKER.public_key().hex(), 100)]
         signed = sign_header(fake, [ATTACKER])

@@ -15,6 +15,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-host DCN backend (2 OS processes) — run with --all
+
 WORKER = r"""
 import sys
 proc_id, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]

@@ -4,6 +4,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # lockstep multi-replica network harness — run with --all
+
 import celestia_tpu.namespace as ns
 from celestia_tpu import blob as blob_pkg
 from celestia_tpu.testutil import funded_keys

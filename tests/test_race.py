@@ -13,6 +13,8 @@ import urllib.request
 
 import pytest
 
+pytestmark = pytest.mark.slow  # RPC storm race suite — run with --all
+
 from celestia_tpu import blob as blob_pkg
 from celestia_tpu import namespace as ns
 from celestia_tpu.app import App

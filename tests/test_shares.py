@@ -182,3 +182,27 @@ class TestBlobTxEnvelopes:
         assert ok
         assert w.tx == b"inner"
         assert w.share_indexes == [1, 500, 70000]
+
+
+class TestIndexWrapperSize:
+    def test_size_matches_marshal_on_edges(self):
+        """marshal_index_wrapper_size must equal len(marshal(...)) for
+        every shape, including empty tx / empty indexes (fields with
+        empty payloads are OMITTED by the wire codec on both sides)."""
+        from celestia_tpu.blob import (
+            marshal_index_wrapper,
+            marshal_index_wrapper_size,
+        )
+
+        cases = [
+            (b"", []),
+            (b"", [5]),
+            (b"x" * 300, []),
+            (b"x" * 300, [16384, 1]),
+            (b"a", [0]),
+            (b"y" * 127, [127, 128, 2**20]),
+        ]
+        for tx, idx in cases:
+            assert marshal_index_wrapper_size(tx, idx) == len(
+                marshal_index_wrapper(tx, idx)
+            ), (tx, idx)
