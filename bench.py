@@ -246,6 +246,9 @@ def bench_repair(k: int, erase_frac: float = 0.25):
     fixed_tpu = repair_tpu.repair_tpu(srcs[0], masks[0])
     wall_cold = (time.perf_counter() - t0) * 1e3
     ok_tpu = np.array_equal(fixed_tpu, eds)
+    # ONE warm repetition: this documentation number moves 64 MB through
+    # the tunnel per run, and the tunnel's bandwidth varies 10x between
+    # sessions — repeating it buys noise, not precision
     t0 = time.perf_counter()
     repair_tpu.repair_tpu(srcs[0], masks[0])
     wall_ms = (time.perf_counter() - t0) * 1e3
@@ -270,7 +273,14 @@ def bench_repair(k: int, erase_frac: float = 0.25):
         )
         return fixed
 
-    ok_resident = np.array_equal(np.asarray(resident_cycle(0)), eds)  # warm + check
+    # warm/compile; correctness is asserted ON DEVICE — the cycle
+    # recomputes the NMT roots of the repaired square and compares them
+    # to the true DAH (raises on mismatch), so no 32 MB fetch is needed
+    try:
+        resident_cycle(0)
+        ok_resident = True
+    except ValueError:
+        ok_resident = False
     best = float("inf")
     for i in range(3):
         t0 = time.perf_counter()
@@ -534,14 +544,14 @@ def bench_node_path(k: int):
                 app._extend_and_hash(data_square)
                 best = min(best, time.perf_counter() - t0)
             out["tpu_wall_extend_lazy_ms"] = round(best * 1e3, 3)
-            # round-3 semantics: force the full 32 MB EDS fetch
-            best = float("inf")
-            for _ in range(2):
-                t0 = time.perf_counter()
-                eds_sq, _d = app._extend_and_hash(data_square)
-                _ = eds_sq.data  # materialize on host
-                best = min(best, time.perf_counter() - t0)
-            out["tpu_wall_with_eds_fetch_ms"] = round(best * 1e3, 3)
+            # round-3 semantics: force the full 32 MB EDS fetch (ONE
+            # run — tunnel-bandwidth-bound documentation number)
+            t0 = time.perf_counter()
+            eds_sq, _d = app._extend_and_hash(data_square)
+            _ = eds_sq.data  # materialize on host
+            out["tpu_wall_with_eds_fetch_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 3
+            )
     # parity is only meaningful when at least two backends really ran;
     # main() asserts every "parity" key, so omit it otherwise
     if len(hashes) >= 2:
@@ -550,6 +560,95 @@ def bench_node_path(k: int):
         out["parity_note"] = "fewer than two backends ran; nothing to compare"
     out["live_backend_at_k"] = App(extend_backend="auto").resolve_extend_backend(k)
     return out
+
+
+def bench_node_path_arena(k: int = 128):
+    """Config 8b: the proposal wall with the device blob arena
+    (ops/blob_pool.py) — the shape `cli start --extend-backend tpu`
+    runs once the mempool has staged the block's blobs in HBM at
+    CheckTx time. The square is assembled ON DEVICE: per proposal only
+    share metadata (~300 KB at k=128) crosses the interconnect instead
+    of the 8 MB square, so the wall is tunnel-RTT-bound, not
+    bandwidth-bound."""
+    from celestia_tpu import blob as blob_pkg
+    from celestia_tpu import namespace as ns_pkg
+    from celestia_tpu import square as square_pkg
+    from celestia_tpu.app.app import App
+    from celestia_tpu.crypto import PrivateKey
+    from celestia_tpu.tx import Fee, sign_tx
+    from celestia_tpu.x.blob.types import estimate_gas, new_msg_pay_for_blobs
+
+    # blob-heavy block: ~60 x 120 KB blobs fills a k=128 square
+    key = PrivateKey.from_secret(b"bench-arena")
+    addr = key.bech32_address()
+    rng = np.random.default_rng(11)
+    txs = []
+    blob_size = 120_000
+    for i in range(60):
+        data = rng.integers(0, 256, blob_size, dtype=np.uint8).tobytes()
+        b = blob_pkg.new_blob(
+            ns_pkg.new_v0(b"arena" + i.to_bytes(5, "big")), data, 0
+        )
+        gas = estimate_gas([blob_size])
+        tx = sign_tx(key, [new_msg_pay_for_blobs(addr, b)], "bench", 0, i,
+                     Fee(amount=gas, gas_limit=gas))
+        txs.append(blob_pkg.marshal_blob_tx(tx.marshal(), [b]))
+    square, _kept, builder = square_pkg.build_ex(txs, 1, k)
+    got_k = square_pkg.square_size(len(square))
+
+    from celestia_tpu import native
+
+    use_native = native.available()
+    arr = np.frombuffer(
+        b"".join(s.data for s in square), dtype=np.uint8
+    ).reshape(got_k, got_k, 512)
+    best = float("inf")
+    dah_native = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        if use_native:
+            _e, _r, _c, dah_native = native.extend_and_root_native(arr)
+        best = min(best, time.perf_counter() - t0)
+    native_ms = best * 1e3 if use_native else None
+
+    app = App(extend_backend="tpu")
+    arena = app.enable_blob_pool()
+    t0 = time.perf_counter()
+    for _start, blob in builder.blob_layout():
+        arena.put(blob.data)  # the CheckTx-time staging cost, off-path
+    staging_ms = (time.perf_counter() - t0) * 1e3
+
+    dah = app._assembled_proposal_dah(square, builder, got_k)  # warm/compile
+    if dah is None:
+        return {"error": "arena path declined (residency)"}
+    if dah_native is None:
+        # no native runtime: check against the independent host python
+        # path instead — a parity key must never be vacuous
+        from celestia_tpu import da as da_pkg
+        from celestia_tpu.shares import to_bytes as _to_bytes
+
+        dah_native = da_pkg.new_data_availability_header(
+            da_pkg.extend_shares(_to_bytes(square))
+        ).hash()
+    parity = dah.hash() == dah_native
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        app._assembled_proposal_dah(square, builder, got_k)
+        best = min(best, time.perf_counter() - t0)
+    stream = _slope(
+        lambda i: app._assembled_proposal_dah(square, builder, got_k),
+        lambda r: r, n1=2, n2=8, tries=3,
+    )
+    return {
+        "square_size": got_k,
+        "blob_bytes": 60 * blob_size,
+        "native_ms": round(native_ms, 3) if native_ms else None,
+        "tpu_wall_arena_ms": round(best * 1e3, 3),
+        "tpu_wall_arena_stream_ms": round(stream, 3) if stream > 0 else None,
+        "staging_ms_offpath": round(staging_ms, 3),
+        "parity": bool(parity),
+    }
 
 
 def bench_codec_service(k: int = 32):
@@ -609,6 +708,24 @@ def fetch_floor_ms():
     return round(best * 1e3, 3)
 
 
+def tunnel_bandwidth_mb_s():
+    """Measured host<->device bandwidth (4 MB each way). The tunnel's
+    bandwidth varies ~10x between sessions; recording it makes every
+    wall-clock number in this file's output self-describing — a wall
+    regression with a collapsed tunnel is environment, not code."""
+    import jax
+
+    x = np.ones((4 * 1024 * 1024,), np.uint8)
+    t0 = time.perf_counter()
+    d = jax.device_put(x)
+    d.block_until_ready()
+    up = 4 / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    np.asarray(d)
+    down = 4 / (time.perf_counter() - t0)
+    return {"up": round(up, 1), "down": round(down, 1)}
+
+
 def main():
     headline_k = int(sys.argv[1]) if len(sys.argv) > 1 else 128
 
@@ -630,6 +747,7 @@ def main():
     configs[f"7b_batched_throughput_k{headline_k}"] = \
         bench_batched_throughput(headline_k)
     configs[f"8_node_path_k{headline_k}"] = bench_node_path(headline_k)
+    configs["8b_node_path_arena_k128"] = bench_node_path_arena(128)
     configs["9_square_construct"] = {
         f"tx{n}_blob{s}": bench_square_construct(n, s)
         for n, s in ((10, 10_000), (100, 1_000), (1_000, 100))
@@ -656,6 +774,7 @@ def main():
                     "tpu_single_dispatch_with_fetch_ms"
                 ],
                 "tunnel_fetch_floor_ms": fetch_floor_ms(),
+                "tunnel_bandwidth_mb_s": tunnel_bandwidth_mb_s(),
                 "dah": head["dah"],
                 "parity": head["parity"],
                 "configs": configs,

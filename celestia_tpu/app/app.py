@@ -149,6 +149,7 @@ class App:
                 "(want auto|tpu|native|numpy)"
             )
         self._active_backend: str | None = None  # last backend logged
+        self.blob_pool = None  # device blob arena (enable_blob_pool)
         self.store = StateStore()
         self.accounts = AccountKeeper(self.store)
         self.bank = BankKeeper(self.store)
@@ -292,7 +293,9 @@ class App:
             b"".join(s.data for s in data_square), dtype=np.uint8
         ).reshape(k, k, appconsts.SHARE_SIZE)
 
-    def _proposal_dah(self, data_square) -> "da.DataAvailabilityHeader":
+    def _proposal_dah(
+        self, data_square, builder=None
+    ) -> "da.DataAvailabilityHeader":
         """Roots-only hot path for Prepare/ProcessProposal and replay
         verification: square -> DAH, the EDS never leaves the device.
 
@@ -300,7 +303,11 @@ class App:
         proposal flow only needs the DataAvailabilityHeader hash. On the
         TPU backend the EDS is an XLA intermediate of the roots program
         (ops/extend_tpu.roots_device): only 2·2k·90 bytes of axis roots
-        cross back to host instead of the full (2k)²·512 square."""
+        cross back to host instead of the full (2k)²·512 square. With a
+        blob arena attached (enable_blob_pool) and the square's blob
+        bytes already resident, even the square upload disappears: the
+        device assembles it from the arena (`builder` supplies the blob
+        placement provenance) and only share metadata crosses."""
         from celestia_tpu import native
 
         k = square_pkg.square_size(len(data_square))
@@ -308,6 +315,10 @@ class App:
         if backend == "tpu":
             from celestia_tpu.ops import extend_tpu
 
+            if builder is not None and self.blob_pool is not None:
+                dah = self._assembled_proposal_dah(data_square, builder, k)
+                if dah is not None:
+                    return dah
             rows, cols = extend_tpu.roots_device(self._square_array(data_square, k))
             return da.DataAvailabilityHeader(
                 [r.tobytes() for r in rows], [c.tobytes() for c in cols]
@@ -319,6 +330,104 @@ class App:
             return da.DataAvailabilityHeader(rows, cols, _hash=native_dah)
         eds = da.extend_shares(to_bytes(data_square))
         return da.new_data_availability_header(eds)
+
+    def enable_blob_pool(self, capacity_bytes: int = 64 * 1024 * 1024):
+        """Attach a device-resident blob arena (ops/blob_pool.py): the
+        node stages mempool blob bytes in HBM at admission time, and the
+        TPU proposal path assembles squares on device from them instead
+        of uploading 8 MB per proposal. Purely a transfer cache — every
+        miss falls back to the plain upload path, byte-identically."""
+        from celestia_tpu.ops.blob_pool import DeviceBlobArena
+
+        if self.blob_pool is None:
+            self.blob_pool = DeviceBlobArena(capacity_bytes)
+        return self.blob_pool
+
+    def _assembled_proposal_dah(self, data_square, builder, k: int):
+        """Device-assembled roots (arena path); None when the square is
+        not arena-eligible (most blob bytes absent — upload instead).
+
+        Runs entirely under the arena lock: offset lookups, the device
+        dispatch, and the root fetch must see one consistent arena —
+        a concurrent CheckTx staging would otherwise donate-delete the
+        dispatched buffer or (after a wholesale reset) rewrite bytes at
+        snapshotted offsets (see DeviceBlobArena.lock)."""
+        with self.blob_pool.lock:
+            return self._assembled_proposal_dah_locked(data_square, builder, k)
+
+    def _assembled_proposal_dah_locked(self, data_square, builder, k: int):
+        import numpy as np
+
+        from celestia_tpu.ops import extend_tpu
+        from celestia_tpu.ops.blob_pool import blob_key
+        from celestia_tpu.shares.splitters import sparse_shares_needed
+
+        first = appconsts.FIRST_SPARSE_SHARE_CONTENT_SIZE
+        cont = appconsts.CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+        s = k * k
+        cell_is_arena = np.zeros(s, bool)
+        cell_blob = np.zeros(s, np.int32)
+        cell_first = np.zeros(s, bool)
+        data_start = np.zeros(s, np.int32)
+        data_len = np.zeros(s, np.int32)
+        ns_rows: list = []
+        blob_lens: list[int] = []
+        resident = total = 0
+        for start, blob in builder.blob_layout():
+            total += len(blob.data)
+            ns_obj = blob.namespace()
+            if ns_obj.is_tx() or ns_obj.is_pay_for_blob():
+                continue  # compact-ns blob: reserved-byte layout, host path
+            loc = self.blob_pool.offset_of(blob_key(blob.data))
+            if loc is None:
+                continue  # not resident: its cells stay host cells
+            off, ln = loc
+            if ln != len(blob.data):
+                continue
+            b_idx = len(ns_rows)
+            ns_rows.append(np.frombuffer(ns_obj.bytes, np.uint8))
+            blob_lens.append(len(blob.data))
+            n = sparse_shares_needed(len(blob.data))
+            cells = np.arange(start, start + n)
+            cell_is_arena[cells] = True
+            cell_blob[cells] = b_idx
+            cell_first[start] = True
+            starts = np.where(
+                cells == start, 0, first + (cells - start - 1) * cont
+            )
+            data_start[cells] = off + starts
+            caps = np.where(cells == start, first, cont)
+            data_len[cells] = np.minimum(caps, len(blob.data) - starts)
+            resident += len(blob.data)
+        if total == 0 or resident * 2 < total:
+            return None  # mostly host bytes anyway: upload path wins
+        # deduplicated host-share table: a blob-heavy square's host cells
+        # are mostly IDENTICAL padding shares (tail/reserved/namespace
+        # padding), so the uploaded table shrinks from thousands of rows
+        # to ~#unique (PFB shares + a handful of padding patterns)
+        cell_host_row = np.full(s, -1, np.int32)
+        unique_rows: dict[bytes, int] = {}
+        for i in np.flatnonzero(~cell_is_arena):
+            b = data_square[int(i)].data
+            row = unique_rows.get(b)
+            if row is None:
+                row = len(unique_rows)
+                unique_rows[b] = row
+            cell_host_row[i] = row
+        if unique_rows:
+            host_shares = np.frombuffer(
+                b"".join(unique_rows.keys()), np.uint8
+            ).reshape(len(unique_rows), appconsts.SHARE_SIZE)
+        else:
+            host_shares = np.zeros((0, appconsts.SHARE_SIZE), np.uint8)
+        rows, cols = extend_tpu.assembled_roots(
+            self.blob_pool.arena, host_shares, cell_host_row,
+            np.stack(ns_rows), cell_blob, cell_first,
+            np.array(blob_lens, np.int32), data_start, data_len, k,
+        )
+        return da.DataAvailabilityHeader(
+            [r.tobytes() for r in rows], [c.tobytes() for c in cols]
+        )
 
     def _extend_and_hash(self, data_square) -> tuple:
         """The EDS-producing path: square -> EDS + DAH (ExtendBlock /
@@ -430,10 +539,10 @@ class App:
                     size -= len(txs[-1])
                     txs = txs[:-1]
 
-        data_square, txs = square_pkg.build(
+        data_square, txs, builder = square_pkg.build_ex(
             txs, self.app_version, self.gov_square_size_upper_bound()
         )
-        dah = self._proposal_dah(data_square)
+        dah = self._proposal_dah(data_square, builder)
         return ProposalBlockData(
             txs=txs,
             square_size=square_pkg.square_size(len(data_square)),
@@ -523,12 +632,12 @@ class App:
                 continue
             ante(ctx, tx, len(raw_tx))
 
-        data_square = square_pkg.construct(
+        data_square, builder = square_pkg.construct_ex(
             block_data.txs, self.app_version, self.gov_square_size_upper_bound()
         )
         if square_pkg.square_size(len(data_square)) != block_data.square_size:
             return False
-        dah = self._proposal_dah(data_square)
+        dah = self._proposal_dah(data_square, builder)
         return dah.hash() == block_data.hash
 
     # ------------------------------------------------------------------ #

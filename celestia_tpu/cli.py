@@ -122,6 +122,10 @@ def cmd_start(args):
     live = node.app.resolve_extend_backend(
         node.app.gov_square_size_upper_bound()
     )
+    if live == "tpu":
+        # device blob arena: mempool blob bytes stage in HBM at CheckTx,
+        # so proposals assemble squares on device (metadata-only upload)
+        node.app.enable_blob_pool()
     server = RpcServer(node, port=args.port)
     server.start()
     # the reference node serves gRPC alongside RPC (app/app.go:693-719);

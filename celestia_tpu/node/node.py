@@ -166,6 +166,21 @@ class Node:
             res = self.app.check_tx(raw)
             if res.code == 0:
                 self.mempool.add(raw, res.priority, self.app.height)
+        if res.code == 0 and self.app.blob_pool is not None:
+            # stage blob bytes in the device arena at ADMISSION time —
+            # off the consensus hot path — so the proposal can assemble
+            # the square on device without re-uploading them
+            # (ops/blob_pool.py; every miss falls back safely)
+            from celestia_tpu import blob as blob_pkg
+
+            btx, is_blob = blob_pkg.unmarshal_blob_tx(raw)
+            if is_blob:
+                for b in btx.blobs:
+                    try:
+                        self.app.blob_pool.put(b.data)
+                    except Exception as e:  # noqa: BLE001 — cache only
+                        log.info("blob staging failed", error=str(e))
+                        break
         return res
 
     # --- block production (the proposer+validator round) ---

@@ -446,11 +446,106 @@ def _handler_for(node: Node):
                         self._reply(
                             {"nonce": att["nonce"], "proof": proof.to_json()}
                         )
+                elif parts[0] == "cosmos":
+                    self._gateway_get(parts)
                 else:
                     self._reply({"error": "unknown route"}, 404)
             except Exception as e:  # noqa: BLE001
                 log.error("query failed", path=self.path, error=str(e))
                 self._reply({"error": str(e)}, 500)
+
+        def _gateway_get(self, parts):
+            """grpc-gateway REST shim (the SDK's `/cosmos/...` JSON
+            routes, api.enable in the reference's app.toml): the same
+            services the gRPC API exposes (node/grpc_api.py), spelled as
+            the REST paths Cosmos tooling (cosmjs/cosmpy, explorers)
+            dials. Thin aliases over the node functions the native
+            routes above already serve."""
+            from celestia_tpu.x.bank import BALANCE_PREFIX, split_balance_key
+
+            if parts[:4] == ["cosmos", "auth", "v1beta1", "accounts"] and len(parts) == 5:
+                acc = node.app.accounts.get_account(parts[4])
+                if acc is None:
+                    self._reply({"error": "account not found"}, 404)
+                    return
+                self._reply({
+                    "account": {
+                        "@type": "/cosmos.auth.v1beta1.BaseAccount",
+                        "address": acc.address,
+                        "account_number": str(acc.account_number),
+                        "sequence": str(acc.sequence),
+                    }
+                })
+            elif parts[:4] == ["cosmos", "bank", "v1beta1", "balances"] and len(parts) == 5:
+                address = parts[4]
+                prefix = BALANCE_PREFIX + address.encode() + b"\x00"
+                balances = []
+                for key, raw in node.app.store.iter_prefix(prefix):
+                    _addr, denom = split_balance_key(key)
+                    amount = int.from_bytes(raw, "big")
+                    if amount:
+                        balances.append(
+                            {"denom": denom, "amount": str(amount)}
+                        )
+                self._reply({"balances": balances, "pagination": None})
+            elif parts[:5] == ["cosmos", "base", "tendermint", "v1beta1", "blocks"] and len(parts) == 6:
+                if parts[5] == "latest":
+                    height = node.app.height
+                else:
+                    try:
+                        height = int(parts[5])
+                    except ValueError:
+                        self._reply({"error": "invalid block height"}, 400)
+                        return
+                block = node.get_block(height)
+                if block is None:
+                    self._reply({"error": "block not found"}, 404)
+                    return
+                j = block.to_json()
+                self._reply({
+                    "block_id": {"hash": j["app_hash"]},
+                    "block": {
+                        "header": {
+                            "chain_id": node.app.chain_id,
+                            "height": str(block.height),
+                            "time": block.time,
+                            "data_hash": j["data_hash"],
+                            "app_hash": j["app_hash"],
+                        },
+                        "data": {"txs": j["txs"]},
+                    },
+                })
+            elif parts[:5] == ["cosmos", "base", "tendermint", "v1beta1", "node_info"]:
+                s = node.status()
+                self._reply({
+                    "default_node_info": {"network": s["chain_id"]},
+                    "application_version": {
+                        "app_name": "celestia-tpu",
+                        "version": s.get("app_version", 0),
+                    },
+                })
+            elif parts[:4] == ["cosmos", "tx", "v1beta1", "txs"] and len(parts) == 5:
+                try:
+                    txhash = bytes.fromhex(parts[4])
+                except ValueError:
+                    self._reply({"error": "invalid tx hash"}, 400)
+                    return
+                found = node.get_tx(txhash)
+                if found is None:
+                    self._reply({"error": "tx not found"}, 404)
+                    return
+                block, idx = found
+                result = block.to_json()["tx_results"][idx]
+                self._reply({
+                    "tx_response": {
+                        "height": str(block.height),
+                        "txhash": parts[4].upper(),
+                        "code": result["code"],
+                        "raw_log": result["log"],
+                    }
+                })
+            else:
+                self._reply({"error": "unknown route"}, 404)
 
         def do_POST(self):
             length = int(self.headers.get("Content-Length", 0))
@@ -478,6 +573,27 @@ def _handler_for(node: Node):
                     self._reply(
                         {"code": res.code, "log": res.log, "priority": res.priority}
                     )
+                elif parts == ["cosmos", "tx", "v1beta1", "txs"]:
+                    # grpc-gateway BroadcastTx: base64 tx_bytes, JSON
+                    # tx_response reply (the shape cosmjs/cosmpy expect)
+                    import base64
+                    import hashlib as _hashlib
+
+                    raw = base64.b64decode(body["tx_bytes"])
+                    res = node.broadcast_tx(raw)
+                    validator = getattr(node, "validator", None)
+                    if res.code == 0 and validator is not None:
+                        threading.Thread(
+                            target=validator.gossip_tx, args=(raw,),
+                            daemon=True,
+                        ).start()
+                    self._reply({
+                        "tx_response": {
+                            "code": res.code,
+                            "txhash": _hashlib.sha256(raw).hexdigest().upper(),
+                            "raw_log": res.log,
+                        }
+                    })
                 elif parts == ["produce_block"]:
                     block = node.produce_block()
                     self._reply(block.to_json())

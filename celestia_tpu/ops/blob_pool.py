@@ -1,0 +1,144 @@
+"""Device-resident blob arena — the mempool's blob bytes live in HBM.
+
+The node proposal wall time is dominated by moving the 8 MB square
+host→device at PrepareProposal/ProcessProposal time (bench config 8: the
+upload alone exceeds the native CPU baseline through this environment's
+tunnel). But the bulk of a DA square is BLOB bytes, and those bytes are
+known long before the proposal: they arrive with the BlobTx at CheckTx.
+
+This module stages them: on mempool admission the node appends each
+blob's data into a fixed device arena (async `device_put` + a donated
+`dynamic_update_slice` — off the consensus hot path). At proposal time
+the device assembles the square itself (ops/extend_tpu.assembled_roots):
+only the compact tx/PFB/padding shares, the 34-byte share prefixes, and
+int32 offset vectors cross the interconnect — tens of KB instead of MB —
+and the extend+NMT pipeline runs fused on the assembled square without
+it ever existing host-side.
+
+ref: the reference keeps mempool blobs host-side and re-marshals them
+into the square per proposal (pkg/square/builder.go); on a TPU node the
+same bytes are already resident where the MXU needs them.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import threading
+
+
+def blob_key(data: bytes) -> bytes:
+    """Identity of pooled blob BYTES (content-addressed, like the CAT
+    pool's tx keys): sha256 of the raw blob data."""
+    return hashlib.sha256(data).digest()
+
+
+def _pad_len(n: int) -> int:
+    """Arena slots are rounded to 4 KB so the donated update-slice jit
+    compiles for a handful of sizes, not one per blob length."""
+    return max(4096, (n + 4095) // 4096 * 4096)
+
+
+@functools.lru_cache(maxsize=16)
+def _jitted_insert(pad: int):
+    import jax
+    import jax.numpy as jnp
+
+    def insert(arena, chunk, offset):
+        return jax.lax.dynamic_update_slice(arena, chunk, (offset,))
+
+    # donating the arena lets XLA update in place instead of copying
+    # the whole buffer per insert
+    return jax.jit(insert, donate_argnums=(0,))
+
+
+class DeviceBlobArena:
+    """Fixed-size device byte arena with a host-side bump allocator.
+
+    Thread-safe for the node's use (CheckTx threads insert, the proposal
+    path reads). Eviction is wholesale: when the arena cannot fit a new
+    blob, it resets — correctness never depends on residency (the
+    proposal path falls back to the plain host-upload route for any blob
+    it cannot find), so the arena is purely a transfer cache.
+    """
+
+    def __init__(self, capacity_bytes: int = 64 * 1024 * 1024, device=None):
+        import jax
+        import jax.numpy as jnp
+
+        self.capacity = int(capacity_bytes)
+        self._device = device
+        self._arena = jax.device_put(
+            jnp.zeros((self.capacity,), jnp.uint8), device
+        )
+        self._offsets: dict[bytes, tuple[int, int]] = {}  # key -> (off, len)
+        self._next = 0
+        # REENTRANT: the proposal path holds this lock across its whole
+        # read (offset lookups -> device dispatch -> root fetch, see
+        # App._assembled_proposal_dah) while the nested offset_of calls
+        # re-acquire it. Serializing against put() is what makes the
+        # donated in-place arena update safe: a concurrent insert would
+        # otherwise DELETE the buffer the proposal just dispatched on
+        # (donate_argnums), and a wholesale reset would rewrite bytes at
+        # offsets the proposal already snapshotted.
+        self._lock = threading.RLock()
+
+    @property
+    def lock(self):
+        """Hold across a multi-step read (snapshot offsets + dispatch +
+        fetch) to exclude concurrent staging; see __init__."""
+        return self._lock
+
+    # ---- writes (CheckTx admission path) ----
+
+    def put(self, data: bytes) -> bytes:
+        """Stage blob bytes on device; returns the content key.
+        Idempotent; resets the arena when full (transfer cache
+        semantics)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        key = blob_key(data)
+        with self._lock:
+            if key in self._offsets:
+                return key
+            pad = _pad_len(len(data))
+            if pad > self.capacity:
+                return key  # oversized: never resident, always fallback
+            if self._next + pad > self.capacity:
+                # wholesale reset: older entries re-stage on next use
+                self._offsets.clear()
+                self._next = 0
+            offset = self._next
+            self._next += pad
+            chunk = np.zeros((pad,), np.uint8)
+            chunk[: len(data)] = np.frombuffer(data, np.uint8)
+            self._arena = _jitted_insert(pad)(
+                self._arena, jax.device_put(jnp.asarray(chunk), self._device),
+                offset,
+            )
+            self._offsets[key] = (offset, len(data))
+            return key
+
+    def drop(self, key: bytes) -> None:
+        """Forget a blob (committed/evicted tx). Space is reclaimed at
+        the next wholesale reset — a bump allocator stays trivial and
+        the arena is a cache, not a ledger."""
+        with self._lock:
+            self._offsets.pop(key, None)
+
+    # ---- reads (proposal path) ----
+
+    def offset_of(self, key: bytes) -> tuple[int, int] | None:
+        with self._lock:
+            return self._offsets.get(key)
+
+    @property
+    def arena(self):
+        """The device buffer (pass to the assembly program)."""
+        return self._arena
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(ln for _off, ln in self._offsets.values())

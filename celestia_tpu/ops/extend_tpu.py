@@ -235,6 +235,129 @@ def eds_roots_device(eds):
     return np.asarray(rows), np.asarray(cols)
 
 
+# ------------------------------------------------------------------ #
+# Device-side square assembly from the resident blob arena
+# (ops/blob_pool.py). The proposal path's wall time is otherwise
+# dominated by uploading the 8 MB square; with the blob bytes already
+# in HBM, only share metadata (a few hundred KB) crosses per proposal
+# and the assembled square feeds the fused extend+NMT pipeline without
+# ever existing host-side.
+
+
+def _assemble_square(arena, host_shares, cells_meta, ns_len_table, k: int):
+    """Build the (k,k,512) share square on device.
+
+    cells_meta is ONE packed (5, S) int32 block — [host_row | blob_idx |
+    is_first | data_start | data_len] — and ns_len_table one (B, 33)
+    uint8 block (29-byte namespace ‖ 4-byte BE blob length): per
+    proposal exactly TWO metadata buffers cross the interconnect, which
+    matters on a high-RTT link where every transfer pays latency.
+
+    Each cell is either a host-table share (host_row >= 0) or a sparse
+    blob share assembled in place: namespace ‖ info ‖ [seq len] ‖
+    arena[data_start : data_start+data_len] ‖ zeros — exactly the
+    sparse splitter's layout (shares/splitters.py write), so the result
+    is byte-identical to the host-built square (pinned by tests)."""
+    j = jnp.arange(SHARE_SIZE, dtype=jnp.int32)  # (512,)
+    cell_host_row = cells_meta[0]
+    cell_blob = cells_meta[1]
+    cell_first = cells_meta[2].astype(bool)
+    data_start = cells_meta[3]
+    data_len = cells_meta[4]
+
+    blob_idx = jnp.clip(cell_blob, 0, ns_len_table.shape[0] - 1)
+    ns = ns_len_table[blob_idx, :NAMESPACE_SIZE]  # (S, 29)
+    info = jnp.where(cell_first, 1, 0).astype(jnp.uint8)  # share version 0
+    seq_bytes = ns_len_table[blob_idx, NAMESPACE_SIZE:]  # (S, 4) BE length
+    prefix = jnp.concatenate([ns, info[:, None], seq_bytes], axis=-1)  # (S, 34)
+    prefix_len = jnp.where(cell_first, 34, 30).astype(jnp.int32)
+
+    pref_padded = jnp.pad(prefix, ((0, 0), (0, SHARE_SIZE - prefix.shape[1])))
+    data_pos = j[None, :] - prefix_len[:, None]  # (S, 512)
+    arena_idx = jnp.clip(
+        data_start[:, None] + data_pos, 0, arena.shape[0] - 1
+    )
+    arena_vals = arena[arena_idx]  # (S, 512) HBM gather
+    in_prefix = j[None, :] < prefix_len[:, None]
+    in_data = (~in_prefix) & (data_pos < data_len[:, None])
+    blob_cells = jnp.where(
+        in_prefix, pref_padded, jnp.where(in_data, arena_vals, 0)
+    )
+
+    hrow = jnp.clip(cell_host_row, 0, host_shares.shape[0] - 1)
+    host_cells = host_shares[hrow]
+    cells = jnp.where(
+        (cell_host_row >= 0)[:, None], host_cells, blob_cells
+    )
+    return cells.reshape(k, k, SHARE_SIZE)
+
+
+@functools.lru_cache(maxsize=16)
+def _jitted_assembled_roots(k: int, h_pad: int, b_pad: int, n_arena: int):
+    m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
+
+    @jax.jit
+    def run(arena, host_shares, cells_meta, ns_len_table):
+        square = _assemble_square(arena, host_shares, cells_meta,
+                                  ns_len_table, k)
+        return _rows_cols_only(square, m2)
+
+    return run
+
+
+def _pow2_at_least(n: int, floor: int) -> int:
+    p = floor
+    while p < n:
+        p <<= 1
+    return p
+
+
+def assembled_roots(
+    arena,
+    host_shares: np.ndarray,     # (H, 512) uint8
+    cell_host_row: np.ndarray,   # (S,) int32, -1 = arena cell
+    ns_table: np.ndarray,        # (B, 29) uint8
+    cell_blob: np.ndarray,       # (S,) int32 into ns_table
+    cell_first: np.ndarray,      # (S,) bool — sequence-start cells
+    blob_len: np.ndarray,        # (B,) int32 — blob byte lengths
+    data_start: np.ndarray,      # (S,) int32 — absolute arena offsets
+    data_len: np.ndarray,        # (S,) int32 — data bytes in this cell
+    k: int,
+):
+    """Host entry: assemble the square ON DEVICE from the blob arena and
+    return numpy (row_roots, col_roots) — the roots-only proposal path
+    with only metadata uploaded. Host/blob padding counts are padded to
+    powers of two so the jit cache stays small."""
+    h_pad = _pow2_at_least(max(len(host_shares), 1), 16)
+    b_pad = _pow2_at_least(max(len(ns_table), 1), 8)
+    hs = np.zeros((h_pad, SHARE_SIZE), np.uint8)
+    if len(host_shares):
+        hs[: len(host_shares)] = host_shares
+    # pack [ns ‖ BE length] per blob and the five per-cell vectors into
+    # single buffers: 2 metadata transfers per proposal, not 8
+    nslen = np.zeros((b_pad, NAMESPACE_SIZE + 4), np.uint8)
+    if len(ns_table):
+        nslen[: len(ns_table), :NAMESPACE_SIZE] = ns_table
+        bl = np.asarray(blob_len, dtype=">u4")
+        nslen[: len(ns_table), NAMESPACE_SIZE:] = bl.view(np.uint8).reshape(
+            len(ns_table), 4
+        )
+    cells_meta = np.stack(
+        [
+            cell_host_row.astype(np.int32),
+            cell_blob.astype(np.int32),
+            cell_first.astype(np.int32),
+            data_start.astype(np.int32),
+            data_len.astype(np.int32),
+        ]
+    )
+    fn = _jitted_assembled_roots(k, h_pad, b_pad, int(arena.shape[0]))
+    rows, cols = fn(
+        arena, jnp.asarray(hs), jnp.asarray(cells_meta), jnp.asarray(nslen)
+    )
+    return np.asarray(rows), np.asarray(cols)
+
+
 def extend_and_root_batched(shares: jnp.ndarray, m2: jnp.ndarray):
     """(B, k, k, 512) -> batched (eds, row_roots, col_roots, dah).
 

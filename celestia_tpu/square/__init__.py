@@ -220,6 +220,20 @@ class Builder:
         self.done = True
         return self._square
 
+    def blob_layout(self) -> list[tuple[int, "blob_pkg.Blob"]]:
+        """Per-blob placement after export: [(first_share_index, blob)].
+
+        The provenance the device-side square assembly consumes
+        (ops/extend_tpu.assembled_roots): every share in
+        [start, start + sparse_shares_needed(len(blob.data))) is that
+        blob's sparse share; everything else is host bytes."""
+        if not self.done:
+            self.export()
+        return [
+            (self.pfbs[e.pfb_index].share_indexes[e.blob_index], e.blob)
+            for e in self.blobs
+        ]
+
     def find_blob_starting_index(self, pfb_index: int, blob_index: int) -> int:
         """pfb_index counts from the start of the tx set. ref: builder.go:212"""
         if pfb_index < len(self.txs):
@@ -308,8 +322,11 @@ def write_square(
     return square
 
 
-def build(txs: list[bytes], app_version: int, max_square_size: int) -> tuple[Square, list[bytes]]:
-    """Proposer: greedy best-effort packing. ref: pkg/square/square.go:22"""
+def build_ex(
+    txs: list[bytes], app_version: int, max_square_size: int
+) -> tuple[Square, list[bytes], Builder]:
+    """build() that also returns the Builder (blob-placement provenance
+    for the device-side square assembly)."""
     builder = Builder(max_square_size, app_version)
     normal_txs: list[bytes] = []
     blob_txs: list[bytes] = []
@@ -325,7 +342,21 @@ def build(txs: list[bytes], app_version: int, max_square_size: int) -> tuple[Squ
         else:
             if builder.append_tx(tx):
                 normal_txs.append(tx)
-    return builder.export(), normal_txs + blob_txs
+    return builder.export(), normal_txs + blob_txs, builder
+
+
+def build(txs: list[bytes], app_version: int, max_square_size: int) -> tuple[Square, list[bytes]]:
+    """Proposer: greedy best-effort packing. ref: pkg/square/square.go:22"""
+    square, kept, _builder = build_ex(txs, app_version, max_square_size)
+    return square, kept
+
+
+def construct_ex(
+    txs: list[bytes], app_version: int, max_square_size: int
+) -> tuple[Square, Builder]:
+    """construct() that also returns the Builder (provenance)."""
+    b = Builder.from_txs(max_square_size, app_version, txs)
+    return b.export(), b
 
 
 def construct(txs: list[bytes], app_version: int, max_square_size: int) -> Square:

@@ -1,0 +1,115 @@
+"""grpc-gateway REST shim: the SDK's `/cosmos/...` JSON routes served
+over the node's HTTP server (the reference enables these via api.enable;
+generated Cosmos tooling dials them). Thin aliases over the same node
+functions the native routes serve — both spellings must agree."""
+
+import base64
+import json
+import urllib.request
+
+import pytest
+
+from celestia_tpu.app import App
+from celestia_tpu.crypto import PrivateKey
+from celestia_tpu.node import Node
+from celestia_tpu.node.rpc import RpcServer
+from celestia_tpu.tx import Fee, sign_tx
+from celestia_tpu.user import Signer
+from celestia_tpu.x.bank import MsgSend
+
+ALICE = PrivateKey.from_secret(b"gateway-alice")
+BOB = PrivateKey.from_secret(b"gateway-bob")
+
+
+@pytest.fixture
+def served():
+    app = App(chain_id="gateway-1")
+    app.init_chain(
+        {ALICE.bech32_address(): 1_000_000_000,
+         BOB.bech32_address(): 5_000},
+        genesis_time=0.0,
+    )
+    node = Node(app)
+    node.produce_block(15.0)
+    srv = RpcServer(node, port=0)
+    srv.start()
+    try:
+        yield node, f"http://127.0.0.1:{srv.port}"
+    finally:
+        srv.stop()
+
+
+def _get(base, path, expect=200):
+    try:
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        assert e.code == expect, (e.code, expect)
+        return e.code, json.loads(e.read())
+
+
+def _post(base, path, body):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+class TestRestGateway:
+    def test_auth_account(self, served):
+        node, base = served
+        _s, res = _get(base, f"/cosmos/auth/v1beta1/accounts/{ALICE.bech32_address()}")
+        acc = res["account"]
+        assert acc["@type"] == "/cosmos.auth.v1beta1.BaseAccount"
+        assert acc["address"] == ALICE.bech32_address()
+        assert acc["sequence"] == "0"
+
+    def test_bank_balances_all_denoms(self, served):
+        node, base = served
+        bob = BOB.bech32_address()
+        node.app.bank.mint(bob, 777, "transfer/channel-0/utia")
+        node.app.store.commit_hash_refresh()
+        _s, res = _get(base, f"/cosmos/bank/v1beta1/balances/{bob}")
+        by_denom = {b["denom"]: b["amount"] for b in res["balances"]}
+        assert by_denom["utia"] == "5000"
+        assert by_denom["transfer/channel-0/utia"] == "777"
+
+    def test_blocks_latest_and_by_height(self, served):
+        node, base = served
+        _s, latest = _get(base, "/cosmos/base/tendermint/v1beta1/blocks/latest")
+        assert latest["block"]["header"]["chain_id"] == "gateway-1"
+        h = int(latest["block"]["header"]["height"])
+        _s, by_h = _get(base, f"/cosmos/base/tendermint/v1beta1/blocks/{h}")
+        assert by_h["block"]["header"]["height"] == str(h)
+
+    def test_broadcast_and_get_tx(self, served):
+        node, base = served
+        signer = Signer.setup_single(ALICE, node)
+        tx = sign_tx(
+            ALICE,
+            [MsgSend(ALICE.bech32_address(), BOB.bech32_address(), 123)],
+            node.app.chain_id, signer.account_number, signer.sequence,
+            Fee(amount=20_000, gas_limit=200_000),
+        ).marshal()
+        res = _post(
+            base, "/cosmos/tx/v1beta1/txs",
+            {"tx_bytes": base64.b64encode(tx).decode(), "mode": "BROADCAST_MODE_SYNC"},
+        )
+        assert res["tx_response"]["code"] == 0, res
+        txhash = res["tx_response"]["txhash"]
+        node.produce_block(30.0)
+        _s, got = _get(base, f"/cosmos/tx/v1beta1/txs/{txhash}")
+        assert got["tx_response"]["code"] == 0
+        assert int(got["tx_response"]["height"]) == node.app.height
+
+    def test_node_info(self, served):
+        _node, base = served
+        _s, res = _get(base, "/cosmos/base/tendermint/v1beta1/node_info")
+        assert res["default_node_info"]["network"] == "gateway-1"
+
+    def test_unknown_gateway_route_404s(self, served):
+        _node, base = served
+        code, _ = _get(base, "/cosmos/staking/v1beta1/nonexistent", expect=404)
+        assert code == 404
