@@ -726,6 +726,36 @@ def tunnel_bandwidth_mb_s():
     return {"up": round(up, 1), "down": round(down, 1)}
 
 
+def _probe_device(timeout_s: float = 120.0):
+    """(reachable, why) — whether the accelerator answers a tiny round
+    trip within the timeout, and the real failure reason otherwise
+    (init error vs tunnel timeout). The tunnel can die entirely
+    (observed); a clean JSON error line beats a hang."""
+    import threading
+
+    ok: list = []
+    err: list = []
+
+    def attempt():
+        try:
+            import jax
+
+            x = jax.device_put(np.ones((8,), np.uint8))
+            np.asarray(x)
+            ok.append(True)
+        except Exception as e:  # noqa: BLE001 — surfaced in the JSON
+            err.append(f"{type(e).__name__}: {e}")
+
+    t = threading.Thread(target=attempt, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if ok:
+        return True, None
+    if err:
+        return False, err[0]
+    return False, f"device round trip timed out after {timeout_s:.0f}s (tunnel down)"
+
+
 def main():
     headline_k = int(sys.argv[1]) if len(sys.argv) > 1 else 128
 
@@ -734,6 +764,22 @@ def main():
     from celestia_tpu.ops import enable_compile_cache
 
     enable_compile_cache()
+
+    reachable, why = _probe_device()
+    if not reachable:
+        print(
+            json.dumps(
+                {
+                    "metric": f"extend_block_k{headline_k}_tpu_ms_per_square",
+                    "value": None,
+                    "unit": "ms",
+                    "vs_baseline": None,
+                    "error": f"accelerator unreachable: {why} — "
+                             "no numbers measured",
+                }
+            )
+        )
+        sys.exit(1)
 
     configs = {}
     configs["1_smoke_k2"] = bench_extend_config(2)

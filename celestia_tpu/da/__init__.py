@@ -96,22 +96,28 @@ class ExtendedDataSquare:
         return [_axis_root(self.col(j), j, self.original_width) for j in range(self.width)]
 
 
+def erasured_leaf_namespace(
+    axis_index: int, share_index: int, cell: bytes, k: int
+) -> bytes:
+    """The wrapper's quadrant rule for ONE leaf
+    (pkg/wrapper/nmt_wrapper.go:93-114): the share's own namespace in
+    Q0, the parity namespace otherwise. The single source of the rule —
+    roots, range/absence proofs, and fraud-proof verification all
+    consume it (directly or via erasured_axis_leaves)."""
+    if axis_index < k and share_index < k:
+        return cell[:NAMESPACE_SIZE]
+    return PARITY_NS
+
+
 def erasured_axis_leaves(
     cells: list[bytes], axis_index: int, k: int
 ) -> list[bytes]:
-    """Namespaced NMT leaves of one row/column with the wrapper's quadrant
-    rule (pkg/wrapper/nmt_wrapper.go:93-114): leaf = ns ‖ share where ns is
-    the share's own namespace in Q0 and the parity namespace otherwise.
-    The single source of the rule — roots, range proofs and absence proofs
-    all consume it."""
-    leaves = []
-    for share_index, cell in enumerate(cells):
-        if axis_index < k and share_index < k:
-            nid = cell[:NAMESPACE_SIZE]
-        else:
-            nid = PARITY_NS
-        leaves.append(nid + cell)
-    return leaves
+    """Namespaced NMT leaves of one row/column: leaf = ns ‖ share with ns
+    per erasured_leaf_namespace."""
+    return [
+        erasured_leaf_namespace(axis_index, share_index, cell, k) + cell
+        for share_index, cell in enumerate(cells)
+    ]
 
 
 def _axis_root(cells: list[bytes], axis_index: int, k: int) -> bytes:

@@ -130,6 +130,15 @@ class NmtRangeProof:
         total = self.tree_size
         if total is None:
             raise ValueError("tree_size must be set before verification")
+        # an attacker-controlled proof with a range outside [0, total)
+        # would make rec() classify the WHOLE tree as out-of-range and
+        # return the first supplied node verbatim — i.e. "prove" any
+        # root without binding a single leaf. Ranges must be real.
+        if not (0 <= self.start < self.end <= total):
+            raise ValueError(
+                f"proof range [{self.start}, {self.end}) invalid for "
+                f"tree size {total}"
+            )
 
         def rec(lo: int, hi: int) -> bytes:
             if hi <= self.start or lo >= self.end:
