@@ -36,15 +36,25 @@ def _uvarint_slow(n: int) -> bytes:
             return bytes(out)
 
 
-# one- and two-byte encodings cover every length delimiter and share
-# index the builder emits in practice; table lookup beats the loop
-_UVARINT_TABLE = tuple(_uvarint_slow(i) for i in range(16384))
+# covers every length delimiter and share index the builder emits (the
+# worst-case share index is 128·128 = 16384, so the table must extend
+# past it); table lookup beats the loop
+_UVARINT_TABLE = tuple(_uvarint_slow(i) for i in range(1 << 16))
 
 
 def uvarint(n: int) -> bytes:
-    if 0 <= n < 16384:
+    if 0 <= n < (1 << 16):
         return _UVARINT_TABLE[n]
     return _uvarint_slow(n)
+
+
+def uvarint_len(n: int) -> int:
+    """Byte length of uvarint(n) without building it (7 bits per byte)."""
+    length = 1
+    while n >= 0x80:
+        n >>= 7
+        length += 1
+    return length
 
 
 def read_uvarint(data: bytes, pos: int) -> tuple[int, int]:
@@ -295,10 +305,10 @@ class IndexWrapper:
 def marshal_index_wrapper_size(tx: bytes, share_indexes: list[int]) -> int:
     """len(marshal_index_wrapper(tx, share_indexes)) without building the
     bytes — the builder's capacity accounting calls this per blob tx."""
-    packed_len = sum(len(uvarint(i)) for i in share_indexes)
-    size = 1 + len(uvarint(len(tx))) + len(tx) if tx else 0
+    packed_len = sum(uvarint_len(i) for i in share_indexes)
+    size = 1 + uvarint_len(len(tx)) + len(tx) if tx else 0
     if packed_len:
-        size += 1 + len(uvarint(packed_len)) + packed_len
+        size += 1 + uvarint_len(packed_len) + packed_len
     return size + 1 + 1 + 4  # field 3: tag, len, "INDX"
 
 
