@@ -135,6 +135,35 @@ class TestHandshake:
         # commitment cleared on B after the ack round
         assert node_b.app.ibc.pending_packets("transfer", chan_b) == []
 
+    def test_timeout_refund_over_handshaken_channel(self):
+        """MsgTimeout over a connection-bound channel: the refund needs a
+        verified counterparty header past the timeout plus a receipt
+        ABSENCE proof — the proof client resolved THROUGH the
+        connection (client_for_channel), not a direct binding."""
+        node_a, node_b, relayer = _setup()
+        chan_a, chan_b = relayer.handshake(100.0, 100.0)
+
+        alice, bob = ALICE.bech32_address(), BOB.bech32_address()
+        a_signer = Signer.setup_single(ALICE, node_a)
+        res = a_signer.submit_tx([
+            MsgTransfer("transfer", chan_a, "utia", 3_000, alice, bob,
+                        timeout_timestamp=750.0)
+        ])
+        assert res.code == 0, res.log
+        node_a.produce_block(700.0)
+        esc = escrow_address("transfer", chan_a)
+        assert node_a.app.bank.get_balance(esc) == 3_000
+        before = node_a.app.bank.get_balance(alice)
+
+        # B never receives the packet; let B's clock pass the timeout
+        node_b.produce_block(800.0)
+        packet = node_a.app.ibc.get_packet("transfer", chan_a, 1)
+        relayer.timeout(packet, node_a, node_b, relayer.signer_a, 820.0)
+
+        assert node_a.app.bank.get_balance(esc) == 0  # refunded
+        assert node_a.app.bank.get_balance(alice) == before + 3_000
+        assert node_a.app.ibc.pending_packets("transfer", chan_a) == []
+
     def test_try_with_wrong_counterparty_client_rejected(self):
         """The INIT proof binds the client PAIR: a Try claiming a
         different counterparty client cannot reconstruct the committed
