@@ -119,7 +119,23 @@ class DeviceBlobArena:
                 offset,
             )
             self._offsets[key] = (offset, len(data))
+            self._publish_metrics()
             return key
+
+    def _publish_metrics(self) -> None:
+        """Operator visibility on /metrics: how much of the mempool's
+        blob data is HBM-resident and how full the arena is."""
+        try:
+            from celestia_tpu.telemetry import metrics
+
+            metrics.set_gauge(
+                "blob_arena_resident_bytes",
+                float(sum(ln for _o, ln in self._offsets.values())),
+            )
+            metrics.set_gauge("blob_arena_used_bytes", float(self._next))
+            metrics.set_gauge("blob_arena_capacity_bytes", float(self.capacity))
+        except Exception:  # noqa: BLE001 — metrics must never break staging
+            pass
 
     def drop(self, key: bytes) -> None:
         """Forget a blob (committed/evicted tx). Space is reclaimed at
