@@ -65,6 +65,9 @@ class ExtendedDataSquare:
     @data.setter
     def data(self, value: np.ndarray) -> None:
         self._data = value
+        # the device copy no longer matches — drop it, or device_data
+        # consumers (repair_eds prefers it) would repair stale bytes
+        self._device = None
 
     @property
     def device_data(self):

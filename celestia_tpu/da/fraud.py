@@ -150,7 +150,7 @@ def verify_befp(proof: BadEncodingFraudProof, dah) -> bool:
         raise ValueError(f"axis index {proof.index} out of range")
     if len(proof.shares) != w or len(proof.proofs) != w:
         raise ValueError("proof must carry all 2k shares with proofs")
-    if len(dah.row_roots) != w:
+    if len(dah.row_roots) != w or len(dah.column_roots) != w:
         raise ValueError("square size does not match the DAH")
     for s in proof.shares:
         if len(s) != SHARE_SIZE:

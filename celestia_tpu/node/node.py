@@ -84,6 +84,11 @@ class Mempool:
         ]
         for k in expired:
             del self.txs[k]
+            # a TTL-expired tx was never committed — forgetting it from
+            # _seen lets a legitimate resubmission re-propagate through
+            # the CAT want/have handshake instead of being refused by
+            # every peer that saw the first attempt
+            self._seen.pop(k, None)
         # seen records outlive the pool entry by one extra TTL window so
         # late duplicate offers are still deduplicated, then age out
         # (bounded memory in a long-running node)
@@ -108,6 +113,12 @@ class Block:
     data_hash: bytes
     app_hash: bytes
     tx_results: list[TxResult] = dataclasses.field(default_factory=list)
+    # slashing.Equivocation entries delivered with this block (ABCI
+    # ByzantineValidators analogue). Evidence is state-affecting —
+    # BeginBlock slashes/tombstones from it — so the block store MUST
+    # carry it or crash-recovery replay recomputes a different app hash
+    # (the reference's blocks persist ByzantineValidators the same way).
+    evidence: list = dataclasses.field(default_factory=list)
 
     def to_json(self) -> dict:
         return {
@@ -121,10 +132,17 @@ class Block:
                 {"code": r.code, "log": r.log, "gas_used": r.gas_used}
                 for r in self.tx_results
             ],
+            "evidence": [
+                {"validator": e.validator, "height": e.height,
+                 "power": e.power}
+                for e in self.evidence
+            ],
         }
 
     @classmethod
     def from_json(cls, d: dict) -> "Block":
+        from celestia_tpu.x.slashing import Equivocation
+
         return cls(
             height=d["height"],
             time=d["time"],
@@ -135,6 +153,11 @@ class Block:
             tx_results=[
                 TxResult(code=r["code"], log=r["log"], gas_used=r["gas_used"])
                 for r in d.get("tx_results", [])
+            ],
+            evidence=[
+                Equivocation(validator=e["validator"], height=e["height"],
+                             power=e.get("power", 0))
+                for e in d.get("evidence", [])
             ],
         )
 
@@ -258,6 +281,7 @@ class Node:
             data_hash=proposal.hash,
             app_hash=app_hash,
             tx_results=results,
+            evidence=list(evidence or []),
         )
         self._store_block(block)
 
@@ -461,7 +485,7 @@ class Node:
         da_verified = node._batch_verify_data_availability(app, pending)
         for block in pending:
             height = block.height
-            app.begin_block(block.time)
+            app.begin_block(block.time, evidence=block.evidence)
             for raw in block.txs:
                 app.deliver_tx(raw)
             app.end_block()

@@ -56,6 +56,21 @@ class TestDeviceResidentExtend:
         assert lazy.row(0) == eds.row(0)
         assert lazy.row_roots() == eds.row_roots()
 
+
+    def test_data_setter_invalidates_device_copy(self, oracle):
+        """ADVICE r4: reassigning .data on a device-resident EDS must
+        drop the stale device buffer — repair_eds prefers device_data
+        and would otherwise repair/verify outdated bytes."""
+        sq, eds, _ = oracle
+        eds_dev, _r, _c = extend_tpu.extend_roots_device_resident(sq)
+        lazy = da.ExtendedDataSquare.from_device(eds_dev, 8)
+        assert lazy.device_data is not None
+        fresh = eds.data.copy()
+        fresh[0, 0] ^= 0xFF
+        lazy.data = fresh
+        assert lazy.device_data is None
+        assert np.array_equal(lazy.data, fresh)
+
     def test_eds_roots_device_of_existing_square(self, oracle):
         _sq, eds, dah = oracle
         rows, cols = extend_tpu.eds_roots_device(eds.data)

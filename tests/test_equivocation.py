@@ -209,3 +209,43 @@ class TestEquivocationFlow:
         validator._record_accept_vote(h, 0, op_c, ph1, v_r0.signature)
         validator._record_accept_vote(h, 1, op_c, ph2, v_r1.signature)
         assert not validator._pending_evidence
+
+
+class TestEvidencePersistence:
+    def test_crash_replay_across_evidence_block(self, tmp_path):
+        """Restarting across an evidence-carrying block must replay the
+        slash (ADVICE r4 high: Block used to drop evidence on
+        serialization, so the recovery replay ran begin_block without it
+        and recomputed a different app hash — permanent 'state
+        corruption' whenever equivocation had fired)."""
+        from celestia_tpu.x.slashing import Equivocation, SlashingKeeper
+
+        app = App(chain_id=CHAIN)
+        app.init_chain({}, genesis_time=0.0)
+        add_consensus_validator(app, VAL_A, 80_000_000)
+        add_consensus_validator(app, VAL_C, 20_000_000)
+        node = Node(app, home=str(tmp_path))
+        node.produce_block(15.0)
+        node.save_snapshot()  # snapshot BEFORE the evidence block
+
+        op_c = VAL_C.bech32_address()
+        proposal = node.app.prepare_proposal([])
+        node.apply_external_block(
+            proposal.txs, proposal.square_size, proposal.hash, 30.0,
+            evidence=[Equivocation(op_c, node.app.height, power=20)],
+        )
+        node.produce_block(45.0)  # one more block past the evidence
+        assert node.app.staking.get_validator(op_c).jailed
+
+        # block store is ahead of the snapshot: load() replays the
+        # evidence block and verifies each commit's app hash
+        recovered = Node.load(str(tmp_path))
+        assert recovered.app.height == node.app.height
+        assert recovered.app.staking.get_validator(op_c).jailed
+        info = SlashingKeeper(
+            recovered.app.store, recovered.app.staking
+        ).signing_info(op_c)
+        assert info.tombstoned
+        b1 = node.produce_block(60.0)
+        b2 = recovered.produce_block(60.0)
+        assert b1.app_hash == b2.app_hash

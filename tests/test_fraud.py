@@ -155,3 +155,16 @@ class TestVerify:
         )
         with pytest.raises(ValueError, match="all 2k shares"):
             verify_befp(short, dah)
+
+
+class TestMalformedDah:
+    def test_short_column_roots_rejected_not_crash(self):
+        """ADVICE r4: a DAH with a truncated column-root list must hit
+        the documented ValueError contract, not IndexError."""
+        eds, dah = _malicious(4, row=1, col=6)
+        proof = generate_befp(eds, AXIS_ROW, 1)
+        import dataclasses as _dc
+
+        short = _dc.replace(dah, column_roots=dah.column_roots[:3])
+        with pytest.raises(ValueError):
+            verify_befp(proof, short)

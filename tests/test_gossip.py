@@ -125,3 +125,17 @@ class TestWantHaveGossip:
         for _ in range(2 * node.mempool.ttl_blocks + 1):
             node.produce_block()
         assert not node.mempool.has_seen(key)  # aged out
+
+    def test_expired_uncommitted_tx_can_regossip(self):
+        """ADVICE r4: a tx that TTL-expires WITHOUT being committed must
+        be forgotten immediately — a legitimate resubmission would
+        otherwise be refused by the want/have handshake on every peer
+        that saw the first attempt, for a further 2x TTL window."""
+        from celestia_tpu.node.node import Mempool
+
+        pool = Mempool(ttl_blocks=3)
+        key = pool.add(b"\x01" * 64, priority=0, height=1)
+        assert pool.has_seen(key)
+        pool.evict_expired(height=4)  # expires uncommitted
+        assert key not in pool.txs
+        assert not pool.has_seen(key)  # peer will answer "want" again
