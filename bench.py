@@ -791,25 +791,33 @@ def _load_cache() -> dict | None:
 
 
 def _save_cache(headline: dict, configs: dict, provenance: dict,
-                prior: dict | None) -> None:
+                prior: dict | None, headline_fresh: bool) -> None:
     """Best-of-session merge: freshly measured configs replace their
-    cached predecessors; configs that failed this run keep the prior
-    session's numbers (with their original timestamps)."""
+    cached predecessors; every other cached config is KEPT — including
+    ones this run never attempted (a `bench.py 256` session must not
+    evict the k=128 numbers the default harness run replays). The
+    cached headline only moves when this run measured it cleanly
+    (headline_fresh) — a parity-failed or substituted headline must
+    never become the replayed metric of record."""
     now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    prior_cfgs = (prior or {}).get("configs", {})
-    prior_when = (prior or {}).get("measured_at_per_config", {})
-    merged, when = {}, {}
+    merged = dict((prior or {}).get("configs", {}))
+    when = dict((prior or {}).get("measured_at_per_config", {}))
     for name, cfg in configs.items():
         if provenance.get(name) == "measured":
             merged[name] = cfg
             when[name] = now
-        elif name in prior_cfgs:
-            merged[name] = prior_cfgs[name]
-            when[name] = prior_when.get(name, "unknown")
+    # headlines keyed by metric name: a k=256 session must not relabel
+    # the k=128 headline the default harness run replays
+    headlines = dict((prior or {}).get("headlines", {}))
+    legacy = (prior or {}).get("headline")
+    if legacy and legacy.get("metric") and legacy["metric"] not in headlines:
+        headlines[legacy["metric"]] = legacy
+    if headline_fresh:
+        headlines[headline["metric"]] = headline
     out = {
         "measured_at": now,
         "measured_at_per_config": when,
-        "headline": headline,
+        "headlines": headlines,
         "configs": merged,
     }
     try:
@@ -855,13 +863,20 @@ def main():
 
     cache = _load_cache()
     head_name = f"3_headline_k{headline_k}"
+    metric_name = f"extend_block_k{headline_k}_tpu_ms_per_square"
     reachable, why = _probe_with_retries()
     if not reachable:
-        if cache and head_name in cache.get("configs", {}):
+        cached_headline = (
+            (cache or {}).get("headlines", {}).get(metric_name)
+            or ((cache or {}).get("headline")
+                if (cache or {}).get("headline", {}).get("metric")
+                == metric_name else None)
+        )
+        if cache and cached_headline and head_name in cache.get("configs", {}):
             # replay the session's measured numbers with provenance
             # flagged — a dead tunnel at harness time is environment,
             # not a missing capability (VERDICT r4 weak #1)
-            out = dict(cache.get("headline", {}))
+            out = dict(cached_headline)
             out["configs"] = cache["configs"]
             out["provenance"] = {
                 "source": "cached-session",
@@ -945,7 +960,8 @@ def main():
         "dah": head.get("dah"),
         "parity": head.get("parity"),
     }
-    _save_cache(headline, configs, prov, cache)
+    _save_cache(headline, configs, prov, cache,
+                headline_fresh=prov.get(head_name) == "measured")
     if parity_failures:
         raise SystemExit(
             f"DAH mismatch between CPU and TPU paths: {parity_failures} "

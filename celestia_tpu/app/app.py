@@ -1021,12 +1021,17 @@ class App:
         (apply_param_changes expects .blob / .blobstream attributes)."""
 
         class _Target:
-            blob = BlobKeeper(store)
-            blobstream = BlobstreamKeeper(
-                store, StakingKeeper(store, BankKeeper(store))
-            )
+            pass
 
-        return _Target()
+        t = _Target()
+        t.blob = BlobKeeper(store)
+        t.blobstream = BlobstreamKeeper(
+            store, StakingKeeper(store, BankKeeper(store))
+        )
+        # gov client recovery reaches the 02-client keeper through the
+        # same deliver branch (paramfilter apply path)
+        t.store = store
+        return t
 
     def commit(self) -> bytes:
         if self._deliver_store is not None:

@@ -174,6 +174,20 @@ class DataAvailabilityHeader:
             self._hash = merkle_root(list(self.row_roots) + list(self.column_roots))
         return self._hash
 
+    def to_json(self) -> dict:
+        """Wire shape shared by the /dah route and fraud-proof wires."""
+        return {
+            "row_roots": [r.hex() for r in self.row_roots],
+            "column_roots": [c.hex() for c in self.column_roots],
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "DataAvailabilityHeader":
+        return cls(
+            [bytes.fromhex(r) for r in d["row_roots"]],
+            [bytes.fromhex(c) for c in d["column_roots"]],
+        )
+
     def validate_basic(self) -> None:
         if len(self.column_roots) != len(self.row_roots):
             raise ValueError(

@@ -177,3 +177,19 @@ def verify_befp(proof: BadEncodingFraudProof, dah) -> bool:
         w, SHARE_SIZE
     )
     return _axis_is_bad(line, k)
+
+
+def find_befp(eds: np.ndarray) -> BadEncodingFraudProof | None:
+    """Scan a reconstructed (2k, 2k, 512) square for a mis-encoded axis
+    and prove the first one found (rows first, then columns) — the full
+    node's detection entry point after it rebuilds a committed square
+    that fails ProcessProposal. Returns None when every axis satisfies
+    the code (the divergence was something other than bad encoding)."""
+    w = eds.shape[0]
+    k = w // 2
+    for axis, get in ((AXIS_ROW, lambda i: eds[i, :]),
+                      (AXIS_COL, lambda i: eds[:, i])):
+        for i in range(w):
+            if _axis_is_bad(get(i), k):
+                return generate_befp(eds, axis, i)
+    return None

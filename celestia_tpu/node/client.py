@@ -98,6 +98,23 @@ class RpcClient:
     def namespace_data(self, height: int, namespace: bytes):
         return self._get(f"/namespace_data/{height}/{namespace.hex()}")
 
+    def header(self, height: int):
+        """Header-only fetch (no txs/shares) — the light-client view."""
+        return self._get(f"/header/{height}")
+
+    def dah(self, height: int):
+        """Full DataAvailabilityHeader: row+column NMT roots, O(w)."""
+        return self._get(f"/dah/{height}")
+
+    def eds(self, height: int):
+        """Full extended square by row — O(w^2); full nodes only."""
+        return self._get(f"/eds/{height}")
+
+    def befp(self, height: int):
+        """Stored Bad Encoding Fraud Proofs at a height:
+        {"height", "proofs": [wire, ...]} or None."""
+        return self._get(f"/fraud/befp/{height}")
+
     def snapshot(self) -> dict:
         return self._get("/snapshot")
 
@@ -142,3 +159,119 @@ class RpcClient:
         if res is None:
             return None
         return Acknowledgement.unmarshal(json.dumps(res["ack"]).encode())
+
+
+def _wire_key(wire) -> str:
+    """32-byte digest of a fraud-proof wire for the screened-memo — the
+    raw JSON dump would keep hundreds of KB alive per screened proof."""
+    import hashlib
+
+    return hashlib.sha256(
+        json.dumps(wire, sort_keys=True).encode()
+    ).hexdigest()
+
+
+class FraudDetected(Exception):
+    """A verified BEFP proves the header's DAH commits a bad encoding."""
+
+
+class FraudAwareLightClient:
+    """Header-tracking light client with fraud-proof protection — the
+    consumer role of specs/fraud_proofs.md (reference: a celestia light
+    node rejects a header when a DASer relays a verified BEFP).
+
+    Downloads are O(w) per header: the header itself and, when a
+    watchtower volunteers a fraud proof, the proof (2w shares + 2w NMT
+    paths). The O(w^2) square is NEVER fetched — the whole point is
+    that a light client can reject a fraudulent block it cannot afford
+    to download. Every volunteered proof is verified INDEPENDENTLY
+    against the header's own data_hash before it is believed, so a
+    malicious watchtower cannot frame an honest chain."""
+
+    def __init__(self, primary: RpcClient, watchtowers: list[RpcClient]):
+        self.primary = primary
+        self.watchtowers = list(watchtowers)
+        self.headers: dict[int, dict] = {}
+        # wires already screened as harmless for a given header
+        # (wrong-DAH / malformed): keyed by (height, header data_hash,
+        # wire identity) so periodic rescreen() re-verifies only NEW
+        # proofs. The data_hash MUST be part of the key — a proof
+        # dismissed as "wrong DAH" under header X may be exactly the
+        # proof that condemns a DIFFERENT header Y the primary serves
+        # at that height after a reorg/equivocation.
+        self._screened: set[tuple[int, str, str]] = set()
+
+    def accept_header(self, height: int) -> dict | None:
+        """Fetch + screen one header. Returns the header dict, None when
+        the primary does not have the height yet, or raises
+        FraudDetected with the verified proof attached.
+
+        Acceptance is PROVISIONAL: a full node needs time to fetch the
+        square and prove a bad encoding, so a proof can surface after
+        the header was already screened clean. Call rescreen()
+        periodically — it re-checks every accepted header and evicts
+        (raising) on late-arriving proofs."""
+        hdr = self.primary.header(height)
+        if hdr is None:
+            return None
+        self._screen(height, hdr)
+        self.headers[height] = hdr
+        return hdr
+
+    def rescreen(self) -> None:
+        """Re-screen every accepted header against the watchtowers; a
+        late-arriving verified proof evicts the header AND everything
+        above it (descendants build on the fraudulent state) before
+        raising FraudDetected."""
+        for height in sorted(self.headers):
+            try:
+                self._screen(height, self.headers[height])
+            except FraudDetected:
+                for h in [h for h in self.headers if h >= height]:
+                    del self.headers[h]
+                raise
+
+    def _screen(self, height: int, hdr: dict) -> None:
+        from celestia_tpu.da import DataAvailabilityHeader
+        from celestia_tpu.da import fraud as fraud_mod
+
+        for tower in self.watchtowers:
+            # EVERYTHING a watchtower sends is untrusted: any shape
+            # error anywhere (non-dict reply, null proof entries, bad
+            # hex) means "this tower has no usable proof", never a
+            # crash — only a VERIFIED proof may affect the client
+            try:
+                res = tower.befp(height)
+                wires = list((res or {}).get("proofs", []))
+            except Exception:  # noqa: BLE001 — a broken watchtower is no proof
+                continue
+            for wire in wires:
+                try:
+                    key = (height, hdr["data_hash"], _wire_key(wire))
+                    if key in self._screened:
+                        continue
+                    dah = DataAvailabilityHeader.from_json(wire["dah"])
+                    if dah.hash().hex() != hdr["data_hash"]:
+                        # proof is for some other block — not THIS
+                        # header's problem (re-checked per data_hash)
+                        self._screened.add(key)
+                        continue
+                    proof = fraud_mod.BadEncodingFraudProof.from_json(
+                        wire["proof"]
+                    )
+                    is_fraud = fraud_mod.verify_befp(proof, dah)
+                except Exception:  # noqa: BLE001 — malformed/forged: rejected
+                    try:
+                        self._screened.add(
+                            (height, hdr["data_hash"], _wire_key(wire))
+                        )
+                    except Exception:  # noqa: BLE001 — unserializable junk
+                        pass
+                    continue
+                if is_fraud:
+                    raise FraudDetected(
+                        f"height {height}: committed DAH fails the erasure "
+                        f"code ({proof.axis} {proof.index}) — proven by "
+                        f"{tower.base_url}"
+                    )
+                self._screened.add(key)

@@ -86,6 +86,9 @@ class PeerClient(RpcClient):
     def consensus_evidence(self, body: dict) -> dict:
         return self._post("/consensus/evidence", body)
 
+    def fraud_befp_submit(self, body: dict) -> dict:
+        return self._post("/fraud/befp", body)
+
     def gossip_have(self, keys: list[bytes]) -> dict:
         return self._post("/gossip/have", {"keys": [k.hex() for k in keys]})
 
@@ -283,6 +286,113 @@ class ValidatorNode:
 
     # ---- peer-facing handlers (RPC threads) ----
 
+    # ---- bad-encoding fraud proofs (specs/fraud_proofs.md) ----
+
+    def _investigate_bad_encoding(self, height: int, body: dict) -> None:
+        """A certificate-valid block failed our ProcessProposal. Fetch
+        the proposer's published square from whichever peer serves it,
+        and if the committed DAH's erasure coding is provably invalid,
+        store + gossip a BEFP. Never raises: investigation is best-
+        effort on top of the refusal that already happened."""
+        import numpy as np
+
+        from celestia_tpu.appconsts import SHARE_SIZE
+        from celestia_tpu.da import DataAvailabilityHeader
+        from celestia_tpu.da import fraud as fraud_mod
+
+        announced = bytes.fromhex(body["data_hash"])
+        if announced.hex() in self.node.fraud_proofs.get(height, {}):
+            return
+        for peer in self.peers:
+            try:
+                d = peer.dah(height)
+                if d is None:
+                    continue
+                dah = DataAvailabilityHeader.from_json(d)
+                if dah.hash() != announced:
+                    continue  # this peer serves a different block
+                e = peer.eds(height)
+                if e is None:
+                    continue
+                w = int(e["width"])
+                eds = np.stack(
+                    [
+                        np.frombuffer(
+                            bytes.fromhex(row), dtype=np.uint8
+                        ).reshape(w, SHARE_SIZE)
+                        for row in e["rows"]
+                    ]
+                )
+                proof = fraud_mod.find_befp(eds)
+                if proof is None:
+                    continue  # divergence was not a bad encoding
+                if not fraud_mod.verify_befp(proof, dah):
+                    continue  # served square is not the committed one
+            except Exception as exc:  # noqa: BLE001 — best-effort per peer
+                log.info("fraud investigation skip", peer=peer.base_url,
+                         error=str(exc))
+                continue
+            wire = {"height": height, "dah": d, "proof": proof.to_json()}
+            # force: `announced` came from a VERIFIED commit certificate
+            # (handle_commit checked it before apply) — this is the
+            # proof of record and must displace any cap-filling decoys
+            if self.node.add_fraud_proof(height, announced, wire,
+                                         force=True):
+                log.error("bad encoding PROVEN", height=height,
+                          axis=proof.axis, index=proof.index)
+                self._gossip_fraud(wire)
+            return
+
+    def handle_fraud(self, body: dict) -> dict:
+        """Accept a gossiped BEFP after INDEPENDENT verification — a
+        forged proof must not let an attacker frame honest blocks —
+        then re-gossip once (the store is the dedup)."""
+        from celestia_tpu.da import DataAvailabilityHeader
+        from celestia_tpu.da import fraud as fraud_mod
+
+        height = int(body["height"])
+        if height > self.node.app.height + 2:
+            # no certificate can exist that far ahead — refusing keeps
+            # an attacker from growing the store with proofs of junk
+            # squares at heights 1..10^9 (each height is individually
+            # capped, so the sum over fake heights was the exposure)
+            raise ValueError(
+                f"fraud proof height {height} is beyond the chain tip"
+            )
+        proof = fraud_mod.BadEncodingFraudProof.from_json(body["proof"])
+        dah = DataAvailabilityHeader.from_json(body["dah"])
+        dah_hash = dah.hash()
+        if dah_hash.hex() in self.node.fraud_proofs.get(height, {}):
+            return {"accepted": True, "duplicate": True}
+        block = self.node.get_block(height)
+        if block is not None and block.data_hash != dah_hash:
+            raise ValueError("fraud proof DAH does not match the committed block")
+        if not fraud_mod.verify_befp(proof, dah):
+            raise ValueError("proof does not demonstrate a bad encoding")
+        wire = {"height": height, "dah": body["dah"],
+                "proof": body["proof"]}
+        # a proof matching OUR committed block is the height's proof of
+        # record — it bypasses the decoy cap
+        force = block is not None and block.data_hash == dah_hash
+        if not self.node.add_fraud_proof(height, dah_hash, wire, force=force):
+            return {"accepted": False, "error": "per-height proof cap"}
+        log.error("bad encoding fraud proof accepted", height=height,
+                  axis=proof.axis, index=proof.index)
+        self._gossip_fraud(wire)
+        return {"accepted": True}
+
+    def _known_fraudulent(self, data_hash: bytes) -> bool:
+        # O(1) on the consensus hot path — maintained by add_fraud_proof
+        return data_hash in self.node.fraudulent_data_hashes
+
+    def _gossip_fraud(self, wire: dict) -> None:
+        for peer in self.peers:
+            try:
+                peer.fraud_befp_submit(wire)
+            except Exception as e:  # noqa: BLE001 — a dead peer is fine
+                log.info("fraud gossip skip", peer=peer.base_url,
+                         error=str(e))
+
     def handle_proposal(self, body: dict) -> dict:
         """ProcessProposal + stake vote (consensus step 2)."""
         if self.halted:
@@ -295,6 +405,10 @@ class ValidatorNode:
         valset = self._valset()
         if body["proposer"] not in {v.operator for v in valset}:
             raise ValueError(f"proposer {body['proposer']} is not bonded")
+        if self._known_fraudulent(bytes.fromhex(body["data_hash"])):
+            # a verified BEFP proves this exact DAH commits a bad
+            # encoding — never endorse it, whatever the round
+            raise ValueError("proposal data hash has a verified fraud proof")
         ph = self._prop_hash(body)
         round_ = int(body.get("round", 0))
 
@@ -384,14 +498,24 @@ class ValidatorNode:
         # commit handlers both passing the height gate above must not
         # stack — the second would apply a block its certificate does
         # not cover
-        block = self.node.apply_external_block(
-            [bytes.fromhex(t) for t in body["txs"]],
-            int(body["square_size"]),
-            bytes.fromhex(body["data_hash"]),
-            float(body["time"]),
-            expected_height=height,
-            evidence=self._body_evidence(body),
-        )
+        try:
+            block = self.node.apply_external_block(
+                [bytes.fromhex(t) for t in body["txs"]],
+                int(body["square_size"]),
+                bytes.fromhex(body["data_hash"]),
+                float(body["time"]),
+                expected_height=height,
+                evidence=self._body_evidence(body),
+            )
+        except ValueError:
+            if self.node.app.height + 1 == height:
+                # a certificate-valid block WE reject: a >2/3-dishonest
+                # committee may have committed a bad erasure coding —
+                # fetch the published square and try to prove it before
+                # refusing, so light clients get a warning they can
+                # verify (specs/fraud_proofs.md's full-node role)
+                self._investigate_bad_encoding(height, body)
+            raise
         self._last_commit = time.monotonic()
         with self._vote_lock:
             # committed heights can never be voted again — drop their

@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import hashlib
 import json
@@ -119,6 +120,11 @@ class Block:
     # carry it or crash-recovery replay recomputes a different app hash
     # (the reference's blocks persist ByzantineValidators the same way).
     evidence: list = dataclasses.field(default_factory=list)
+    # app version the square was BUILT at (the reference's header
+    # carries Version.App): reconstructing a historical square after an
+    # upgrade must use the block's own rules. None = stored before this
+    # field existed — reconstruct at current rules.
+    version: int | None = None
 
     def to_json(self) -> dict:
         return {
@@ -128,6 +134,7 @@ class Block:
             "square_size": self.square_size,
             "data_hash": self.data_hash.hex(),
             "app_hash": self.app_hash.hex(),
+            "version": self.version,
             "tx_results": [
                 {"code": r.code, "log": r.log, "gas_used": r.gas_used}
                 for r in self.tx_results
@@ -154,6 +161,7 @@ class Block:
                 TxResult(code=r["code"], log=r["log"], gas_used=r["gas_used"])
                 for r in d.get("tx_results", [])
             ],
+            version=d.get("version"),
             evidence=[
                 Equivocation(validator=e["validator"], height=e["height"],
                              power=e.get("power", 0))
@@ -170,6 +178,25 @@ class Node:
         self.mempool = Mempool()
         self.blocks: dict[int, Block] = {}
         self.tx_index: dict[bytes, tuple[int, int]] = {}  # hash -> (height, idx)
+        # verified Bad Encoding Fraud Proofs: height -> dah_hash_hex ->
+        # wire JSON ({"height", "dah": {row_roots, column_roots},
+        # "proof"}), served on /fraud/befp/<height> so light clients can
+        # reject the header without downloading the square
+        # (specs/fraud_proofs.md role). Keyed by the DAH hash — dedup by
+        # height alone would let an attacker SQUAT a height with a
+        # self-made proof of some unrelated bad square and suppress the
+        # real one. Capped per height against spam.
+        self.fraud_proofs: dict[int, dict[str, dict]] = {}
+        # O(1) "is this data hash proven fraudulent" for the consensus
+        # hot path (validators refuse to endorse these)
+        self.fraudulent_data_hashes: set[bytes] = set()
+        # reconstruction memo for the share-serving routes: committed
+        # blocks are immutable, so /dah answers come from a tiny
+        # per-height cache and /eds from a 2-deep LRU (a full EDS is
+        # ~32 MB at k=128 — memoizing every height would eat the heap)
+        self._dah_cache: dict[int, object] = {}
+        self._eds_cache: "collections.OrderedDict[int, object]" = \
+            collections.OrderedDict()
         self.home = pathlib.Path(home) if home else None
         if self.home:
             (self.home / "blocks").mkdir(parents=True, exist_ok=True)
@@ -181,6 +208,53 @@ class Node:
         # lock at Commit, and state proofs pair root+proof under the
         # store's own SMT lock).
         self._lock = threading.RLock()
+
+    MAX_FRAUD_PROOFS_PER_HEIGHT = 4
+
+    def add_fraud_proof(self, height: int, dah_hash: bytes, wire: dict,
+                        force: bool = False) -> bool:
+        """Store a VERIFIED fraud proof. Returns False when already
+        known or the per-height cap is hit (spam bound).
+
+        force: the caller has bound dah_hash to a commit certificate or
+        a committed block — the proof of record for the height. It
+        bypasses (and if needed evicts a decoy from) the cap: an
+        attacker pre-filling the height with valid proofs of unrelated
+        junk squares must not be able to suppress it. Forced entries
+        are bounded by the number of certified hashes per height, not
+        attacker effort."""
+        # RPC handler threads gossip concurrently while readers list
+        # the height's proofs — same locking contract as every other
+        # cross-thread Node mutation
+        with self._lock:
+            at_height = self.fraud_proofs.setdefault(height, {})
+            key = dah_hash.hex()
+            if key in at_height:
+                return False
+            if len(at_height) >= self.MAX_FRAUD_PROOFS_PER_HEIGHT:
+                if not force:
+                    return False
+                # evict an unforced decoy to make room
+                for k in list(at_height):
+                    if not at_height[k].get("_certified"):
+                        del at_height[k]
+                        break
+            # _certified is LOCAL provenance: never trust it from a
+            # gossiped wire (an attacker would mark decoys eviction-
+            # proof), always restamp from the caller's own verification
+            wire = {k: v for k, v in wire.items() if k != "_certified"}
+            if force:
+                wire["_certified"] = True
+            at_height[key] = wire
+            self.fraudulent_data_hashes.add(dah_hash)
+            return True
+
+    def fraud_proofs_at(self, height: int) -> list[dict]:
+        """Snapshot of the height's stored proofs (the /fraud/befp
+        serving read) — copied under the lock so a concurrent gossip
+        insert/eviction can never break the iteration."""
+        with self._lock:
+            return list(self.fraud_proofs.get(height, {}).values())
 
     # --- mempool admission ---
 
@@ -258,6 +332,9 @@ class Node:
                 "ProcessProposal"
             )
 
+        # the square was built/validated under the PRE-commit version
+        # (commit may adopt a pending upgrade) — record that one
+        build_version = self.app.app_version
         self.app.begin_block(block_time, evidence=evidence)
         results = [self.app.deliver_tx(t) for t in proposal.txs]
         self.app.end_block()
@@ -282,6 +359,7 @@ class Node:
             app_hash=app_hash,
             tx_results=results,
             evidence=list(evidence or []),
+            version=build_version,
         )
         self._store_block(block)
 
@@ -334,6 +412,64 @@ class Node:
 
     def latest_height(self) -> int:
         return self.app.height
+
+    def block_eds(self, height: int):
+        """The (2w, 2w, 512) extended square of a committed block — the
+        share-serving source for peers and fraud investigation. A
+        MaliciousApp that committed a corrupted extension serves THAT
+        square (its `published_eds`): under the DA assumption the data
+        is available, the encoding is what's fraudulent."""
+        published = getattr(self.app, "published_eds", None)
+        if published and height in published:
+            return published[height]
+        with self._lock:  # LRU mutation races concurrent RPC threads
+            cached = self._eds_cache.get(height)
+            if cached is not None:
+                self._eds_cache.move_to_end(height)
+                return cached
+        block = self.blocks.get(height)
+        if block is None:
+            return None
+        # pure host reconstruction (NOT app.extend_block): this runs on
+        # RPC handler threads, so it must not touch the app's device/
+        # native backend state. The block's own build version governs
+        # the layout rules — a post-upgrade node must still reproduce
+        # pre-upgrade squares byte-exactly.
+        from celestia_tpu import appconsts, da, square as square_pkg
+        from celestia_tpu.shares import to_bytes
+
+        v = block.version if block.version is not None else self.app.app_version
+        sq = square_pkg.construct(
+            block.txs, v, appconsts.square_size_upper_bound(v)
+        )
+        eds = da.extend_shares(to_bytes(sq)).data
+        with self._lock:
+            self._eds_cache[height] = eds
+            while len(self._eds_cache) > 2:
+                self._eds_cache.popitem(last=False)
+        return eds
+
+    def block_dah(self, height: int):
+        """The DataAvailabilityHeader a block's data_hash commits to —
+        the O(w)-sized artifact light clients fetch instead of the
+        square (row+column NMT roots; hash() == block.data_hash).
+        Memoized per height: blocks are immutable and the roots are
+        tiny, while recomputing them costs a full O(w^2) extension."""
+        # single atomic dict get/set (no iteration/eviction): safe
+        # lock-free under the Node's read contract; worst case two
+        # threads compute the same immutable DAH once
+        dah = self._dah_cache.get(height)
+        if dah is not None:
+            return dah
+        eds = self.block_eds(height)
+        if eds is None:
+            return None
+        from celestia_tpu import da
+
+        k = eds.shape[0] // 2
+        dah = da.new_data_availability_header(da.ExtendedDataSquare(eds, k))
+        self._dah_cache[height] = dah
+        return dah
 
     def ibc_light_client_header(self):
         """Unsigned light-client header material for this chain's latest

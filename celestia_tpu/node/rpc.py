@@ -101,6 +101,57 @@ def _handler_for(node: Node):
                         self._reply({"error": "block not found"}, 404)
                     else:
                         self._reply(block.to_json())
+                elif len(parts) == 2 and parts[0] == "header":
+                    # header-only view: what a LIGHT client downloads —
+                    # no txs, no shares (O(1) vs the O(w^2) block body)
+                    block = node.get_block(int(parts[1]))
+                    if block is None:
+                        self._reply({"error": "block not found"}, 404)
+                    else:
+                        self._reply(
+                            {
+                                "height": block.height,
+                                "time": block.time,
+                                "square_size": block.square_size,
+                                "data_hash": block.data_hash.hex(),
+                                "app_hash": block.app_hash.hex(),
+                            }
+                        )
+                elif len(parts) == 2 and parts[0] == "dah":
+                    # the full DataAvailabilityHeader (row+column NMT
+                    # roots, O(w)): hash() reproduces the header's
+                    # data_hash — the artifact BEFPs verify against
+                    dah = node.block_dah(int(parts[1]))
+                    if dah is None:
+                        self._reply({"error": "block not found"}, 404)
+                    else:
+                        self._reply(dah.to_json())
+                elif len(parts) == 2 and parts[0] == "eds":
+                    # full extended square by row (share-serving for
+                    # peers / fraud investigation; light clients never
+                    # touch this route)
+                    eds = node.block_eds(int(parts[1]))
+                    if eds is None:
+                        self._reply({"error": "block not found"}, 404)
+                    else:
+                        self._reply(
+                            {
+                                "width": int(eds.shape[0]),
+                                "rows": [
+                                    bytes(eds[i].reshape(-1)).hex()
+                                    for i in range(eds.shape[0])
+                                ],
+                            }
+                        )
+                elif len(parts) == 3 and parts[0] == "fraud" and parts[1] == "befp":
+                    h = int(parts[2])
+                    proofs = node.fraud_proofs_at(h)
+                    if not proofs:
+                        self._reply({"error": "no fraud proof at height"}, 404)
+                    else:
+                        # every stored proof for the height — the client
+                        # picks the one matching ITS header's data hash
+                        self._reply({"height": h, "proofs": proofs})
                 elif len(parts) == 2 and parts[0] == "tx":
                     found = node.get_tx(bytes.fromhex(parts[1]))
                     if found is None:
@@ -625,6 +676,14 @@ def _handler_for(node: Node):
                         self._reply({"error": "not a devnet validator"}, 404)
                     else:
                         self._reply(validator.handle_evidence(body))
+                elif parts == ["fraud", "befp"]:
+                    # gossiped Bad Encoding Fraud Proof: verify
+                    # independently, store, re-gossip once
+                    validator = getattr(node, "validator", None)
+                    if validator is None:
+                        self._reply({"error": "not a devnet validator"}, 404)
+                    else:
+                        self._reply(validator.handle_fraud(body))
                 else:
                     self._reply({"error": "unknown route"}, 404)
             except Exception as e:  # noqa: BLE001

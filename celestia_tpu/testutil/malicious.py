@@ -25,6 +25,11 @@ class BehaviorConfig:
 
     out_of_order_blobs: bool = False  # don't sort blobs by namespace
     ignore_padding: bool = False  # drop the commitment-rule padding
+    # commit a DAH over an EDS whose parity does NOT satisfy the
+    # Reed-Solomon code — the attack Bad Encoding Fraud Proofs exist
+    # for (reference specs/src/specs/fraud_proofs.md). The square
+    # layout itself is honest; only the extension is corrupted.
+    corrupt_extension: bool = False
 
 
 class MaliciousApp(App):
@@ -33,8 +38,22 @@ class MaliciousApp(App):
     def __init__(self, *args, behavior: BehaviorConfig | None = None, **kwargs):
         super().__init__(*args, **kwargs)
         self.behavior = behavior or BehaviorConfig()
+        # height -> the corrupted EDS this app committed there; served to
+        # peers on request — the DA assumption is that the data IS
+        # available, it is the ENCODING that is fraudulent
+        self.published_eds: dict[int, object] = {}
+        self._published_hashes: set[bytes] = set()
+
+    def process_proposal(self, block_data) -> bool:
+        if block_data.hash in self._published_hashes:
+            # blind self-acceptance: the attacker must vote its own
+            # fraudulent block through (it controls >2/3 in the scenario)
+            return True
+        return super().process_proposal(block_data)
 
     def prepare_proposal(self, mempool_txs, block_data_size=None):
+        if self.height >= 1 and self.behavior.corrupt_extension:
+            return self._prepare_corrupt_extension(mempool_txs)
         if self.height == 0 or not (
             self.behavior.out_of_order_blobs or self.behavior.ignore_padding
         ):
@@ -53,6 +72,29 @@ class MaliciousApp(App):
             square_size=square_pkg.square_size(len(square)),
             hash=dah.hash(),
         )
+
+    def _prepare_corrupt_extension(self, mempool_txs):
+        """An honestly laid-out square whose COMMITTED extension breaks
+        the RS code: extend correctly, flip bits in one parity cell, and
+        commit the DAH of the corrupted EDS. Honest validators reject it
+        in ProcessProposal; with >2/3 attacker power it commits anyway,
+        and only a Bad Encoding Fraud Proof can warn light clients."""
+        from celestia_tpu.app.context import ExecMode
+
+        store = self.store.branch()
+        ctx = self._new_ctx(store, ExecMode.PREPARE)
+        txs = self.filter_txs(ctx, mempool_txs)
+        square, txs = square_pkg.build(
+            txs, self.app_version, self.gov_square_size_upper_bound()
+        )
+        k = square_pkg.square_size(len(square))
+        eds = da.extend_shares(to_bytes(square)).data.copy()
+        eds[0, k] ^= 0x5A  # corrupt one Q2 parity cell: row 0 breaks
+        bad = da.ExtendedDataSquare(eds, k)
+        dah = da.new_data_availability_header(bad)
+        self.published_eds[self.height + 1] = eds
+        self._published_hashes.add(dah.hash())
+        return ProposalBlockData(txs=txs, square_size=k, hash=dah.hash())
 
     def _build_malicious_square(self, txs):
         """Lay blobs in arrival order and/or without alignment padding

@@ -638,6 +638,67 @@ class ClientKeeper:
         self._set_client(cs)
         return cs
 
+    def _is_expired(self, cs: ClientState, now: float | None) -> bool:
+        t = self._block_now(now)
+        latest = self.get_consensus_state(cs.client_id, cs.latest_height)
+        return (
+            t is not None
+            and latest is not None
+            and t - latest.timestamp > cs.trusting_period
+        )
+
+    def recover_client(
+        self, subject_id: str, substitute_id: str, now: float | None = None
+    ) -> ClientState:
+        """Governance client recovery (the reference routes ibc-go's
+        ClientUpdateProposal through a dedicated gov handler,
+        app/ibc_proposal_handler.go:17-28): a frozen or expired SUBJECT
+        client adopts the latest verified state of an ACTIVE SUBSTITUTE
+        client tracking the same chain, and is unfrozen.
+
+        Safety rests on the substitute having verified its own headers
+        the normal way AND on the gov quorum: an attacker cannot use
+        recovery to skip verification — the substitute's state was
+        signature-verified, and the social layer approved the
+        substitution (ibc-go 02-client CheckSubstituteAndUpdateState)."""
+        subject = self.get_client(subject_id)
+        if subject is None:
+            raise ValueError(f"unknown subject client {subject_id}")
+        if not subject.frozen and not self._is_expired(subject, now):
+            raise ValueError(
+                f"subject client {subject_id} is active — nothing to recover"
+            )
+        substitute = self._require_active(substitute_id)
+        if self._is_expired(substitute, now):
+            raise ValueError(f"substitute client {substitute_id} is expired")
+        if substitute.chain_id != subject.chain_id:
+            raise ValueError(
+                "substitute tracks a different chain "
+                f"({substitute.chain_id!r} != {subject.chain_id!r})"
+            )
+        if substitute.latest_height <= subject.latest_height:
+            raise ValueError(
+                "substitute client is not ahead of the subject "
+                f"({substitute.latest_height} <= {subject.latest_height})"
+            )
+        cons = self.get_consensus_state(
+            substitute_id, substitute.latest_height
+        )
+        if cons is None:
+            raise ValueError("substitute has no latest consensus state")
+        subject.latest_height = substitute.latest_height
+        subject.validators = list(substitute.validators)
+        subject.trusting_period = substitute.trusting_period
+        subject.frozen = False
+        self._set_client(subject)
+        self.store.set(
+            _consensus_key(subject_id, subject.latest_height), cons.marshal()
+        )
+        self._store_valset(
+            subject_id, subject.latest_height, subject.validators
+        )
+        return subject
+
     # --- proof verification (23-commitment role) ---
 
     def verify_membership(

@@ -65,5 +65,24 @@ def apply_param_changes(app, changes: list[ParamChange]) -> None:
                 app.blobstream.data_commitment_window = int(change.value)
             else:
                 raise ValueError(f"unknown blobstream param {change.key}")
+        elif change.subspace == "ibc":
+            # gov-driven frozen-client recovery (the reference routes
+            # ibc-go's ClientUpdateProposal through a dedicated gov
+            # handler, app/ibc_proposal_handler.go:17-28). Same guard
+            # surface as every other gov change: the filter above ran,
+            # and the recovery itself enforces the 02-client
+            # substitution rules (frozen/expired subject, active
+            # substitute, same chain, height advance).
+            if change.key == "RecoverClient":
+                import json as _json
+
+                from celestia_tpu.x.lightclient import ClientKeeper
+
+                v = _json.loads(change.value)
+                ClientKeeper(app.store).recover_client(
+                    v["subject_client_id"], v["substitute_client_id"]
+                )
+            else:
+                raise ValueError(f"unknown ibc param {change.key}")
         else:
             raise ValueError(f"unknown subspace {change.subspace}")

@@ -363,6 +363,103 @@ class TestClientKeeper:
                 "07-tendermint-0", 1, b"k", b"v", Proof(b"\x00" * 32, [])
             )
 
+
+    def _frozen_with_substitute(self):
+        """Subject 07-tendermint-0 frozen by misbehaviour; substitute
+        07-tendermint-1 active and verified ahead of it."""
+        store, keeper, valset = self._keeper_with_client()
+        ha = _mk_header(height=7, validators=valset, app_hash=b"\x01" * 32)
+        hb = _mk_header(height=7, validators=valset, app_hash=b"\x02" * 32)
+        keeper.submit_misbehaviour(
+            "07-tendermint-0",
+            self._signed(ha, [VAL_B1, VAL_B2, VAL_B3]),
+            self._signed(hb, [VAL_B1, VAL_B2, VAL_B3]),
+        )
+        sub = keeper.create_client(
+            _mk_header(height=1, validators=valset, time=10.0)
+        )
+        assert sub.client_id == "07-tendermint-1"
+        h9 = _mk_header(height=9, validators=valset, app_hash=b"\x0c" * 32)
+        keeper.update_client(sub.client_id, self._signed(
+            h9, [VAL_B1, VAL_B2, VAL_B3]
+        ))
+        return store, keeper, valset
+
+    def test_recover_client_unfreezes_from_substitute(self):
+        """Gov client recovery (reference app/ibc_proposal_handler.go:
+        17-28): a frozen subject adopts the substitute's verified state
+        and serves updates/proofs again."""
+        _s, keeper, valset = self._frozen_with_substitute()
+        cs = keeper.recover_client("07-tendermint-0", "07-tendermint-1")
+        assert not cs.frozen
+        assert cs.latest_height == 9
+        cons = keeper.get_consensus_state("07-tendermint-0", 9)
+        assert cons is not None and cons.app_hash == b"\x0c" * 32
+        # the recovered client verifies new headers again
+        h10 = _mk_header(height=10, validators=valset)
+        keeper.update_client(
+            "07-tendermint-0", self._signed(h10, [VAL_B1, VAL_B2, VAL_B3])
+        )
+        assert keeper.get_client("07-tendermint-0").latest_height == 10
+
+    def test_recover_rejects_active_subject(self):
+        _s, keeper, valset = self._keeper_with_client()
+        keeper.create_client(_mk_header(height=1, validators=valset, time=10.0))
+        with pytest.raises(ValueError, match="active"):
+            keeper.recover_client("07-tendermint-0", "07-tendermint-1")
+
+    def test_recover_rejects_lagging_or_foreign_substitute(self):
+        _s, keeper, valset = self._frozen_with_substitute()
+        # substitute behind the subject
+        lag = keeper.create_client(
+            _mk_header(height=1, validators=valset, time=10.0)
+        )
+        with pytest.raises(ValueError, match="not ahead"):
+            keeper.recover_client("07-tendermint-0", lag.client_id)
+        # substitute tracking a different chain
+        other = keeper.create_client(_mk_header(
+            height=50, chain_id="chain-y", validators=valset, time=10.0
+        ))
+        with pytest.raises(ValueError, match="different chain"):
+            keeper.recover_client("07-tendermint-0", other.client_id)
+
+    def test_recover_rejects_frozen_substitute(self):
+        _s, keeper, valset = self._frozen_with_substitute()
+        ha = _mk_header(height=12, validators=valset, app_hash=b"\x01" * 32)
+        hb = _mk_header(height=12, validators=valset, app_hash=b"\x02" * 32)
+        keeper.submit_misbehaviour(
+            "07-tendermint-1",
+            self._signed(ha, [VAL_B1, VAL_B2, VAL_B3]),
+            self._signed(hb, [VAL_B1, VAL_B2, VAL_B3]),
+        )
+        with pytest.raises(ValueError, match="frozen"):
+            keeper.recover_client("07-tendermint-0", "07-tendermint-1")
+
+    def test_recover_expired_subject(self):
+        """Expiry (not just freezing) is recoverable — ibc-go's expired-
+        client substitution."""
+        _s, keeper, valset = self._keeper_with_client()
+        sub = keeper.create_client(
+            _mk_header(height=1, validators=valset, time=10.0)
+        )
+        # the substitute keeps itself fresh with periodic updates...
+        late = _mk_header(height=9, validators=valset,
+                          time=10.0 + 13 * 24 * 3600)
+        keeper.update_client(sub.client_id, self._signed(
+            late, [VAL_B1, VAL_B2, VAL_B3]
+        ), now=10.0 + 13 * 24 * 3600)
+        # ...while the subject's last state ages past the 14d window
+        now = 10.0 + 15 * 24 * 3600
+        with pytest.raises(ValueError, match="expired"):
+            keeper.update_client("07-tendermint-0", self._signed(
+                _mk_header(height=9, validators=valset, time=now),
+                [VAL_B1, VAL_B2, VAL_B3],
+            ), now=now)
+        cs = keeper.recover_client(
+            "07-tendermint-0", sub.client_id, now=now
+        )
+        assert cs.latest_height == 9
+
     def test_misbehaviour_requires_valid_commits(self):
         _s, keeper, valset = self._keeper_with_client()
         ha = _mk_header(height=7, validators=valset, app_hash=b"\x01" * 32)
