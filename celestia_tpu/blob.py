@@ -10,6 +10,7 @@ dependency-light; the messages involved use only bytes / uint32 fields.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 from celestia_tpu import appconsts
 from celestia_tpu import namespace as ns_pkg
@@ -265,13 +266,73 @@ def marshal_blob_tx(tx: bytes, blobs: list[Blob]) -> bytes:
 
 
 def unmarshal_blob_tx(raw: bytes) -> tuple[BlobTx | None, bool]:
-    """Returns (blob_tx, is_blob_tx). ref: pkg/blob/blob.go:58"""
+    """Returns (blob_tx, is_blob_tx). ref: pkg/blob/blob.go:58
+
+    Parse results are memoized (bytes-keyed LRU): the node parses the
+    same tx at CheckTx, PrepareProposal, ProcessProposal, and DeliverTx
+    — the reference's mempool keeps parsed txs around the same way.
+    The returned BlobTx/Blob objects are SHARED between callers and
+    must be treated as immutable (all fields are bytes/int values;
+    nothing in-tree mutates them)."""
     # Sound fast-reject: the type_id field value "BLOB" must appear
     # literally in the wire bytes, so its absence proves not-a-BlobTx
     # without a varint-by-varint parse (the common case for ordinary sdk
-    # txs flowing through the builder/mempool).
+    # txs flowing through the builder/mempool). Rejects skip the cache:
+    # the scan is cheaper than LRU bookkeeping for plain sdk txs.
     if b"BLOB" not in raw:
         return None, False
+    cached = _PARSE_CACHE.get(raw)
+    if cached is not None:
+        return cached
+    out = _unmarshal_blob_tx_uncached(raw)
+    _PARSE_CACHE.put(raw, out, len(raw))
+    return out
+
+
+class _ByteBudgetLRU:
+    """FIFO cache bounded by BYTES, not entries: each cached parse pins
+    ~3x the raw tx size (raw key + parsed blob bytes + the sparse-share
+    memo the splitter attaches), so an entry-count bound alone would let
+    large blob txs grow the cache to gigabytes. FIFO (not true LRU)
+    keeps reads lock-free; the workload is a few blocks' worth of hot
+    txs, where the distinction is immaterial."""
+
+    def __init__(self, budget_bytes: int, overhead_factor: int = 3):
+        import collections
+        import threading
+
+        self._data: collections.OrderedDict = collections.OrderedDict()
+        self._cost: dict = {}
+        self.budget = budget_bytes
+        self.factor = overhead_factor
+        self.used = 0
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        # lock-free read: dict.get is GIL-atomic, and eviction is FIFO
+        # (no move_to_end) precisely so hits never mutate shared state —
+        # the parse cache sits on the per-tx hot path
+        return self._data.get(key)
+
+    def put(self, key, val, raw_len: int) -> None:
+        cost = raw_len * self.factor
+        if cost > self.budget:
+            return  # a single giant tx must not own the whole cache
+        with self._lock:
+            if key in self._data:
+                return
+            self._data[key] = val
+            self._cost[key] = cost
+            self.used += cost
+            while self.used > self.budget and self._data:
+                k, _ = self._data.popitem(last=False)
+                self.used -= self._cost.pop(k)
+
+
+_PARSE_CACHE = _ByteBudgetLRU(budget_bytes=192 * 1024 * 1024)
+
+
+def _unmarshal_blob_tx_uncached(raw: bytes) -> tuple[BlobTx | None, bool]:
     try:
         tx = b""
         blobs: list[Blob] = []
@@ -305,20 +366,54 @@ class IndexWrapper:
 def marshal_index_wrapper_size(tx: bytes, share_indexes: list[int]) -> int:
     """len(marshal_index_wrapper(tx, share_indexes)) without building the
     bytes — the builder's capacity accounting calls this per blob tx."""
+    return marshal_index_wrapper_size_from_len(len(tx), tuple(share_indexes))
+
+
+@functools.lru_cache(maxsize=8192)
+def marshal_index_wrapper_size_from_len(
+    tx_len: int, share_indexes: tuple[int, ...]
+) -> int:
+    """Size from lengths alone (pure, cached): the builder accounts with
+    WORST-CASE indexes, so (tx_len, n_blobs, version) repeats heavily."""
     packed_len = sum(uvarint_len(i) for i in share_indexes)
-    size = 1 + uvarint_len(len(tx)) + len(tx) if tx else 0
+    size = 1 + uvarint_len(tx_len) + tx_len if tx_len else 0
     if packed_len:
         size += 1 + uvarint_len(packed_len) + packed_len
     return size + 1 + 1 + 4  # field 3: tag, len, "INDX"
 
 
+_IW_TAIL = _field_bytes(3, PROTO_INDEX_WRAPPER_TYPE_ID.encode())
+
+# byte-budgeted like the parse cache: inner tx bytes are UNTRUSTED
+# (ProcessProposal reconstructs peer squares), so an entry-count bound
+# would let an adversarial proposer pin gigabytes of multi-MB inner txs
+_IW_FIELD_CACHE = _ByteBudgetLRU(budget_bytes=32 * 1024 * 1024,
+                                 overhead_factor=2)
+
+
+def _iw_tx_field(tx: bytes) -> bytes:
+    # field 1 depends only on the inner tx — constant across the
+    # per-build re-marshals with fresh share indexes
+    cached = _IW_FIELD_CACHE.get(tx)
+    if cached is not None:
+        return cached
+    out = _field_bytes(1, tx)
+    _IW_FIELD_CACHE.put(tx, out, len(tx))
+    return out
+
+
 def marshal_index_wrapper(tx: bytes, share_indexes: list[int]) -> bytes:
     packed = b"".join(uvarint(i) for i in share_indexes)
-    return (
-        _field_bytes(1, tx)
-        + _field_bytes(2, packed)
-        + _field_bytes(3, PROTO_INDEX_WRAPPER_TYPE_ID.encode())
-    )
+    return _iw_tx_field(tx) + _field_bytes(2, packed) + _IW_TAIL
+
+
+def marshal_index_wrapper_with_head(
+    tx_field: bytes, share_indexes: list[int]
+) -> bytes:
+    """marshal_index_wrapper with field 1 pre-encoded (the builder's
+    export marshals every PFB per block; the tx field never changes)."""
+    packed = b"".join(uvarint(i) for i in share_indexes)
+    return tx_field + _field_bytes(2, packed) + _IW_TAIL
 
 
 def unmarshal_index_wrapper(raw: bytes) -> tuple[IndexWrapper | None, bool]:

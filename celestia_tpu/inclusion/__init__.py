@@ -10,6 +10,7 @@ verified against the DAH.
 
 from __future__ import annotations
 
+import functools
 import math
 
 from celestia_tpu import appconsts
@@ -25,12 +26,10 @@ def blob_min_square_size(share_count: int) -> int:
     return round_up_power_of_two(math.isqrt(max(share_count - 1, 0)) + 1 if share_count > 0 else 1)
 
 
-import functools
-
-
 @functools.lru_cache(maxsize=4096)
 def sub_tree_width(share_count: int, subtree_root_threshold: int) -> int:
-    """Max leaves per commitment subtree. ref: blob_share_commitment_rules.go:84"""
+    """Max leaves per commitment subtree. ref: blob_share_commitment_rules.go:84
+    Pure in both arguments; cached — the builder calls it per blob."""
     s = share_count // subtree_root_threshold
     if share_count % subtree_root_threshold != 0:
         s += 1

@@ -8,6 +8,7 @@ an 18-zero-byte ID prefix, leaving 10 user bytes.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 NAMESPACE_VERSION_SIZE = 1
 NAMESPACE_ID_SIZE = 28
@@ -78,9 +79,17 @@ class Namespace:
 
 
 def new_namespace(version: int, id: bytes) -> Namespace:
+    # Namespace is frozen, so one cached instance serves every
+    # occurrence — construction+validation sits on the block-building
+    # hot path (once per blob share write)
+    return _new_namespace_cached(version, bytes(id))
+
+
+@functools.lru_cache(maxsize=8192)
+def _new_namespace_cached(version: int, id: bytes) -> Namespace:
     _validate_version_supported(version)
     _validate_id(version, id)
-    return Namespace(version, bytes(id))
+    return Namespace(version, id)
 
 
 def new_v0(sub_id: bytes) -> Namespace:

@@ -218,18 +218,34 @@ class FraudAwareLightClient:
         self.headers[height] = hdr
         return hdr
 
-    def rescreen(self) -> None:
-        """Re-screen every accepted header against the watchtowers; a
-        late-arriving verified proof evicts the header AND everything
+    # bound on the screened-harmless memo: a malicious watchtower
+    # serving fresh malformed wires every round must not grow client
+    # memory with its effort. Exceeding the cap clears the memo — the
+    # worst case is re-verification work, never a wrong verdict.
+    MAX_SCREENED_MEMO = 8192
+
+    def rescreen(self, window: int = 64) -> None:
+        """Re-screen recently accepted headers against the watchtowers;
+        a late-arriving verified proof evicts the header AND everything
         above it (descendants build on the fraudulent state) before
-        raising FraudDetected."""
-        for height in sorted(self.headers):
+        raising FraudDetected.
+
+        window: how many of the HIGHEST accepted headers to re-check
+        (fraud proofs target recent blocks — full nodes refuse to store
+        proofs far beyond their tip, so unbounded re-screening of deep
+        history costs O(chain length) HTTP traffic for nothing)."""
+        for height in sorted(self.headers)[-window:]:
             try:
                 self._screen(height, self.headers[height])
             except FraudDetected:
                 for h in [h for h in self.headers if h >= height]:
                     del self.headers[h]
                 raise
+
+    def _memo(self, key) -> None:
+        if len(self._screened) >= self.MAX_SCREENED_MEMO:
+            self._screened.clear()
+        self._screened.add(key)
 
     def _screen(self, height: int, hdr: dict) -> None:
         from celestia_tpu.da import DataAvailabilityHeader
@@ -254,7 +270,7 @@ class FraudAwareLightClient:
                     if dah.hash().hex() != hdr["data_hash"]:
                         # proof is for some other block — not THIS
                         # header's problem (re-checked per data_hash)
-                        self._screened.add(key)
+                        self._memo(key)
                         continue
                     proof = fraud_mod.BadEncodingFraudProof.from_json(
                         wire["proof"]
@@ -262,9 +278,7 @@ class FraudAwareLightClient:
                     is_fraud = fraud_mod.verify_befp(proof, dah)
                 except Exception:  # noqa: BLE001 — malformed/forged: rejected
                     try:
-                        self._screened.add(
-                            (height, hdr["data_hash"], _wire_key(wire))
-                        )
+                        self._memo((height, hdr["data_hash"], _wire_key(wire)))
                     except Exception:  # noqa: BLE001 — unserializable junk
                         pass
                     continue
@@ -274,4 +288,4 @@ class FraudAwareLightClient:
                         f"code ({proof.axis} {proof.index}) — proven by "
                         f"{tower.base_url}"
                     )
-                self._screened.add(key)
+                self._memo(key)

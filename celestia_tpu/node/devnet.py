@@ -351,7 +351,7 @@ class ValidatorNode:
         from celestia_tpu.da import fraud as fraud_mod
 
         height = int(body["height"])
-        if height > self.node.app.height + 2:
+        if height < 1 or height > self.node.app.height + 2:
             # no certificate can exist that far ahead — refusing keeps
             # an attacker from growing the store with proofs of junk
             # squares at heights 1..10^9 (each height is individually

@@ -252,9 +252,14 @@ class Node:
     def fraud_proofs_at(self, height: int) -> list[dict]:
         """Snapshot of the height's stored proofs (the /fraud/befp
         serving read) — copied under the lock so a concurrent gossip
-        insert/eviction can never break the iteration."""
+        insert/eviction can never break the iteration. The local
+        `_certified` provenance marker never goes on the wire (two
+        towers serving the same proof must serve identical bytes)."""
         with self._lock:
-            return list(self.fraud_proofs.get(height, {}).values())
+            return [
+                {k: v for k, v in wire.items() if k != "_certified"}
+                for wire in self.fraud_proofs.get(height, {}).values()
+            ]
 
     # --- mempool admission ---
 

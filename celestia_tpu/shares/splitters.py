@@ -10,6 +10,7 @@ with revert), share_splitting.go (SplitTxs / SplitBlobs).
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 from celestia_tpu import appconsts
 from celestia_tpu import blob as blob_pkg
@@ -119,13 +120,17 @@ class CompactShareSplitter:
 
         # reserved-byte pointers: in-share offset of the first unit that
         # STARTS in each share (0 when none does)
-        lens = np.array([len(d) for d in delimited], np.int64)
+        lens = np.fromiter(
+            (len(d) for d in delimited), np.int64, count=len(delimited)
+        )
         starts = np.concatenate([[0], np.cumsum(lens)[:-1]])
         share_of = np.where(starts < first, 0, 1 + (starts - first) // cont)
         in_share = np.where(starts < first, 38 + starts, 34 + (starts - first) % cont)
         ptr = np.zeros(n, np.int64)
-        with_units, first_idx = np.unique(share_of, return_index=True)
-        ptr[with_units] = in_share[first_idx]
+        # share_of is non-decreasing (starts ascend), so first
+        # occurrences are where the value changes — no sort via unique
+        first_idx = np.concatenate([[0], np.nonzero(np.diff(share_of))[0] + 1])
+        ptr[share_of[first_idx]] = in_share[first_idx]
         buf[0, 34:38] = np.frombuffer(int(ptr[0]).to_bytes(4, "big"), np.uint8)
         if n > 1:
             buf[1:, 32] = ptr[1:] >> 8
@@ -231,6 +236,24 @@ class SparseShareSplitter:
         self.shares: list[Share] = []
 
     def write(self, blob: blob_pkg.Blob) -> None:
+        # A blob's own sparse shares are position-independent bytes, and
+        # parsed Blob objects are shared across the Prepare/Process/
+        # Deliver re-builds of one block (blob.py's unmarshal LRU) — so
+        # the split is computed once per blob and replayed from the
+        # object. The cache holds Share objects whose bytes are frozen;
+        # list.extend of the cached list is the whole warm path.
+        cached = getattr(blob, "_sparse_shares", None)
+        if cached is not None:
+            self.shares.extend(cached)
+            return
+        mark = len(self.shares)
+        self._write_uncached(blob)
+        try:
+            blob._sparse_shares = tuple(self.shares[mark:])
+        except AttributeError:  # slotted/frozen Blob variants: skip memo
+            pass
+
+    def _write_uncached(self, blob: blob_pkg.Blob) -> None:
         # inlined Blob.validate() with the namespace constructed ONCE
         # (new_namespace validates version/id; validate() would build it
         # a second time just to throw it away)
@@ -303,6 +326,48 @@ class SparseShareSplitter:
         return len(self.shares)
 
 
+@functools.lru_cache(maxsize=1 << 15)
+def _counter_step(
+    shares: int, remainder: int, data_len: int
+) -> tuple[int, int, int]:
+    """(new_shares, new_remainder, diff) — the pure transition behind
+    CompactShareCounter.add, memoized because block building repeats the
+    same (state, unit length) pairs across Prepare/Process/Deliver."""
+    last_remainder = remainder
+    last_shares = shares
+    data_len += delim_len(data_len)
+
+    if shares == 0:
+        first_left = appconsts.FIRST_COMPACT_SHARE_CONTENT_SIZE - remainder
+        if data_len >= first_left:
+            data_len -= first_left
+            shares += 1
+            remainder = 0
+        else:
+            remainder += data_len
+            data_len = 0
+
+    cont = appconsts.CONTINUATION_COMPACT_SHARE_CONTENT_SIZE
+    if data_len >= cont - remainder:
+        data_len -= cont - remainder
+        shares += 1
+        remainder = 0
+    else:
+        remainder += data_len
+        data_len = 0
+
+    if data_len > 0:
+        shares += data_len // cont
+        remainder = data_len % cont
+
+    diff = shares - last_shares
+    if last_remainder == 0 and remainder > 0:
+        diff += 1
+    elif last_remainder > 0 and remainder == 0:
+        diff -= 1
+    return shares, remainder, diff
+
+
 class CompactShareCounter:
     """Worst-case compact share counter with single-step revert.
     ref: pkg/shares/counter.go:17-87"""
@@ -314,38 +379,11 @@ class CompactShareCounter:
         self.remainder = 0
 
     def add(self, data_len: int) -> int:
-        data_len += delim_len(data_len)
         self.last_remainder = self.remainder
         self.last_shares = self.shares
-
-        if self.shares == 0:
-            first_left = appconsts.FIRST_COMPACT_SHARE_CONTENT_SIZE - self.remainder
-            if data_len >= first_left:
-                data_len -= first_left
-                self.shares += 1
-                self.remainder = 0
-            else:
-                self.remainder += data_len
-                data_len = 0
-
-        cont = appconsts.CONTINUATION_COMPACT_SHARE_CONTENT_SIZE
-        if data_len >= cont - self.remainder:
-            data_len -= cont - self.remainder
-            self.shares += 1
-            self.remainder = 0
-        else:
-            self.remainder += data_len
-            data_len = 0
-
-        if data_len > 0:
-            self.shares += data_len // cont
-            self.remainder = data_len % cont
-
-        diff = self.shares - self.last_shares
-        if self.last_remainder == 0 and self.remainder > 0:
-            diff += 1
-        elif self.last_remainder > 0 and self.remainder == 0:
-            diff -= 1
+        self.shares, self.remainder, diff = _counter_step(
+            self.shares, self.remainder, data_len
+        )
         return diff
 
     def revert(self) -> None:

@@ -291,3 +291,13 @@ class TestBefpEndToEnd:
         with pytest.raises(FraudDetected):
             lc.rescreen()
         assert 2 not in lc.headers
+
+    def test_nonpositive_height_rejected(self, net):
+        """Negative/zero heights must not become unbounded storage."""
+        nodes, validators, _urls = net
+        for h in (0, -1, -10**9):
+            squat = self._junk_squat(50, 2)
+            squat["height"] = h
+            with pytest.raises(ValueError, match="beyond the chain tip"):
+                validators[1].handle_fraud(squat)
+            assert h not in nodes[1].fraud_proofs
