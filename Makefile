@@ -6,10 +6,18 @@ PY ?= python
 .PHONY: test test-all test-slow bench dryrun native
 
 # Fast developer loop: the default tier skips the slow multi-process
-# suites (devnet, gRPC, multihost, network, race storms). ~3-5 min with
-# a warm .jax_cache; the first run compiles and is slower.
+# suites (devnet, gRPC, multihost, network, race storms). Two FRESH
+# pytest processes: accumulated XLA executables/tracing state slows
+# jit-heavy tests 3-5x late in a long single process (measured on the
+# 1-core CI box), so the device-path files run first in their own
+# interpreter. ~2-3 min with a warm .jax_cache; the first run compiles
+# and is slower.
+JIT_HEAVY = tests/test_extend_tpu.py tests/test_nmt_semantics.py \
+	tests/test_device_resident.py tests/test_blob_pool.py \
+	tests/test_parallel.py tests/test_repair.py tests/test_graft_entry.py
 test:
-	$(PY) -m pytest tests/ -q
+	$(PY) -m pytest $(JIT_HEAVY) -q
+	$(PY) -m pytest tests/ -q $(addprefix --ignore=,$(JIT_HEAVY))
 
 # Everything, including the slow tier (3-OS-process devnet, live gRPC,
 # multi-host DCN backend, RPC race storms). ~8-15 min warm.

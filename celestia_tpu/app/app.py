@@ -150,6 +150,8 @@ class App:
             )
         self._active_backend: str | None = None  # last backend logged
         self.blob_pool = None  # device blob arena (enable_blob_pool)
+        # assembled-vs-fallback proposal counts when the arena is on
+        self.arena_stats = {"assembled": 0, "fallback": 0}
         self.store = StateStore()
         self.accounts = AccountKeeper(self.store)
         self.bank = BankKeeper(self.store)
@@ -317,6 +319,18 @@ class App:
 
             if builder is not None and self.blob_pool is not None:
                 dah = self._assembled_proposal_dah(data_square, builder, k)
+                # hit-rate accounting for operators and the bench: under
+                # arena churn (working set > capacity) proposals
+                # oscillate between the assembled and upload paths —
+                # the rate makes that visible (/metrics + bench 8b)
+                stat = "assembled" if dah is not None else "fallback"
+                self.arena_stats[stat] += 1
+                try:
+                    from celestia_tpu.telemetry import metrics
+
+                    metrics.incr_counter(f"blob_arena_proposal_{stat}")
+                except Exception:  # noqa: BLE001 — metrics never break proposals
+                    pass
                 if dah is not None:
                     return dah
             rows, cols = extend_tpu.roots_device(self._square_array(data_square, k))

@@ -285,7 +285,20 @@ def unmarshal_blob_tx(raw: bytes) -> tuple[BlobTx | None, bool]:
     if cached is not None:
         return cached
     out = _unmarshal_blob_tx_uncached(raw)
-    _PARSE_CACHE.put(raw, out, len(raw))
+    # charge what the entry can actually PIN, not just the raw bytes:
+    # each blob's memoized sparse split holds full 512-byte shares, so a
+    # many-tiny-blob tx pins far more than its wire size (one 1-byte
+    # blob pins a whole share + object overhead)
+    btx = out[0]
+    pinned = len(raw)
+    if btx is not None:
+        first = appconsts.FIRST_SPARSE_SHARE_CONTENT_SIZE
+        cont = appconsts.CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+        for b in btx.blobs:
+            n = len(b.data)
+            shares = 1 if n < first else 1 + (n - first + cont - 1) // cont
+            pinned += shares * appconsts.SHARE_SIZE + 256 + n
+    _PARSE_CACHE.put(raw, out, pinned)
     return out
 
 
@@ -329,7 +342,10 @@ class _ByteBudgetLRU:
                 self.used -= self._cost.pop(k)
 
 
-_PARSE_CACHE = _ByteBudgetLRU(budget_bytes=192 * 1024 * 1024)
+# factor 1: the caller passes a real pinned-bytes estimate per entry
+# (raw + per-blob share memo), not just the wire length
+_PARSE_CACHE = _ByteBudgetLRU(budget_bytes=192 * 1024 * 1024,
+                              overhead_factor=1)
 
 
 def _unmarshal_blob_tx_uncached(raw: bytes) -> tuple[BlobTx | None, bool]:

@@ -224,17 +224,22 @@ class FraudAwareLightClient:
     # worst case is re-verification work, never a wrong verdict.
     MAX_SCREENED_MEMO = 8192
 
-    def rescreen(self, window: int = 64) -> None:
-        """Re-screen recently accepted headers against the watchtowers;
-        a late-arriving verified proof evicts the header AND everything
+    def rescreen(self, window: int | None = None) -> None:
+        """Re-screen accepted headers against the watchtowers; a
+        late-arriving verified proof evicts the header AND everything
         above it (descendants build on the fraudulent state) before
         raising FraudDetected.
 
-        window: how many of the HIGHEST accepted headers to re-check
-        (fraud proofs target recent blocks — full nodes refuse to store
-        proofs far beyond their tip, so unbounded re-screening of deep
-        history costs O(chain length) HTTP traffic for nothing)."""
-        for height in sorted(self.headers)[-window:]:
+        By default EVERY accepted header is re-screened — the guarantee
+        is that no accepted header survives a later proof. Passing
+        `window` bounds the check to the HIGHEST `window` headers for
+        callers that rescreen on a tight cadence and cannot afford
+        O(chain length) HTTP traffic per tick; such callers should
+        still run an unbounded pass periodically."""
+        heights = sorted(self.headers)
+        if window is not None:
+            heights = heights[-window:]
+        for height in heights:
             try:
                 self._screen(height, self.headers[height])
             except FraudDetected:

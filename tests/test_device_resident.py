@@ -136,7 +136,10 @@ class TestChunkedBatchedRoots:
         assert extend_tpu._batch_chunk(128, 8) == 1  # large: sequential map
         assert extend_tpu._batch_chunk(128, 1) == 1
 
-    @pytest.mark.parametrize("chunk", [1, 2])
+    @pytest.mark.parametrize(
+        "chunk",
+        [pytest.param(1, marks=pytest.mark.slow), 2],
+    )
     def test_chunked_equals_unchunked(self, chunk):
         import jax.numpy as jnp
 

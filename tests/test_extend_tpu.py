@@ -104,6 +104,8 @@ class TestPallasKernel:
         pal = np.asarray(rs_pallas.extend_square(jnp.asarray(q0), m2, interpret=True))
         assert np.array_equal(ref, pal)
 
+    @pytest.mark.slow  # pallas interpret mode: compile-bound on 1 CPU core;
+    # the XLA roots-only path stays covered fast by test_device_resident
     def test_roots_only_matches_full(self):
         import jax.numpy as jnp
 
@@ -130,6 +132,8 @@ class TestSha256Pallas:
     tpu-marked test below and the device microbench in the module
     docstring."""
 
+    @pytest.mark.slow  # supplementary: the production XLA spelling is
+    # covered fast by TestSha256Jax; this pins the Pallas kernel MATH
     def test_kernel_math_matches_hashlib(self):
         import hashlib
 

@@ -15,6 +15,8 @@ def host_expected(sq):
 
 
 class TestShardedExtend:
+    @pytest.mark.slow  # multi-device compile-bound on 1 core; the
+    # graft-entry dryrun keeps sharding covered in the fast tier
     def test_jit_sharded_batched(self):
         import jax
 
@@ -37,6 +39,8 @@ class TestShardedExtend:
             assert np.array_equal(np.asarray(eds[b]), eds_h.data)
             assert np.asarray(dah[b]).tobytes() == dah_h.hash()
 
+    @pytest.mark.slow  # multi-device compile-bound on 1 core; the
+    # graft-entry dryrun keeps sharding covered in the fast tier
     def test_shard_map_explicit_collectives(self):
         import jax
 
