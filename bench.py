@@ -864,13 +864,48 @@ def _save_cache(headline: dict, configs: dict, provenance: dict,
         pass
 
 
+CONFIG_TIMEOUT_S = 600
+
+
+class _ConfigTimeout(Exception):
+    pass
+
+
 def _run_config(configs: dict, provenance: dict, cache: dict | None,
                 name: str, fn, *args, **kwargs) -> None:
     """Run one bench config; on ANY failure substitute the cached result
-    (flagged) so one mid-run tunnel drop costs one config, not the round."""
+    (flagged) so one mid-run tunnel drop costs one config, not the round.
+
+    A SIGALRM watchdog bounds each config: a tunnel that dies MID-
+    TRANSFER blocks the device call forever (no exception to catch —
+    observed in round 5), and one hung config must not hang the
+    harness. The alarm raises at the next Python bytecode after the
+    blocked call returns/aborts; the outer watcher's process-level
+    timeout is the backstop when even that never happens."""
+    import signal
+
+    def _on_alarm(_sig, _frm):
+        raise _ConfigTimeout(
+            f"config exceeded {CONFIG_TIMEOUT_S}s (tunnel hang?)"
+        )
+
+    armed = False
+    old_handler = None
     try:
-        configs[name] = fn(*args, **kwargs)
-        provenance[name] = "measured"
+        old_handler = signal.signal(signal.SIGALRM, _on_alarm)
+        signal.alarm(CONFIG_TIMEOUT_S)
+        armed = True
+    except ValueError:  # not the main thread: run unguarded
+        pass
+    try:
+        try:
+            configs[name] = fn(*args, **kwargs)
+            provenance[name] = "measured"
+        finally:
+            # disarm BEFORE any bookkeeping: a timeout firing inside the
+            # except/cache-substitution path would escape uncaught
+            if armed:
+                signal.alarm(0)
     except Exception as e:  # noqa: BLE001 — every failure mode is a tunnel risk
         cached = ((cache or {}).get("configs") or {}).get(name)
         if cached is not None:
@@ -881,6 +916,10 @@ def _run_config(configs: dict, provenance: dict, cache: dict | None,
         else:
             configs[name] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
             provenance[name] = "failed"
+    finally:
+        if armed:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old_handler)
 
 
 def _safe(fn, default=None):
