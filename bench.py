@@ -889,10 +889,21 @@ def _run_config(configs: dict, provenance: dict, cache: dict | None,
             f"config exceeded {CONFIG_TIMEOUT_S}s (tunnel hang?)"
         )
 
+    # `disarmed` also gates the HANDLER: alarm(0) cancels the timer but
+    # not a signal already delivered and pending — the handler must
+    # become a no-op the instant the guarded region ends, or a pending
+    # alarm could fire during bookkeeping and clobber a measured result
+    disarmed = [False]
+
+    def _on_alarm_guarded(_sig, _frm):
+        if disarmed[0]:
+            return
+        _on_alarm(_sig, _frm)
+
     armed = False
     old_handler = None
     try:
-        old_handler = signal.signal(signal.SIGALRM, _on_alarm)
+        old_handler = signal.signal(signal.SIGALRM, _on_alarm_guarded)
         signal.alarm(CONFIG_TIMEOUT_S)
         armed = True
     except ValueError:  # not the main thread: run unguarded
@@ -902,8 +913,9 @@ def _run_config(configs: dict, provenance: dict, cache: dict | None,
             configs[name] = fn(*args, **kwargs)
             provenance[name] = "measured"
         finally:
-            # disarm BEFORE any bookkeeping: a timeout firing inside the
-            # except/cache-substitution path would escape uncaught
+            # neutralize FIRST, then cancel the timer: anything pending
+            # after this point is ignored by the guarded handler
+            disarmed[0] = True
             if armed:
                 signal.alarm(0)
     except Exception as e:  # noqa: BLE001 — every failure mode is a tunnel risk

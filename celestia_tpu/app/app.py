@@ -376,17 +376,17 @@ class App:
         from celestia_tpu.ops.blob_pool import blob_key
         from celestia_tpu.shares.splitters import sparse_shares_needed
 
-        first = appconsts.FIRST_SPARSE_SHARE_CONTENT_SIZE
-        cont = appconsts.CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
         s = k * k
         cell_is_arena = np.zeros(s, bool)
-        cell_blob = np.zeros(s, np.int32)
-        cell_first = np.zeros(s, bool)
-        data_start = np.zeros(s, np.int32)
-        data_len = np.zeros(s, np.int32)
         ns_rows: list = []
+        blob_starts: list[int] = []
+        blob_ns: list[int] = []
+        blob_offs: list[int] = []
         blob_lens: list[int] = []
         resident = total = 0
+        # blob_layout is export order: the cursor only advances, so
+        # starts are ASCENDING — the device-side searchsorted
+        # derivation (_derive_cells) depends on that
         for start, blob in builder.blob_layout():
             total += len(blob.data)
             ns_obj = blob.namespace()
@@ -398,36 +398,33 @@ class App:
             off, ln = loc
             if ln != len(blob.data):
                 continue
-            b_idx = len(ns_rows)
-            ns_rows.append(np.frombuffer(ns_obj.bytes, np.uint8))
-            blob_lens.append(len(blob.data))
             n = sparse_shares_needed(len(blob.data))
-            cells = np.arange(start, start + n)
-            cell_is_arena[cells] = True
-            cell_blob[cells] = b_idx
-            cell_first[start] = True
-            starts = np.where(
-                cells == start, 0, first + (cells - start - 1) * cont
-            )
-            data_start[cells] = off + starts
-            caps = np.where(cells == start, first, cont)
-            data_len[cells] = np.minimum(caps, len(blob.data) - starts)
+            ns_rows.append(np.frombuffer(ns_obj.bytes, np.uint8))
+            blob_starts.append(start)
+            blob_ns.append(n)
+            blob_offs.append(off)
+            blob_lens.append(len(blob.data))
+            cell_is_arena[start : start + n] = True
             resident += len(blob.data)
         if total == 0 or resident * 2 < total:
             return None  # mostly host bytes anyway: upload path wins
         # deduplicated host-share table: a blob-heavy square's host cells
         # are mostly IDENTICAL padding shares (tail/reserved/namespace
         # padding), so the uploaded table shrinks from thousands of rows
-        # to ~#unique (PFB shares + a handful of padding patterns)
-        cell_host_row = np.full(s, -1, np.int32)
+        # to ~#unique (PFB shares + a handful of padding patterns).
+        # Host cells travel as SPARSE (pos, row) pairs and the per-cell
+        # vectors are derived on device: the upload is O(#blobs +
+        # #host cells), not O(k²).
+        host_pos = np.flatnonzero(~cell_is_arena).astype(np.int32)
+        host_row = np.zeros(len(host_pos), np.int32)
         unique_rows: dict[bytes, int] = {}
-        for i in np.flatnonzero(~cell_is_arena):
+        for idx, i in enumerate(host_pos):
             b = data_square[int(i)].data
             row = unique_rows.get(b)
             if row is None:
                 row = len(unique_rows)
                 unique_rows[b] = row
-            cell_host_row[i] = row
+            host_row[idx] = row
         if unique_rows:
             host_shares = np.frombuffer(
                 b"".join(unique_rows.keys()), np.uint8
@@ -435,9 +432,12 @@ class App:
         else:
             host_shares = np.zeros((0, appconsts.SHARE_SIZE), np.uint8)
         rows, cols = extend_tpu.assembled_roots(
-            self.blob_pool.arena, host_shares, cell_host_row,
-            np.stack(ns_rows), cell_blob, cell_first,
-            np.array(blob_lens, np.int32), data_start, data_len, k,
+            self.blob_pool.arena, host_shares, host_pos, host_row,
+            np.array(blob_starts, np.int32), np.array(blob_ns, np.int32),
+            np.array(blob_offs, np.int32), np.array(blob_lens, np.int32),
+            np.stack(ns_rows) if ns_rows
+            else np.zeros((0, appconsts.NAMESPACE_SIZE), np.uint8),
+            k,
         )
         return da.DataAvailabilityHeader(
             [r.tobytes() for r in rows], [c.tobytes() for c in cols]

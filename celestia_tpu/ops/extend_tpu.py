@@ -41,7 +41,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from celestia_tpu import namespace as ns
-from celestia_tpu.appconsts import NAMESPACE_SIZE, SHARE_SIZE
+from celestia_tpu.appconsts import (
+    CONTINUATION_SPARSE_SHARE_CONTENT_SIZE as CONT_SPARSE,
+    FIRST_SPARSE_SHARE_CONTENT_SIZE as FIRST_SPARSE,
+    NAMESPACE_SIZE,
+    SHARE_SIZE,
+)
 from celestia_tpu.ops import rs_tpu
 # The pipeline's hasher is the XLA scan spelling. A Pallas alternative
 # exists (ops/sha256_pallas.py) and measures 1.8x FASTER standalone on
@@ -244,14 +249,57 @@ def eds_roots_device(eds):
 # ever existing host-side.
 
 
-def _assemble_square(arena, host_shares, cells_meta, ns_len_table, k: int):
+def _derive_cells(blob_meta, host_sparse, k: int):
+    """Expand PER-BLOB metadata into the per-cell vectors ON DEVICE.
+
+    blob_meta is (4, B) int32 — [start_cell | n_shares | arena_off |
+    blob_len] with starts ascending (the builder lays blobs out at an
+    increasing cursor) and padding rows start_cell = S, n_shares = 0.
+    host_sparse is (2, Hc) int32 — [cell_pos | host_row] pairs for the
+    cells NOT covered by a resident blob, padding pos = S (dropped).
+
+    Deriving here is what shrinks the proposal upload from O(k²)
+    per-cell vectors (~320 KB at k=128) to O(#blobs + #host cells)
+    rows (~1-10 KB): on a high-RTT, low-bandwidth link the metadata
+    transfer WAS the assembled path's wall time."""
+    s = k * k
+    s_idx = jnp.arange(s, dtype=jnp.int32)
+    starts = blob_meta[0]
+    b = jnp.clip(
+        jnp.searchsorted(starts, s_idx, side="right").astype(jnp.int32) - 1,
+        0, blob_meta.shape[1] - 1,
+    )
+    j_in = s_idx - starts[b]
+    in_blob = (j_in >= 0) & (j_in < blob_meta[1][b])
+    first = FIRST_SPARSE
+    cont = CONT_SPARSE
+    cell_first = in_blob & (j_in == 0)
+    doff = jnp.where(cell_first, 0, first + (j_in - 1) * cont)
+    data_start = jnp.where(in_blob, blob_meta[2][b] + doff, 0)
+    cap = jnp.where(cell_first, first, cont)
+    data_len = jnp.where(
+        in_blob, jnp.minimum(cap, blob_meta[3][b] - doff), 0
+    )
+    cell_blob = jnp.where(in_blob, b, 0)
+    cell_host_row = (
+        jnp.full((s,), -1, jnp.int32)
+        .at[host_sparse[0]]
+        .set(host_sparse[1], mode="drop")
+    )
+    return cell_host_row, cell_blob, cell_first, data_start, data_len
+
+
+def _assemble_square(arena, host_shares, blob_meta, host_sparse,
+                     ns_len_table, k: int):
     """Build the (k,k,512) share square on device.
 
-    cells_meta is ONE packed (5, S) int32 block — [host_row | blob_idx |
-    is_first | data_start | data_len] — and ns_len_table one (B, 33)
-    uint8 block (29-byte namespace ‖ 4-byte BE blob length): per
-    proposal exactly TWO metadata buffers cross the interconnect, which
-    matters on a high-RTT link where every transfer pays latency.
+    Inputs per proposal: the resident arena, the dedup'd host-share
+    table, ONE (4, B) per-blob int32 block, ONE (2, Hc) sparse
+    host-cell block, and ONE (B, 33) uint8 block (29-byte namespace ‖
+    4-byte BE blob length). The per-cell vectors are DERIVED on device
+    (_derive_cells) — only per-blob/host-cell rows cross the
+    interconnect, which matters on a high-RTT link where both latency
+    and bandwidth are paid per proposal.
 
     Each cell is either a host-table share (host_row >= 0) or a sparse
     blob share assembled in place: namespace ‖ info ‖ [seq len] ‖
@@ -259,11 +307,8 @@ def _assemble_square(arena, host_shares, cells_meta, ns_len_table, k: int):
     sparse splitter's layout (shares/splitters.py write), so the result
     is byte-identical to the host-built square (pinned by tests)."""
     j = jnp.arange(SHARE_SIZE, dtype=jnp.int32)  # (512,)
-    cell_host_row = cells_meta[0]
-    cell_blob = cells_meta[1]
-    cell_first = cells_meta[2].astype(bool)
-    data_start = cells_meta[3]
-    data_len = cells_meta[4]
+    cell_host_row, cell_blob, cell_first, data_start, data_len = \
+        _derive_cells(blob_meta, host_sparse, k)
 
     blob_idx = jnp.clip(cell_blob, 0, ns_len_table.shape[0] - 1)
     ns = ns_len_table[blob_idx, :NAMESPACE_SIZE]  # (S, 29)
@@ -293,13 +338,14 @@ def _assemble_square(arena, host_shares, cells_meta, ns_len_table, k: int):
 
 
 @functools.lru_cache(maxsize=16)
-def _jitted_assembled_roots(k: int, h_pad: int, b_pad: int, n_arena: int):
+def _jitted_assembled_roots(k: int, h_pad: int, b_pad: int, hc_pad: int,
+                            n_arena: int):
     m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
 
     @jax.jit
-    def run(arena, host_shares, cells_meta, ns_len_table):
-        square = _assemble_square(arena, host_shares, cells_meta,
-                                  ns_len_table, k)
+    def run(arena, host_shares, blob_meta, host_sparse, ns_len_table):
+        square = _assemble_square(arena, host_shares, blob_meta,
+                                  host_sparse, ns_len_table, k)
         return _rows_cols_only(square, m2)
 
     return run
@@ -314,27 +360,34 @@ def _pow2_at_least(n: int, floor: int) -> int:
 
 def assembled_roots(
     arena,
-    host_shares: np.ndarray,     # (H, 512) uint8
-    cell_host_row: np.ndarray,   # (S,) int32, -1 = arena cell
-    ns_table: np.ndarray,        # (B, 29) uint8
-    cell_blob: np.ndarray,       # (S,) int32 into ns_table
-    cell_first: np.ndarray,      # (S,) bool — sequence-start cells
-    blob_len: np.ndarray,        # (B,) int32 — blob byte lengths
-    data_start: np.ndarray,      # (S,) int32 — absolute arena offsets
-    data_len: np.ndarray,        # (S,) int32 — data bytes in this cell
+    host_shares: np.ndarray,    # (H, 512) uint8 — dedup'd host table
+    host_pos: np.ndarray,       # (Hc,) int32 — cell indexes of host cells
+    host_row: np.ndarray,       # (Hc,) int32 — row into host_shares
+    blob_start: np.ndarray,     # (B,) int32 — first cell per resident blob, ASCENDING
+    blob_nshares: np.ndarray,   # (B,) int32
+    blob_off: np.ndarray,       # (B,) int32 — absolute arena offsets
+    blob_len: np.ndarray,       # (B,) int32 — blob byte lengths
+    ns_table: np.ndarray,       # (B, 29) uint8
     k: int,
 ):
     """Host entry: assemble the square ON DEVICE from the blob arena and
-    return numpy (row_roots, col_roots) — the roots-only proposal path
-    with only metadata uploaded. Host/blob padding counts are padded to
-    powers of two so the jit cache stays small."""
+    return numpy (row_roots, col_roots) — the roots-only proposal path.
+    The upload is O(#blobs + #host cells), NOT O(k²): the per-cell
+    vectors are derived on device (_derive_cells). Pad counts are
+    rounded to powers of two so the jit cache stays small."""
+    s = k * k
+    starts_arr = np.asarray(blob_start, np.int64)
+    if len(starts_arr) > 1 and not np.all(np.diff(starts_arr) > 0):
+        # the device searchsorted derivation silently misattributes
+        # cells if starts are not strictly ascending — fail LOUDLY here
+        # rather than sign a proposal with corrupt roots
+        raise ValueError("blob_start must be strictly ascending")
     h_pad = _pow2_at_least(max(len(host_shares), 1), 16)
     b_pad = _pow2_at_least(max(len(ns_table), 1), 8)
+    hc_pad = _pow2_at_least(max(len(host_pos), 1), 16)
     hs = np.zeros((h_pad, SHARE_SIZE), np.uint8)
     if len(host_shares):
         hs[: len(host_shares)] = host_shares
-    # pack [ns ‖ BE length] per blob and the five per-cell vectors into
-    # single buffers: 2 metadata transfers per proposal, not 8
     nslen = np.zeros((b_pad, NAMESPACE_SIZE + 4), np.uint8)
     if len(ns_table):
         nslen[: len(ns_table), :NAMESPACE_SIZE] = ns_table
@@ -342,18 +395,26 @@ def assembled_roots(
         nslen[: len(ns_table), NAMESPACE_SIZE:] = bl.view(np.uint8).reshape(
             len(ns_table), 4
         )
-    cells_meta = np.stack(
-        [
-            cell_host_row.astype(np.int32),
-            cell_blob.astype(np.int32),
-            cell_first.astype(np.int32),
-            data_start.astype(np.int32),
-            data_len.astype(np.int32),
-        ]
-    )
-    fn = _jitted_assembled_roots(k, h_pad, b_pad, int(arena.shape[0]))
+    # padding rows: start = S (past every cell, keeps starts sorted so
+    # searchsorted never lands a real cell there), n_shares = 0
+    bm = np.zeros((4, b_pad), np.int32)
+    bm[0, :] = s
+    n_b = len(ns_table)
+    if n_b:
+        bm[0, :n_b] = np.asarray(blob_start, np.int32)
+        bm[1, :n_b] = np.asarray(blob_nshares, np.int32)
+        bm[2, :n_b] = np.asarray(blob_off, np.int32)
+        bm[3, :n_b] = np.asarray(blob_len, np.int32)
+    hsp = np.full((2, hc_pad), s, np.int32)  # pos = S → scatter-dropped
+    n_h = len(host_pos)
+    if n_h:
+        hsp[0, :n_h] = np.asarray(host_pos, np.int32)
+        hsp[1, :n_h] = np.asarray(host_row, np.int32)
+    fn = _jitted_assembled_roots(k, h_pad, b_pad, hc_pad,
+                                 int(arena.shape[0]))
     rows, cols = fn(
-        arena, jnp.asarray(hs), jnp.asarray(cells_meta), jnp.asarray(nslen)
+        arena, jnp.asarray(hs), jnp.asarray(bm), jnp.asarray(hsp),
+        jnp.asarray(nslen),
     )
     return np.asarray(rows), np.asarray(cols)
 
