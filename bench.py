@@ -985,7 +985,9 @@ def main():
                     "unit": "ms",
                     "vs_baseline": None,
                     "error": f"accelerator unreachable: {why} — "
-                             "no numbers measured and no session cache",
+                             "no numbers measured and no session cache; "
+                             "last real-chip measurements are recorded in "
+                             "specs/bench.md (round-4/5 sections)",
                 }
             )
         )

@@ -3,15 +3,53 @@
 import os
 
 
+def _machine_fingerprint() -> str:
+    """Short digest of what makes a CPU-compiled executable portable:
+    the host's instruction-set features plus the jaxlib version.
+
+    XLA:CPU AOT results embed the COMPILE machine's feature set; loading
+    one on a host missing those features SIGILLs/segfaults (observed:
+    the shared cache dir was written by a box with amx/avx512 variants
+    this host lacks, and a cache READ crashed the test suite). The
+    cache's own key does not include host features, so partition the
+    directory by them instead."""
+    import hashlib
+    import platform
+
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 says "flags", aarch64 says "Features" — missing
+                # either collapses the fingerprint to machine|version
+                # and re-shares partitions across ISA-different hosts
+                if line.lower().startswith(("flags", "features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        feats = platform.processor()
+    try:
+        import jaxlib
+
+        ver = getattr(jaxlib, "__version__", "?")
+    except Exception:  # noqa: BLE001
+        ver = "?"
+    return hashlib.sha256(
+        f"{platform.machine()}|{ver}|{feats}".encode()
+    ).hexdigest()[:16]
+
+
 def enable_compile_cache() -> str:
-    """Point JAX's persistent compilation cache at the repo-local
-    `.jax_cache` directory (idempotent; env wins if already set).
+    """Point JAX's persistent compilation cache at a repo-local,
+    MACHINE-PARTITIONED directory (idempotent; env wins if already set).
 
     The repair sweep program at k=128 costs tens of seconds to compile
     cold; a warmed cache turns every later process start — node restart,
-    bench run, driver dryrun — into a disk load. Keyed by
-    platform/flags/program, so a stale entry can only cause a recompile,
-    never a wrong result. Returns the cache dir in use."""
+    bench run, driver dryrun — into a disk load. Partitioning by the
+    host fingerprint (_machine_fingerprint) keeps one box's AOT
+    executables from ever loading on a box with different CPU features,
+    which is a hard crash, not a recompile. Returns the cache dir in
+    use."""
     import jax
 
     cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
@@ -19,10 +57,17 @@ def enable_compile_cache() -> str:
         cache_dir = os.path.join(
             os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
             ".jax_cache",
+            _machine_fingerprint(),
         )
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        # 3 s threshold: only the expensive programs (device-path k=128
+        # extends, repair sweeps, sharded steps) are worth persisting,
+        # and every write/read is exposure to an intermittent jaxlib
+        # executable-(de)serialization segfault observed twice under the
+        # long concurrent suite — persist an order of magnitude fewer
+        # programs, keep the wins that matter
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 3.0)
     except Exception:  # noqa: BLE001 — older jax without the knobs
         pass
     return cache_dir
