@@ -850,7 +850,31 @@ def build_validator(genesis: dict, index: int, listen_port: int,
 
     secrets = [bytes.fromhex(v["secret"]) for v in genesis["validators"]]
     keys = [PrivateKey.from_secret(s) for s in secrets]
-    app = App(chain_id=genesis["chain_id"])
+    malicious = genesis.get("malicious") or {}
+    if int(malicious.get("index", -1)) == index:
+        # fault-injection for adversarial devnet tests: this PROCESS
+        # runs the rule-breaking app (testutil/malicious.py) while the
+        # honest processes defend (specs/fraud_proofs.md scenario)
+        import dataclasses
+
+        from celestia_tpu.testutil.malicious import (
+            BehaviorConfig,
+            MaliciousApp,
+        )
+
+        name = malicious.get("behavior", "corrupt_extension")
+        valid = {f.name for f in dataclasses.fields(BehaviorConfig)}
+        if name not in valid:
+            # the child's stderr is usually discarded — a clear error
+            # beats an opaque TypeError after a silent startup timeout
+            raise ValueError(
+                f"unknown malicious behavior {name!r}; expected one of "
+                f"{sorted(valid)}"
+            )
+        behavior = BehaviorConfig(**{name: True})
+        app = MaliciousApp(chain_id=genesis["chain_id"], behavior=behavior)
+    else:
+        app = App(chain_id=genesis["chain_id"])
     accounts = {k: int(v) for k, v in genesis.get("accounts", {}).items()}
     for key, v in zip(keys, genesis["validators"]):
         accounts.setdefault(key.bech32_address(), 0)

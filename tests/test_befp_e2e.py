@@ -301,3 +301,87 @@ class TestBefpEndToEnd:
             with pytest.raises(ValueError, match="beyond the chain tip"):
                 validators[1].handle_fraud(squat)
             assert h not in nodes[1].fraud_proofs
+
+
+@pytest.mark.slow
+class TestBefpMultiProcessDevnet:
+    """The VERDICT done-criterion at OS-process level: a malicious
+    80%-stake proposer PROCESS commits a bad encoding; the honest
+    validator processes refuse, prove, and serve the BEFP; a light
+    client dialing the malicious node's RPC rejects the header."""
+
+    def test_devnet_befp_light_client_rejects(self, tmp_path):
+        import json as _json
+        import subprocess
+        import time as _time
+
+        from tests.test_devnet import _free_ports, _spawn, _wait_status
+
+        genesis = {
+            "chain_id": "befp-devnet",
+            "accounts": {ALICE.bech32_address(): 1_000_000_000},
+            "validators": [
+                {"secret": b"befp-dn-evil".hex(), "tokens": 80_000_000},
+                {"secret": b"befp-dn-b".hex(), "tokens": 10_000_000},
+                {"secret": b"befp-dn-c".hex(), "tokens": 10_000_000},
+            ],
+            "malicious": {"index": 0, "behavior": "corrupt_extension"},
+        }
+        genesis_path = tmp_path / "genesis.json"
+        genesis_path.write_text(_json.dumps(genesis))
+        ports = _free_ports(3)
+        procs = []
+        try:
+            for i in range(3):
+                # liveness far beyond the test window: the honest nodes'
+                # catch-up would otherwise fire mid-test and (with the
+                # malicious node their only ahead peer) restore an
+                # UNCORROBORATED snapshot of the fraudulent chain
+                procs.append(
+                    _spawn(genesis_path, i, ports, tmp_path / f"v{i}",
+                           interval=0.3, liveness=600.0)
+                )
+            clients = [RpcClient(f"http://127.0.0.1:{p}") for p in ports]
+            for c in clients:
+                _wait_status(c)
+
+            # submit a blob to the malicious node so height 2 carries a
+            # corrupted-extension square
+            signer = Signer.setup_single(ALICE, clients[0])
+            from celestia_tpu import blob as blob_pkg
+            from celestia_tpu import namespace as ns
+
+            b = blob_pkg.new_blob(ns.new_v0(b"dn-blob"), b"\x5a" * 4000, 0)
+            res = signer.submit_pay_for_blob([b])
+            assert res.code == 0, res.log
+
+            # the malicious leader commits height >= 2 on ITSELF; honest
+            # processes refuse and must eventually hold a fraud proof
+            deadline = _time.monotonic() + 120
+            proof_height = None
+            while _time.monotonic() < deadline and proof_height is None:
+                for h in (2, 3, 4):
+                    if clients[1].befp(h) or clients[2].befp(h):
+                        proof_height = h
+                        break
+                _time.sleep(0.5)
+            assert proof_height is not None, \
+                "honest processes never served a fraud proof"
+            # honest chain refused the fraudulent height
+            assert clients[1].status()["height"] < proof_height
+
+            # light client: malicious primary; BOTH honest nodes as
+            # watchtowers (a transient gossip failure must not matter —
+            # whichever investigated serves the proof)
+            lc = FraudAwareLightClient(clients[0], [clients[1], clients[2]])
+            with pytest.raises(FraudDetected):
+                lc.accept_header(proof_height)
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(timeout=10)
