@@ -910,8 +910,16 @@ def _run_config(configs: dict, provenance: dict, cache: dict | None,
         pass
     try:
         try:
-            configs[name] = fn(*args, **kwargs)
-            provenance[name] = "measured"
+            result = fn(*args, **kwargs)
+            configs[name] = result
+            # parity gating happens here, not only at the end: the cache
+            # is saved INCREMENTALLY after every config (a process-level
+            # kill mid-run must not lose the session), and a
+            # parity-failed result must never enter it as measured
+            if isinstance(result, dict) and result.get("parity") is False:
+                provenance[name] = "parity-failed"
+            else:
+                provenance[name] = "measured"
         finally:
             # neutralize FIRST, then cancel the timer: anything pending
             # after this point is ignored by the guarded handler
@@ -932,6 +940,10 @@ def _run_config(configs: dict, provenance: dict, cache: dict | None,
         if armed:
             signal.alarm(0)
             signal.signal(signal.SIGALRM, old_handler)
+        # incremental persistence: merge whatever has been measured so
+        # far (prior headlines preserved) so a watchdog/process kill
+        # later in the run cannot zero the session
+        _save_cache({}, configs, provenance, cache, headline_fresh=False)
 
 
 def _safe(fn, default=None):
@@ -1022,12 +1034,11 @@ def main():
     # Mark the tainted config so _save_cache never merges it, SAVE the
     # other configs' fresh numbers first, then abort loudly (an explicit
     # raise, not assert — python -O must not silence a DAH mismatch).
+    # _run_config already tagged fresh parity failures (and kept them
+    # out of the incremental cache saves); this is the loud-abort gate
     parity_failures = [
-        name for name, cfg in configs.items()
-        if prov.get(name) == "measured" and cfg.get("parity") is False
+        name for name in configs if prov.get(name) == "parity-failed"
     ]
-    for name in parity_failures:
-        prov[name] = "parity-failed"
 
     head = configs.get(head_name) or {}
     if prov.get(head_name) != "measured" and "tpu_ms" not in head:

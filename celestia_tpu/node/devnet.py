@@ -841,7 +841,13 @@ def build_validator(genesis: dict, index: int, listen_port: int,
     of a devnet genesis document:
 
         {"chain_id": ..., "accounts": {addr: amount},
-         "validators": [{"secret": hex, "tokens": N}, ...]}
+         "validators": [{"secret": hex, "tokens": N}, ...],
+         "malicious": {"index": i, "behavior": name}}  # optional
+                                                       # fault injection
+
+    The optional "malicious" key makes validator `index` run the
+    rule-breaking app (testutil/malicious.py BehaviorConfig field
+    names; adversarial devnet tests only).
 
     Every process derives the same genesis state, so height-0 app
     hashes agree by construction."""

@@ -331,29 +331,33 @@ class TestBefpMultiProcessDevnet:
         genesis_path.write_text(_json.dumps(genesis))
         ports = _free_ports(3)
         procs = []
+        clients = [RpcClient(f"http://127.0.0.1:{p}") for p in ports]
         try:
-            for i in range(3):
-                # liveness far beyond the test window: the honest nodes'
-                # catch-up would otherwise fire mid-test and (with the
-                # malicious node their only ahead peer) restore an
-                # UNCORROBORATED snapshot of the fraudulent chain
+            # liveness far beyond the test window: the honest nodes'
+            # catch-up would otherwise fire mid-test and (with the
+            # malicious node their only ahead peer) restore an
+            # UNCORROBORATED snapshot of the fraudulent chain. With
+            # catch-up off, commit delivery is the only sync channel —
+            # so the HONEST nodes must be serving BEFORE the malicious
+            # leader's first self-committed height goes out (its 80%
+            # needs no peer votes): spawn them first, malicious last.
+            for i in (1, 2):
                 procs.append(
                     _spawn(genesis_path, i, ports, tmp_path / f"v{i}",
                            interval=0.3, liveness=600.0)
                 )
-            clients = [RpcClient(f"http://127.0.0.1:{p}") for p in ports]
-            for c in clients:
-                _wait_status(c)
+            for i in (1, 2):
+                _wait_status(clients[i])
+            procs.append(
+                _spawn(genesis_path, 0, ports, tmp_path / "v0",
+                       interval=0.3, liveness=600.0)
+            )
+            _wait_status(clients[0])
 
-            # submit a blob to the malicious node so height 2 carries a
-            # corrupted-extension square
-            signer = Signer.setup_single(ALICE, clients[0])
-            from celestia_tpu import blob as blob_pkg
-            from celestia_tpu import namespace as ns
-
-            b = blob_pkg.new_blob(ns.new_v0(b"dn-blob"), b"\x5a" * 4000, 0)
-            res = signer.submit_pay_for_blob([b])
-            assert res.code == 0, res.log
+            # NOTE: the corrupted extension is independent of mempool
+            # content — MaliciousApp corrupts EVERY proposal from
+            # height 2 on, including empty squares, so no tx submission
+            # is needed to trigger the fraud.
 
             # the malicious leader commits height >= 2 on ITSELF; honest
             # processes refuse and must eventually hold a fraud proof
