@@ -13,7 +13,6 @@ the first unit that starts in the share.
 
 from __future__ import annotations
 
-import dataclasses
 
 from celestia_tpu import appconsts
 from celestia_tpu import namespace as ns_pkg
@@ -22,15 +21,36 @@ from celestia_tpu.namespace import Namespace
 from .info_byte import InfoByte, new_info_byte, parse_info_byte  # noqa: F401
 
 
-@dataclasses.dataclass(frozen=True)
 class Share:
-    data: bytes
+    """One 512-byte share. Semantically immutable (`data` is bytes and
+    is never reassigned in-tree); a hand-rolled __slots__ class instead
+    of a frozen dataclass because block building constructs thousands
+    per square and frozen-dataclass __init__ costs ~2x (it routes every
+    field through object.__setattr__)."""
 
-    def __post_init__(self):
-        if len(self.data) != appconsts.SHARE_SIZE:
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes):
+        if len(data) != appconsts.SHARE_SIZE:
             raise ValueError(
-                f"share data must be {appconsts.SHARE_SIZE} bytes, got {len(self.data)}"
+                f"share data must be {appconsts.SHARE_SIZE} bytes, got {len(data)}"
             )
+        object.__setattr__(self, "data", data)
+
+    def __setattr__(self, name, value):
+        # immutability is load-bearing: padding shares are lru-cached
+        # singletons shared across every square, and Share hashes by
+        # its bytes — a silent mutation would corrupt both
+        raise AttributeError("Share is immutable")
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Share) and self.data == other.data
+
+    def __hash__(self) -> int:
+        return hash(self.data)
+
+    def __repr__(self) -> str:
+        return f"Share({self.data[:8].hex()}…)"
 
     def namespace(self) -> Namespace:
         return ns_pkg.from_bytes(self.data[: appconsts.NAMESPACE_SIZE])
@@ -215,8 +235,9 @@ def _cached_padding_share(ns_bytes: bytes, share_version: int) -> Share:
 
 def namespace_padding_share(namespace: Namespace, share_version: int) -> Share:
     # Padding shares are constant per (namespace, version); Share is
-    # frozen so one cached instance serves every occurrence — a square
-    # can contain thousands of identical tail-padding shares.
+    # immutable (__setattr__ guard) so one cached instance serves every
+    # occurrence — a square can contain thousands of identical
+    # tail-padding shares.
     return _cached_padding_share(namespace.bytes, share_version)
 
 
