@@ -378,6 +378,71 @@ def cmd_query(args):
     print(json.dumps(_rpc(args, "GET", args.path)))
 
 
+def cmd_light(args):
+    """Fraud-aware light client (specs/fraud_proofs.md consumer role):
+    follow headers from a primary full node, screen each against
+    watchtower fraud proofs, print one JSON line per decision. Exits
+    non-zero the moment a verified proof condemns a header."""
+    from celestia_tpu.node.client import (
+        FraudAwareLightClient,
+        FraudDetected,
+        RpcClient,
+    )
+
+    primary = RpcClient(args.primary)
+    towers = [
+        RpcClient(u.strip()) for u in args.watchtowers.split(",")
+        if u.strip()
+    ]
+    lc = FraudAwareLightClient(primary, towers)
+    height = args.from_height
+    # idle timeout: reset on every accepted header — "stop waiting for
+    # NEW headers", not an absolute run deadline
+    idle_since = time.monotonic()
+    polls = 0
+    while True:
+        try:
+            hdr = lc.accept_header(height)
+        except FraudDetected as e:
+            print(json.dumps({"height": height, "accepted": False,
+                              "fraud": str(e)}))
+            raise SystemExit(2)
+        if hdr is None:
+            if args.once:
+                # explicit record: exit 0 with silence would be
+                # indistinguishable from "screened clean"
+                print(json.dumps({"height": height, "accepted": None,
+                                  "reason": "not yet produced"}))
+                return
+            if args.timeout and time.monotonic() - idle_since > args.timeout:
+                return
+            time.sleep(args.poll)
+            polls += 1
+            # rescreen for proofs that arrived after acceptance: a
+            # cheap windowed pass each poll, a FULL pass periodically
+            # (a proof can condemn a header far below the tip —
+            # client.py requires windowed callers to do this)
+            try:
+                lc.rescreen(window=None if polls % 32 == 0 else 64)
+            except FraudDetected as e:
+                print(json.dumps(
+                    {"height": getattr(e, "height", None),
+                     "accepted": False, "fraud": str(e)}))
+                raise SystemExit(2)
+            # bound follower memory: headers far below the full-pass
+            # horizon can no longer be condemned by a servable proof
+            if len(lc.headers) > 16384:
+                for h in sorted(lc.headers)[:-8192]:
+                    del lc.headers[h]
+            continue
+        print(json.dumps({"height": height, "accepted": True,
+                          "data_hash": hdr["data_hash"]}))
+        idle_since = time.monotonic()
+        height += 1
+        if args.once:
+            return
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="celestia-tpu")
     parser.add_argument("--home", default=DEFAULT_HOME)
@@ -443,6 +508,22 @@ def main(argv=None):
     p_compact.add_argument("--keep-recent", type=int, default=100,
                            help="blocks to retain below the snapshot height")
 
+    p_light = sub.add_parser(
+        "light", help="fraud-aware light client: follow headers from a "
+        "primary node, reject on verified bad-encoding proofs")
+    p_light.add_argument("--primary", required=True,
+                         help="full node RPC base URL to follow")
+    p_light.add_argument("--watchtowers", default="",
+                         help="comma-separated RPC URLs serving "
+                              "/fraud/befp")
+    p_light.add_argument("--from-height", type=int, default=1)
+    p_light.add_argument("--poll", type=float, default=1.0)
+    p_light.add_argument("--timeout", type=float, default=0.0,
+                         help="stop waiting for new headers after this "
+                              "many seconds (0 = follow forever)")
+    p_light.add_argument("--once", action="store_true",
+                         help="screen exactly --from-height, then exit")
+
     args = parser.parse_args(argv)
     {
         "init": cmd_init,
@@ -455,6 +536,7 @@ def main(argv=None):
         "addrbook": cmd_addrbook,
         "rollback": cmd_rollback,
         "compact": cmd_compact,
+        "light": cmd_light,
     }[args.cmd](args)
 
 

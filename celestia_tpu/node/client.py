@@ -288,9 +288,11 @@ class FraudAwareLightClient:
                         pass
                     continue
                 if is_fraud:
-                    raise FraudDetected(
+                    err = FraudDetected(
                         f"height {height}: committed DAH fails the erasure "
                         f"code ({proof.axis} {proof.index}) — proven by "
                         f"{tower.base_url}"
                     )
+                    err.height = height  # structured access for callers
+                    raise err
                 self._memo(key)

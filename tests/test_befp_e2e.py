@@ -389,3 +389,45 @@ class TestBefpMultiProcessDevnet:
                 except subprocess.TimeoutExpired:
                     p.kill()
                     p.wait(timeout=10)
+
+
+class TestLightCli:
+    def test_cli_light_accepts_honest_and_rejects_fraud(self, net, capsys):
+        """`celestia-tpu light` — the operator surface over
+        FraudAwareLightClient: accepts honest headers, exits 2 with a
+        fraud record on a condemned one."""
+        import json as _json
+
+        from celestia_tpu.cli import main as cli_main
+
+        nodes, validators, urls = net
+        _commit_fraudulent_block(nodes, validators)
+
+        # honest height 1 via --once
+        cli_main(["light", "--primary", urls[0],
+                  "--watchtowers", urls[1], "--from-height", "1", "--once"])
+        out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out == {"height": 1, "accepted": True,
+                       "data_hash": nodes[0].get_block(1).data_hash.hex()}
+
+        # fraudulent height 2: exit code 2 + fraud record
+        with pytest.raises(SystemExit) as exc:
+            cli_main(["light", "--primary", urls[0],
+                      "--watchtowers", urls[1], "--from-height", "2",
+                      "--once"])
+        assert exc.value.code == 2
+        out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["accepted"] is False and "erasure code" in out["fraud"]
+
+    def test_cli_light_unproduced_height_is_explicit(self, net, capsys):
+        """--once on a not-yet-produced height must say so, not exit
+        silently (exit 0 + silence would read as 'screened clean')."""
+        import json as _json
+
+        from celestia_tpu.cli import main as cli_main
+
+        nodes, _validators, urls = net
+        cli_main(["light", "--primary", urls[1], "--watchtowers", "",
+                  "--from-height", "999", "--once"])
+        out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["accepted"] is None and out["height"] == 999
