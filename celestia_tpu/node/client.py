@@ -110,6 +110,10 @@ class RpcClient:
         """Full extended square by row — O(w^2); full nodes only."""
         return self._get(f"/eds/{height}")
 
+    def sample(self, height: int, row: int, col: int):
+        """One EDS cell + NMT inclusion proof (the DAS unit), or None."""
+        return self._get(f"/sample/{height}/{row}/{col}")
+
     def befp(self, height: int):
         """Stored Bad Encoding Fraud Proofs at a height:
         {"height", "proofs": [wire, ...]} or None."""
@@ -173,6 +177,10 @@ def _wire_key(wire) -> str:
 
 class FraudDetected(Exception):
     """A verified BEFP proves the header's DAH commits a bad encoding."""
+
+
+class Unavailable(Exception):
+    """A sampled block's data cannot be fetched and proof-verified."""
 
 
 class FraudAwareLightClient:
@@ -251,6 +259,80 @@ class FraudAwareLightClient:
         if len(self._screened) >= self.MAX_SCREENED_MEMO:
             self._screened.clear()
         self._screened.add(key)
+
+    def sample_availability(self, height: int, n: int = 16,
+                            rng=None) -> dict:
+        """Data-availability sampling (the celestia-node DAS role): pick
+        n uniformly random extended-square cells, fetch each with its
+        NMT proof from the primary, and verify against the header's own
+        DAH. The header must already be accepted (screened).
+
+        Every fetched byte is UNTRUSTED: a share must carry a valid
+        inclusion proof against the authenticated row root or the
+        sample counts as unavailable. Returns
+        {"sampled", "confidence"} where confidence = 1 - 2^-n is the
+        probability bound that at least half
+        the square is retrievable (each hidden-majority square fails an
+        independent sample with p >= 1/2, and a return means ALL n
+        verified — one failure raises); raises Unavailable when any
+        sample cannot be served or verified — the light client should
+        treat the block as unavailable and alert.
+
+        Note sampling checks AVAILABILITY, not encoding validity: a
+        well-served but mis-encoded square passes sampling by design —
+        that is exactly the gap fraud proofs close (§specs/
+        fraud_proofs.md)."""
+        import random
+
+        from celestia_tpu.da import (
+            DataAvailabilityHeader,
+            erasured_leaf_namespace,
+        )
+        from celestia_tpu.proof import NmtRangeProof
+
+        hdr = self.headers.get(height)
+        if hdr is None:
+            raise ValueError(f"header {height} not accepted yet")
+        try:
+            dah_json = self.primary.dah(height)
+        except Exception as e:  # noqa: BLE001 — stonewalling = unavailable
+            raise Unavailable(
+                f"height {height}: DAH fetch failed: {e}"
+            ) from e
+        if dah_json is None:
+            raise Unavailable(f"height {height}: primary serves no DAH")
+        dah = DataAvailabilityHeader.from_json(dah_json)
+        if dah.hash().hex() != hdr["data_hash"]:
+            raise Unavailable(
+                f"height {height}: served DAH does not match the header"
+            )
+        w = len(dah.row_roots)
+        k = w // 2
+        rng = rng or random.SystemRandom()
+        for _ in range(n):
+            i, j = rng.randrange(w), rng.randrange(w)
+            try:
+                res = self.primary.sample(height, i, j)
+                share = bytes.fromhex(res["share"])
+                p = res["proof"]
+                proof = NmtRangeProof(
+                    start=int(p["start"]), end=int(p["end"]),
+                    nodes=[bytes.fromhex(x) for x in p["nodes"]],
+                    tree_size=int(p["tree_size"]),
+                )
+                if (proof.start, proof.end) != (j, j + 1) or \
+                        proof.tree_size != w:
+                    raise ValueError("proof shape mismatch")
+                ns = erasured_leaf_namespace(i, j, share, k)
+                proof.verify_inclusion(dah.row_roots[i], [ns], [share])
+            except Exception as e:  # noqa: BLE001 — any failure = unavailable
+                raise Unavailable(
+                    f"height {height}: sample ({i},{j}) failed: {e}"
+                ) from e
+        # all-or-nothing by design: ONE unservable/unverifiable sample
+        # makes the block unavailable (raises above), so a return means
+        # every sample verified
+        return {"sampled": n, "confidence": 1.0 - 0.5 ** n}
 
     def _screen(self, height: int, hdr: dict) -> None:
         from celestia_tpu.da import DataAvailabilityHeader

@@ -143,6 +143,40 @@ def _handler_for(node: Node):
                                 ],
                             }
                         )
+                elif len(parts) == 4 and parts[0] == "sample":
+                    # /sample/<h>/<row>/<col> — ONE extended-square cell
+                    # with its NMT inclusion proof against the row tree:
+                    # the data-availability-sampling unit (a light
+                    # client verifies it against the DAH row root it
+                    # already authenticated). O(w) server work, O(log w)
+                    # reply.
+                    h, i, j = int(parts[1]), int(parts[2]), int(parts[3])
+                    eds = node.block_eds(h)
+                    if eds is None:
+                        self._reply({"error": "block not found"}, 404)
+                        return
+                    w = int(eds.shape[0])
+                    if not (0 <= i < w and 0 <= j < w):
+                        self._reply({"error": "coordinate out of range"}, 400)
+                        return
+                    from celestia_tpu.da import erasured_axis_leaves
+                    from celestia_tpu.proof import nmt_prove_range
+
+                    k_orig = w // 2
+                    row_cells = [bytes(eds[i, c]) for c in range(w)]
+                    leaves = erasured_axis_leaves(row_cells, i, k_orig)
+                    proof = nmt_prove_range(leaves, j, j + 1)
+                    self._reply(
+                        {
+                            "share": row_cells[j].hex(),
+                            "proof": {
+                                "start": proof.start,
+                                "end": proof.end,
+                                "nodes": [n.hex() for n in proof.nodes],
+                                "tree_size": proof.tree_size,
+                            },
+                        }
+                    )
                 elif len(parts) == 3 and parts[0] == "fraud" and parts[1] == "befp":
                     h = int(parts[2])
                     proofs = node.fraud_proofs_at(h)

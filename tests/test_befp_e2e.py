@@ -431,3 +431,86 @@ class TestLightCli:
                   "--from-height", "999", "--once"])
         out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
         assert out["accepted"] is None and out["height"] == 999
+
+
+class TestDataAvailabilitySampling:
+    """DAS (the celestia-node light-node role): random EDS cells fetched
+    with NMT proofs and verified against the authenticated DAH."""
+
+    def test_sampling_honest_block(self, net):
+        import random
+
+        nodes, _validators, urls = net
+        lc = FraudAwareLightClient(RpcClient(urls[1]), [])
+        lc.accept_header(1)
+        out = lc.sample_availability(1, n=12, rng=random.Random(7))
+        assert out == {"sampled": 12, "confidence": 1.0 - 0.5 ** 12}
+
+    def test_sampling_detects_withholding(self, net):
+        """A primary that cannot serve a sampled share (or serves an
+        unverifiable one) makes the block UNAVAILABLE."""
+        import random
+
+        from celestia_tpu.node.client import Unavailable
+
+        nodes, _validators, urls = net
+
+        class Withholding(RpcClient):
+            def sample(self, height, row, col):
+                return None  # 404: share withheld
+
+        lc = FraudAwareLightClient(Withholding(urls[1]), [])
+        lc.accept_header(1)
+        with pytest.raises(Unavailable, match="sample"):
+            lc.sample_availability(1, n=4, rng=random.Random(3))
+
+        class Forging(RpcClient):
+            def sample(self, height, row, col):
+                res = super().sample(height, row, col)
+                share = bytearray(bytes.fromhex(res["share"]))
+                share[100] ^= 0xFF  # tamper outside the namespace
+                res["share"] = bytes(share).hex()
+                return res
+
+        lc2 = FraudAwareLightClient(Forging(urls[1]), [])
+        lc2.accept_header(1)
+        with pytest.raises(Unavailable):
+            lc2.sample_availability(1, n=4, rng=random.Random(3))
+
+    def test_sampling_wrong_dah_rejected(self, net):
+        """A primary serving a DAH that does not hash to the header's
+        data_hash is caught before any share is fetched."""
+        import random
+
+        from celestia_tpu.node.client import Unavailable
+
+        nodes, _validators, urls = net
+
+        class WrongDah(RpcClient):
+            def dah(self, height):
+                d = super().dah(height)
+                d["row_roots"][0] = "00" * 90
+                return d
+
+        lc = FraudAwareLightClient(WrongDah(urls[1]), [])
+        lc.accept_header(1)
+        with pytest.raises(Unavailable, match="does not match"):
+            lc.sample_availability(1, n=2, rng=random.Random(1))
+
+    def test_sampling_passes_on_fraudulent_but_served_square(self, net):
+        """Sampling checks AVAILABILITY, not encoding: the malicious
+        node's well-served bad square passes sampling — and the fraud
+        proof is what condemns it (the two mechanisms compose)."""
+        import random
+
+        nodes, validators, urls = net
+        _commit_fraudulent_block(nodes, validators)
+        lc = FraudAwareLightClient(RpcClient(urls[0]), [])
+        hdr = lc.primary.header(2)
+        lc.headers[2] = hdr  # bypass screening: isolate the DAS check
+        out = lc.sample_availability(2, n=8, rng=random.Random(5))
+        assert out["sampled"] == 8
+        # ...and the fraud proof still condemns the same header
+        lc2 = FraudAwareLightClient(RpcClient(urls[0]), [RpcClient(urls[1])])
+        with pytest.raises(FraudDetected):
+            lc2.accept_header(2)
