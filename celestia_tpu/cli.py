@@ -387,6 +387,7 @@ def cmd_light(args):
         FraudAwareLightClient,
         FraudDetected,
         RpcClient,
+        Unavailable,
     )
 
     primary = RpcClient(args.primary)
@@ -435,8 +436,16 @@ def cmd_light(args):
                 for h in sorted(lc.headers)[:-8192]:
                     del lc.headers[h]
             continue
-        print(json.dumps({"height": height, "accepted": True,
-                          "data_hash": hdr["data_hash"]}))
+        record = {"height": height, "accepted": True,
+                  "data_hash": hdr["data_hash"]}
+        if args.sample:
+            try:
+                record["das"] = lc.sample_availability(height, n=args.sample)
+            except Unavailable as e:
+                record.update(accepted=False, unavailable=str(e))
+                print(json.dumps(record))
+                raise SystemExit(3)
+        print(json.dumps(record))
         idle_since = time.monotonic()
         height += 1
         if args.once:
@@ -523,6 +532,16 @@ def main(argv=None):
                               "many seconds (0 = follow forever)")
     p_light.add_argument("--once", action="store_true",
                          help="screen exactly --from-height, then exit")
+    def _nonneg(v):
+        n = int(v)
+        if n < 0:
+            raise argparse.ArgumentTypeError("--sample must be >= 0")
+        return n
+
+    p_light.add_argument("--sample", type=_nonneg, default=0, metavar="N",
+                         help="also data-availability-sample N random "
+                              "shares per header (exit 3 on an "
+                              "unavailable block)")
 
     args = parser.parse_args(argv)
     {

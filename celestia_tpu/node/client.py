@@ -301,12 +301,19 @@ class FraudAwareLightClient:
             ) from e
         if dah_json is None:
             raise Unavailable(f"height {height}: primary serves no DAH")
-        dah = DataAvailabilityHeader.from_json(dah_json)
+        try:
+            dah = DataAvailabilityHeader.from_json(dah_json)
+        except Exception as e:  # noqa: BLE001 — malformed reply = unavailable
+            raise Unavailable(
+                f"height {height}: malformed DAH reply: {e}"
+            ) from e
         if dah.hash().hex() != hdr["data_hash"]:
             raise Unavailable(
                 f"height {height}: served DAH does not match the header"
             )
         w = len(dah.row_roots)
+        if w < 2:
+            raise Unavailable(f"height {height}: DAH has no rows")
         k = w // 2
         rng = rng or random.SystemRandom()
         for _ in range(n):

@@ -514,3 +514,15 @@ class TestDataAvailabilitySampling:
         lc2 = FraudAwareLightClient(RpcClient(urls[0]), [RpcClient(urls[1])])
         with pytest.raises(FraudDetected):
             lc2.accept_header(2)
+
+    def test_cli_light_with_sampling(self, net, capsys):
+        import json as _json
+
+        from celestia_tpu.cli import main as cli_main
+
+        nodes, _validators, urls = net
+        cli_main(["light", "--primary", urls[1], "--watchtowers", "",
+                  "--from-height", "1", "--once", "--sample", "6"])
+        out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["accepted"] is True
+        assert out["das"] == {"sampled": 6, "confidence": 1.0 - 0.5 ** 6}
