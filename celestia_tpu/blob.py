@@ -373,10 +373,16 @@ def _unmarshal_blob_tx_uncached(raw: bytes) -> tuple[BlobTx | None, bool]:
 # --- IndexWrapper (celestia-core's wrapped PFB tx carrying share indexes) ---
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class IndexWrapper:
     tx: bytes
     share_indexes: list[int]
+    # pre-encoded protobuf field 1, attached by the square builder so
+    # export's per-block re-marshal skips re-encoding the inner tx; a
+    # cache, not identity — excluded from __eq__/__repr__
+    _txf: bytes | None = dataclasses.field(
+        default=None, compare=False, repr=False
+    )
 
 
 def marshal_index_wrapper_size(tx: bytes, share_indexes: list[int]) -> int:
@@ -428,8 +434,16 @@ def marshal_index_wrapper_with_head(
 ) -> bytes:
     """marshal_index_wrapper with field 1 pre-encoded (the builder's
     export marshals every PFB per block; the tx field never changes)."""
-    packed = b"".join(uvarint(i) for i in share_indexes)
-    return tx_field + _field_bytes(2, packed) + _IW_TAIL
+    if len(share_indexes) == 1:  # the common single-blob PFB
+        packed = uvarint(share_indexes[0])
+    elif share_indexes:
+        packed = b"".join(map(uvarint, share_indexes))
+    else:
+        # proto3 omits an empty repeated field — must match
+        # marshal_index_wrapper and the size accounting byte-for-byte
+        return tx_field + _IW_TAIL
+    # b"\x12" == field 2, wire type 2 (what _field_bytes(2, …) emits)
+    return tx_field + b"\x12" + uvarint(len(packed)) + packed + _IW_TAIL
 
 
 def unmarshal_index_wrapper(raw: bytes) -> tuple[IndexWrapper | None, bool]:

@@ -91,8 +91,16 @@ class CompactShareSplitter:
         first = appconsts.FIRST_COMPACT_SHARE_CONTENT_SIZE
         cont = appconsts.CONTINUATION_COMPACT_SHARE_CONTENT_SIZE
         share_size = appconsts.SHARE_SIZE
-        delimited = [uvarint(len(t)) + t for t in txs]
-        stream = b"".join(delimited)
+        # interleave delimiter/payload and join once: one big concat
+        # instead of a fresh bytes object per tx
+        parts = [b""] * (2 * len(txs))
+        unit_lens = np.empty(len(txs), np.int64)
+        for i, t in enumerate(txs):
+            u = uvarint(len(t))
+            parts[2 * i] = u
+            parts[2 * i + 1] = t
+            unit_lens[i] = len(u) + len(t)
+        stream = b"".join(parts)
         total = len(stream)
         n = 1 if total <= first else 1 + (total - first + cont - 1) // cont
 
@@ -120,10 +128,7 @@ class CompactShareSplitter:
 
         # reserved-byte pointers: in-share offset of the first unit that
         # STARTS in each share (0 when none does)
-        lens = np.fromiter(
-            (len(d) for d in delimited), np.int64, count=len(delimited)
-        )
-        starts = np.concatenate([[0], np.cumsum(lens)[:-1]])
+        starts = np.concatenate([[0], np.cumsum(unit_lens)[:-1]])
         share_of = np.where(starts < first, 0, 1 + (starts - first) // cont)
         in_share = np.where(starts < first, 38 + starts, 34 + (starts - first) % cont)
         ptr = np.zeros(n, np.int64)
@@ -140,7 +145,7 @@ class CompactShareSplitter:
             # per-tx share ranges (same Range semantics as write_tx);
             # the square builder passes False — nothing on that path
             # reads them, and tx_key is a sha256 per tx
-            last_byte = starts + lens - 1
+            last_byte = starts + unit_lens - 1
             end_share = np.where(
                 last_byte < first, 0, 1 + (last_byte - first) // cont
             )

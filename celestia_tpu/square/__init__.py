@@ -90,7 +90,14 @@ class Builder:
         self.app_version = app_version
         self.txs: list[bytes] = []
         self.pfbs: list[blob_pkg.IndexWrapper] = []
-        self.blobs: list[Element] = []
+        # layout rows, one per blob: (ns_key, pfb_index, blob_index,
+        # num_shares, max_padding, blob). Plain tuples rather than
+        # Element objects so export() can sort them with the default
+        # tuple comparison — ns_key is the 29-byte namespace (version
+        # byte ‖ 28-byte id), whose lexicographic order IS namespace
+        # order, and the (pfb_index, blob_index) tie-break reproduces
+        # the stable sort's insertion order (appends are sequential)
+        self.blobs: list[tuple] = []
         self.tx_counter = CompactShareCounter()
         self.pfb_counter = CompactShareCounter()
         self.current_size = 0
@@ -155,34 +162,37 @@ class Builder:
                 len(blob_tx.tx), tuple(worst_indexes)
             )
             # Element.new is the single source of the sizing rules —
-            # the template only caches its (num_shares, max_padding)
+            # the template caches its (num_shares, max_padding) along
+            # with the blob and its precomputed namespace sort key
             metas = tuple(
-                (e.num_shares, e.max_padding)
-                for e in (
-                    Element.new(b, 0, 0, self.subtree_root_threshold)
+                (bytes((b.namespace_version,)) + b.namespace_id,
+                 b, e.num_shares, e.max_padding)
+                for b, e in (
+                    (b, Element.new(b, 0, 0, self.subtree_root_threshold))
                     for b in blob_tx.blobs
                 )
             )
             tpl = tpl_map[self.app_version] = (
                 size, metas,
-                sum(num + pad for num, pad in metas),
+                sum(num + pad for _, _, num, pad in metas),
                 blob_pkg._iw_tx_field(blob_tx.tx),
+                worst_indexes,
             )
-        size, metas, max_blob_share_count, txf = tpl
-        iw = blob_pkg.IndexWrapper(
-            tx=blob_tx.tx,
-            share_indexes=_worst_case_share_indexes(
-                len(metas), self.app_version
-            ),
-        )
-        iw._txf = txf  # pre-encoded field 1 for export's re-marshal
+        size, metas, max_blob_share_count, txf, worst = tpl
+        # _txf rides the constructor: pre-encoded field 1 for export's
+        # re-marshal
+        iw = blob_pkg.IndexWrapper(blob_tx.tx, list(worst), txf)
         pfb_share_diff = self.pfb_counter.add(size)
 
         pfb_index = len(self.pfbs)
-        elements = [
-            Element(blob_tx.blobs[idx], pfb_index, idx, num, pad)
-            for idx, (num, pad) in enumerate(metas)
-        ]
+        if len(metas) == 1:  # the common single-blob PFB
+            nskey, b, num, pad = metas[0]
+            elements = [(nskey, pfb_index, 0, num, pad, b)]
+        else:
+            elements = [
+                (nskey, pfb_index, idx, num, pad, b)
+                for idx, (nskey, b, num, pad) in enumerate(metas)
+            ]
 
         if self._can_fit(pfb_share_diff + max_blob_share_count):
             self.blobs.extend(elements)
@@ -203,11 +213,10 @@ class Builder:
 
         ss = inclusion.blob_min_square_size(self.current_size)
 
-        # stable sort by namespace preserves priority order within
-        # namespace; (version, id) tuple order == 29-byte namespace order
-        self.blobs.sort(
-            key=lambda e: (e.blob.namespace_version, e.blob.namespace_id)
-        )
+        # tuple sort: ns_key leads, and the (pfb_index, blob_index)
+        # tie-break equals insertion order — same result as a stable
+        # sort by namespace, without a per-element key callback
+        self.blobs.sort()
 
         tx_writer = CompactShareSplitter(ns_pkg.TX_NAMESPACE, appconsts.SHARE_VERSION_ZERO)
         tx_writer.write_txs_bulk(self.txs, track_ranges=False)
@@ -222,23 +231,25 @@ class Builder:
         stw = inclusion.sub_tree_width
         threshold = self.subtree_root_threshold
         pfbs = self.pfbs
-        for i, element in enumerate(self.blobs):
-            tree_width = stw(element.num_shares, threshold)
+        for i, (_, pfb_index, blob_index, num_shares, max_padding, blob) in enumerate(
+            self.blobs
+        ):
+            tree_width = stw(num_shares, threshold)
             rem = cursor % tree_width
             if rem:
                 cursor += tree_width - rem
             if i == 0:
                 non_reserved_start = cursor
             padding = cursor - end_of_last_blob
-            if padding > element.max_padding:
+            if padding > max_padding:
                 raise ValueError(
-                    f"blob has {padding} padding shares, but {element.max_padding} was the max"
+                    f"blob has {padding} padding shares, but {max_padding} was the max"
                 )
-            pfbs[element.pfb_index].share_indexes[element.blob_index] = cursor
+            pfbs[pfb_index].share_indexes[blob_index] = cursor
             if padding and i > 0:
                 blob_writer.write_namespace_padding_shares(padding)
-            blob_writer.write(element.blob)
-            cursor += element.num_shares
+            blob_writer.write(blob)
+            cursor += num_shares
             end_of_last_blob = cursor
 
         pfb_writer = CompactShareSplitter(
@@ -250,7 +261,7 @@ class Builder:
                     blob_pkg.marshal_index_wrapper_with_head(
                         iw._txf, iw.share_indexes
                     )
-                    if hasattr(iw, "_txf")
+                    if iw._txf is not None
                     else blob_pkg.marshal_index_wrapper(
                         iw.tx, iw.share_indexes
                     )
@@ -281,8 +292,8 @@ class Builder:
         if not self.done:
             self.export()
         return [
-            (self.pfbs[e.pfb_index].share_indexes[e.blob_index], e.blob)
-            for e in self.blobs
+            (self.pfbs[pfb_index].share_indexes[blob_index], blob)
+            for _, pfb_index, blob_index, _, _, blob in self.blobs
         ]
 
     def find_blob_starting_index(self, pfb_index: int, blob_index: int) -> int:
@@ -300,9 +311,9 @@ class Builder:
         if pfb_index < len(self.txs):
             raise ValueError(f"pfbIndex {pfb_index} does not match a pfb")
         pfb_index -= len(self.txs)
-        for e in self.blobs:
-            if e.pfb_index == pfb_index and e.blob_index == blob_index:
-                return e.num_shares
+        for _, p_idx, b_idx, num_shares, _, _ in self.blobs:
+            if p_idx == pfb_index and b_idx == blob_index:
+                return num_shares
         raise ValueError("blob not found")
 
     def find_tx_share_range(self, tx_index: int) -> Range:

@@ -206,3 +206,27 @@ class TestIndexWrapperSize:
             assert marshal_index_wrapper_size(tx, idx) == len(
                 marshal_index_wrapper(tx, idx)
             ), (tx, idx)
+
+    def test_with_head_matches_plain_marshal(self):
+        """The builder's pre-encoded-field-1 fast path must be
+        byte-identical to marshal_index_wrapper on every shape —
+        including empty share_indexes, where proto3 omits the repeated
+        field entirely (regression: the single-index fast path once
+        emitted an explicit empty field 2)."""
+        from celestia_tpu.blob import (
+            _iw_tx_field,
+            marshal_index_wrapper,
+            marshal_index_wrapper_with_head,
+        )
+
+        for tx, idx in [
+            (b"inner", []),
+            (b"inner", [0]),
+            (b"inner", [7]),
+            (b"inner", [16384]),
+            (b"x" * 300, [1, 500, 70000]),
+            (b"", [5, 6]),
+        ]:
+            assert marshal_index_wrapper_with_head(
+                _iw_tx_field(tx), idx
+            ) == marshal_index_wrapper(tx, idx), (tx, idx)
