@@ -141,6 +141,7 @@ class TestChunkedBatchedRoots:
         [pytest.param(1, marks=pytest.mark.slow), 2],
     )
     def test_chunked_equals_unchunked(self, chunk):
+        import jax
         import jax.numpy as jnp
 
         from celestia_tpu.ops import rs_tpu
@@ -148,12 +149,14 @@ class TestChunkedBatchedRoots:
         k, b = 2, 4
         batch = np.stack([_square(k, seed=i) for i in range(b)])
         m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
-        rows_c, cols_c = extend_tpu.roots_only_batched(
-            jnp.asarray(batch), m2, chunk=chunk
-        )
-        rows_f, cols_f = extend_tpu.roots_only_batched(
-            jnp.asarray(batch), m2, chunk=b
-        )  # full vmap (the small-square path)
+        # one program per spelling (production always jits this entry;
+        # eager composition compiles every internal op separately)
+        rows_c, cols_c = jax.jit(
+            lambda s: extend_tpu.roots_only_batched(s, m2, chunk=chunk)
+        )(jnp.asarray(batch))
+        rows_f, cols_f = jax.jit(
+            lambda s: extend_tpu.roots_only_batched(s, m2, chunk=b)
+        )(jnp.asarray(batch))  # full vmap (the small-square path)
         assert np.array_equal(np.asarray(rows_c), np.asarray(rows_f))
         assert np.array_equal(np.asarray(cols_c), np.asarray(cols_f))
 

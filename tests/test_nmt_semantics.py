@@ -152,17 +152,30 @@ class TestHostDeviceAgreement:
 
         return jnp
 
+    # a single jitted entry (jax.jit recompiles per input shape on its
+    # own): calling nmt_leaf_nodes / nmt_reduce_axis eagerly compiles
+    # every internal op and per-level reduction as its OWN tiny XLA
+    # program (~200 compiles, tens of seconds on XLA:CPU); production
+    # always runs these under jit
+    _row_root_fn = None
+
     def _device_row_root(self, jnp, leaf_ns_rows, data_rows):
+        import jax
+
         from celestia_tpu.ops.extend_tpu import nmt_leaf_nodes, nmt_reduce_axis
 
+        cls = type(self)
+        if cls._row_root_fn is None:
+            cls._row_root_fn = jax.jit(
+                lambda n, d: nmt_reduce_axis(nmt_leaf_nodes(n, d))
+            )
         ns_arr = jnp.asarray(
             np.stack([np.frombuffer(n, dtype=np.uint8) for n in leaf_ns_rows])
         )
         data_arr = jnp.asarray(
             np.stack([np.frombuffer(d, dtype=np.uint8) for d in data_rows])
         )
-        nodes = nmt_leaf_nodes(ns_arr, data_arr)
-        return bytes(np.asarray(nmt_reduce_axis(nodes)))
+        return bytes(np.asarray(cls._row_root_fn(ns_arr, data_arr)))
 
     def test_max_ns_leaf_in_q0_matches_general_hasher(self, jnp):
         """Adversarial-but-ordered: the LAST Q0 leaf carries the maximal
