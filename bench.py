@@ -655,8 +655,9 @@ def bench_node_path_arena(k: int = 128):
         lambda i: app._assembled_proposal_dah(square, builder, got_k),
         lambda r: r, n1=2, n2=8, tries=3,
     )
-    # churn regime: a working set ~2x the arena forces wholesale resets
-    # between proposals — the busy-node oscillation (VERDICT r4 weak 5).
+    # churn regime: a working set ~2x the arena forces eviction (half
+    # flips) between proposals — the busy-node oscillation (VERDICT r4
+    # weak 5).
     # Report the measured hit rate and the wall under churn.
     churn_app = App(extend_backend="tpu")
     churn_arena = churn_app.enable_blob_pool(

@@ -364,7 +364,7 @@ class App:
         Runs entirely under the arena lock: offset lookups, the device
         dispatch, and the root fetch must see one consistent arena —
         a concurrent CheckTx staging would otherwise donate-delete the
-        dispatched buffer or (after a wholesale reset) rewrite bytes at
+        dispatched buffer or (after a half flip) rewrite bytes at
         snapshotted offsets (see DeviceBlobArena.lock)."""
         with self.blob_pool.lock:
             return self._assembled_proposal_dah_locked(data_square, builder, k)

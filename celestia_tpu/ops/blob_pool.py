@@ -56,10 +56,15 @@ class DeviceBlobArena:
     """Fixed-size device byte arena with a host-side bump allocator.
 
     Thread-safe for the node's use (CheckTx threads insert, the proposal
-    path reads). Eviction is wholesale: when the arena cannot fit a new
-    blob, it resets — correctness never depends on residency (the
-    proposal path falls back to the plain host-upload route for any blob
-    it cannot find), so the arena is purely a transfer cache.
+    path reads). Eviction is SEMISPACE: the arena is two halves, the
+    bump allocator fills the active one, and overflow flips to the other
+    half, evicting only ITS entries — blobs staged in the previous half
+    stay resident one more cycle, so a working set larger than the
+    arena keeps ~half its blobs warm instead of restaging everything
+    (the wholesale-reset sawtooth the round-4 churn bench measured).
+    Correctness never depends on residency (the proposal path falls back
+    to the plain host-upload route for any blob it cannot find), so the
+    arena is purely a transfer cache.
     """
 
     def __init__(self, capacity_bytes: int = 64 * 1024 * 1024, device=None):
@@ -67,11 +72,18 @@ class DeviceBlobArena:
         import jax.numpy as jnp
 
         self.capacity = int(capacity_bytes)
+        # 4 KB-aligned half; a sub-8 KB arena degenerates to one
+        # wholesale-reset region (half == 0 would make everything
+        # "oversized", so clamp to one slot)
+        self._half = max(4096, self.capacity // 2 // 4096 * 4096)
+        if self._half > self.capacity:
+            self._half = self.capacity
         self._device = device
         self._arena = jax.device_put(
             jnp.zeros((self.capacity,), jnp.uint8), device
         )
         self._offsets: dict[bytes, tuple[int, int]] = {}  # key -> (off, len)
+        self._base = 0  # active half's base offset
         self._next = 0
         # REENTRANT: the proposal path holds this lock across its whole
         # read (offset lookups -> device dispatch -> root fetch, see
@@ -79,7 +91,7 @@ class DeviceBlobArena:
         # re-acquire it. Serializing against put() is what makes the
         # donated in-place arena update safe: a concurrent insert would
         # otherwise DELETE the buffer the proposal just dispatched on
-        # (donate_argnums), and a wholesale reset would rewrite bytes at
+        # (donate_argnums), and a half flip would rewrite bytes at
         # offsets the proposal already snapshotted.
         self._lock = threading.RLock()
 
@@ -93,8 +105,8 @@ class DeviceBlobArena:
 
     def put(self, data: bytes) -> bytes:
         """Stage blob bytes on device; returns the content key.
-        Idempotent; resets the arena when full (transfer cache
-        semantics)."""
+        Idempotent; flips to the other half when the active one is full
+        (transfer cache semantics — see class docstring)."""
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -104,12 +116,24 @@ class DeviceBlobArena:
             if key in self._offsets:
                 return key
             pad = _pad_len(len(data))
-            if pad > self.capacity:
+            if pad > self._half:
                 return key  # oversized: never resident, always fallback
-            if self._next + pad > self.capacity:
-                # wholesale reset: older entries re-stage on next use
-                self._offsets.clear()
-                self._next = 0
+            if self._next + pad > self._base + self._half:
+                # flip: activate the other half and evict only ITS
+                # entries; the half we just filled stays resident for
+                # one more cycle. Entries never straddle the boundary
+                # (pad <= half and allocation flips before overflowing).
+                if self._half * 2 <= self.capacity:
+                    self._base = self._half - self._base  # 0 <-> half
+                else:  # degenerate single-region arena
+                    self._base = 0
+                self._next = self._base
+                lo, hi = self._base, self._base + self._half
+                self._offsets = {
+                    k: (o, ln)
+                    for k, (o, ln) in self._offsets.items()
+                    if not (lo <= o < hi)
+                }
             offset = self._next
             self._next += pad
             chunk = np.zeros((pad,), np.uint8)
@@ -132,15 +156,19 @@ class DeviceBlobArena:
                 "blob_arena_resident_bytes",
                 float(sum(ln for _o, ln in self._offsets.values())),
             )
-            metrics.set_gauge("blob_arena_used_bytes", float(self._next))
+            # active-half fill, not the absolute bump pointer (which
+            # includes the half's base offset under semispace)
+            metrics.set_gauge(
+                "blob_arena_used_bytes", float(self._next - self._base)
+            )
             metrics.set_gauge("blob_arena_capacity_bytes", float(self.capacity))
         except Exception:  # noqa: BLE001 — metrics must never break staging
             pass
 
     def drop(self, key: bytes) -> None:
-        """Forget a blob (committed/evicted tx). Space is reclaimed at
-        the next wholesale reset — a bump allocator stays trivial and
-        the arena is a cache, not a ledger."""
+        """Forget a blob (committed/evicted tx). Space is reclaimed when
+        its half next flips — a bump allocator stays trivial and the
+        arena is a cache, not a ledger."""
         with self._lock:
             self._offsets.pop(key, None)
 

@@ -53,10 +53,44 @@ class TestArena:
         k1 = arena.put(b"a" * 100)
         assert arena.put(b"a" * 100) == k1
         first = arena.offset_of(k1)
-        # fill past capacity: wholesale reset drops the old entry
+        # fill past capacity: eviction eventually drops the old entry
         for i in range(20):
             arena.put(bytes([i]) * 5000)
         assert arena.offset_of(k1) is None or arena.offset_of(k1) == first
+
+    def test_semispace_keeps_previous_half_resident(self):
+        """Overflow flips halves: the entries of the half just filled
+        survive ONE more flip (that is the point of semispace — a
+        churning working set keeps ~half its blobs warm), and their
+        bytes stay readable at the recorded offsets."""
+        arena = DeviceBlobArena(capacity_bytes=16 * 4096)  # half = 8 slots
+        half = arena._half
+        first_half_keys = {}
+        data_by_key = {}
+        while arena._next + 4096 <= half:  # fill the active half exactly
+            d = bytes([len(first_half_keys) + 1]) * 3000
+            k = arena.put(d)
+            first_half_keys[k] = arena.offset_of(k)
+            data_by_key[k] = d
+        # next put overflows -> flip to the second half
+        k_flip = arena.put(b"\xaa" * 3000)
+        off_flip, _ = arena.offset_of(k_flip)
+        assert off_flip >= half, "flip must allocate from the other half"
+        # every first-half entry is still resident, offsets unchanged,
+        # device bytes intact
+        for k, (off, ln) in first_half_keys.items():
+            assert arena.offset_of(k) == (off, ln)
+            got = np.asarray(arena.arena[off : off + ln]).tobytes()
+            assert got == data_by_key[k]
+        # filling the second half past its end flips BACK and evicts the
+        # first half's entries (they had their extra cycle)
+        while arena._next + 4096 <= 2 * half:
+            arena.put(bytes([200 + arena._next // 4096]) * 3000)
+        arena.put(b"\xbb" * 3000)
+        for k in first_half_keys:
+            assert arena.offset_of(k) is None
+        # but the second half's survivor is still there
+        assert arena.offset_of(k_flip) is not None
 
     def test_oversized_blob_never_resident(self):
         arena = DeviceBlobArena(capacity_bytes=8192)
@@ -255,7 +289,14 @@ class TestArenaChurn:
     @pytest.mark.slow
     def test_hit_rate_reported_under_oscillation(self):
         """The assembled/fallback counters expose the oscillation regime
-        a busy node lives in (the bench reports the same rate)."""
+        a busy node lives in (the bench reports the same rate).
+
+        The odd blocks' working set must defeat SEMISPACE eviction, not
+        just a wholesale reset: both halves together hold 4 padded 20 KB
+        blobs, so 12 blobs leave at most 4 resident and the
+        resident*2 < total eligibility rule forces the fallback
+        (6 blobs would keep 4/6 resident and assemble via partial
+        residency — measured when the semispace landed)."""
         app = App(extend_backend="tpu")
         arena = app.enable_blob_pool(capacity_bytes=96 * 1024)
         rng = np.random.default_rng(5)
@@ -266,7 +307,7 @@ class TestArenaChurn:
             if block % 2 == 0:
                 txs = _blob_txs(2, 15_000, seed=500)  # same set: re-stages
             else:
-                txs = _blob_txs(6, 20_000, seed=600 + block)
+                txs = _blob_txs(12, 20_000, seed=600 + block)
             square, kept, builder = square_pkg.build_ex(txs, 1, 128)
             staged = 0
             for _start, blob in builder.blob_layout():
