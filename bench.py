@@ -464,8 +464,9 @@ def bench_sha256_kernels(n: int = 65536, length: int = 571):
     import jax
 
     if jax.default_backend() == "cpu":
-        # Mosaic kernels don't lower on the CPU backend; every other
-        # config still runs there, so skip rather than abort the suite
+        # Mosaic kernels don't lower on the CPU backend. Unreachable
+        # via main() (the probe refuses the cpu backend outright) but
+        # kept for direct callers of this function
         return {"skipped": "no TPU device (pallas kernels need Mosaic)"}
     import jax.numpy as jnp
 
@@ -776,6 +777,9 @@ def tunnel_bandwidth_mb_s():
     return {"up": round(up, 1), "down": round(down, 1)}
 
 
+_NO_RETRY = "[no-retry] "
+
+
 def _probe_device(timeout_s: float = 120.0):
     """(reachable, why) — whether the accelerator answers a tiny round
     trip within the timeout, and the real failure reason otherwise
@@ -790,6 +794,21 @@ def _probe_device(timeout_s: float = 120.0):
         try:
             import jax
 
+            # a dead tunnel can make jax fall back to the cpu backend
+            # SILENTLY (plugin registered, init failed): a cpu round
+            # trip would then "succeed" and the run would record
+            # cpu-vs-cpu numbers as tpu — and overwrite the cached
+            # headline with them. Refuse: cpu fallback IS unreachable.
+            if jax.default_backend() == "cpu":
+                # _NO_RETRY prefix: backend selection is cached for the
+                # process lifetime, so retrying this is guaranteed futile
+                err.append(
+                    _NO_RETRY
+                    + "jax initialized on the cpu backend (accelerator "
+                    "plugin absent or failed) — refusing to measure "
+                    "'tpu' numbers on cpu"
+                )
+                return
             x = jax.device_put(np.ones((8,), np.uint8))
             np.asarray(x)
             ok.append(True)
@@ -817,6 +836,10 @@ def _probe_with_retries(attempts: int = 3, timeout_s: float = 60.0,
         if ok:
             return True, None
         last = why
+        if why and why.startswith(_NO_RETRY):
+            # deterministic for the process lifetime (e.g. jax settled
+            # on the cpu backend): backoff buys nothing, replay now
+            return False, why[len(_NO_RETRY):]
         if i < attempts - 1:
             time.sleep(backoff_s * (i + 1))
     return False, last

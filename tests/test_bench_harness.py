@@ -94,6 +94,40 @@ class TestCacheMerge:
         assert bench._load_cache() is None
 
 
+class TestProbeRetry:
+    def test_no_retry_sentinel_skips_backoff(self, monkeypatch):
+        """A cpu-backend fallback is deterministic for the process
+        lifetime: the probe must give up immediately (no 45 s of
+        futile backoff) and strip the sentinel from the reason."""
+        calls = []
+
+        def fake_probe(timeout_s):
+            calls.append(1)
+            return False, bench._NO_RETRY + "cpu backend"
+
+        monkeypatch.setattr(bench, "_probe_device", fake_probe)
+        monkeypatch.setattr(
+            bench.time, "sleep", lambda s: (_ for _ in ()).throw(
+                AssertionError("backoff slept on a no-retry failure")
+            )
+        )
+        ok, why = bench._probe_with_retries(attempts=3, timeout_s=1)
+        assert not ok
+        assert why == "cpu backend"
+        assert len(calls) == 1
+
+    def test_transient_failure_still_retries(self, monkeypatch):
+        seq = [(False, "timeout"), (True, None)]
+
+        def fake_probe(timeout_s):
+            return seq.pop(0)
+
+        monkeypatch.setattr(bench, "_probe_device", fake_probe)
+        monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+        ok, why = bench._probe_with_retries(attempts=3, timeout_s=1)
+        assert ok and why is None
+
+
 class TestRunConfig:
     def test_success_marks_measured(self, cache_path):
         configs, prov = {}, {}
