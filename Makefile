@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-slow bench dryrun native
+.PHONY: test test-all test-slow chaos bench dryrun native
 
 # Fast developer loop: the default tier skips the slow multi-process
 # suites (devnet, gRPC, multihost, network, race storms). Two FRESH
@@ -36,6 +36,13 @@ test-all:
 # Only the slow tier.
 test-slow:
 	$(PY) -m pytest tests/ --all -m slow -q
+
+# Deterministic chaos suite (specs/faults.md): fault injection across
+# the transport/codec/device boundaries, slow cases included, pinned
+# seed so every run replays the identical fault schedule.
+chaos:
+	CELESTIA_CHAOS_SEED=$${CELESTIA_CHAOS_SEED:-1337} \
+		$(PY) -m pytest tests/test_chaos.py --all -q
 
 # The BASELINE benchmark suite on the real TPU chip (one JSON line).
 bench:

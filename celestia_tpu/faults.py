@@ -1,0 +1,199 @@
+"""Deterministic, seeded fault injection for the I/O and device boundaries.
+
+Erasure-coded DA systems treat partial failure as the steady state, not
+the exception — so the framework's transport and device boundaries carry
+NAMED injection sites that a test (or a chaos drill) can arm without
+touching production code paths:
+
+    rpc.get / rpc.post     RpcClient HTTP transport      (node/client.py)
+    codec.call             CodecClient gRPC calls        (service/codec_service.py)
+    codec.backend          CodecServer handler entry     (service/codec_service.py)
+    device.extend          TPU extend host entries       (ops/extend_tpu.py)
+    device.repair          TPU repair host entries       (ops/repair_tpu.py)
+    watchtower.befp        light-client watchtower query (node/client.py)
+
+Fault kinds:
+
+    delay        sleep ``delay_s`` then continue
+    error        raise TransportFault (a typed transport-layer error)
+    reset        raise ConnectionResetFault (also a ConnectionResetError)
+    corrupt      flip one payload byte (the site applies the returned
+                 corruptor to its raw response bytes)
+    unavailable  raise DeviceUnavailable (device gone / backend down)
+
+Scoping and determinism: ``with faults.inject(rule(...), seed=N):``
+pushes a FaultInjector onto a process-global stack and pops it on exit —
+global so server handler threads (gRPC worker pool, HTTP handler
+threads) see the same injector as the test thread, scoped so nothing
+leaks past the ``with``. Every decision draws from the injector's own
+seeded ``random.Random`` under a lock and is appended to ``.schedule``,
+so two runs with the same seed and the same operation sequence produce
+byte-identical fault schedules (pinned by tests/test_chaos.py).
+
+Sites call ``faults.fire(site, **ctx)``; with no injector armed this is
+a single empty-list check — effectively free on production hot paths.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import fnmatch
+import random
+import threading
+import time
+
+
+class FaultError(Exception):
+    """Base class for every injected fault."""
+
+
+class TransportFault(FaultError):
+    """Injected transport-layer error (connect failure, 5xx, dropped
+    response) — the retryable class of failure a resilient client must
+    absorb."""
+
+
+class ConnectionResetFault(TransportFault, ConnectionResetError):
+    """Injected mid-request connection reset (also an OSError, so code
+    that handles real resets handles this one identically)."""
+
+
+class DeviceUnavailable(FaultError):
+    """Injected device/backend unavailability (TPU gone, sidecar down)."""
+
+
+KINDS = ("delay", "error", "reset", "corrupt", "unavailable")
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One armed fault: where it strikes, what it does, how often.
+
+    ``site`` is glob-matched (``rpc.*`` arms both HTTP methods).
+    ``where`` additionally requires the substring to appear in one of
+    the site's context values (e.g. a port number, to fault only one of
+    several servers). ``after`` skips the first N matching hits;
+    ``times`` stops firing after N strikes; ``probability`` gates each
+    strike on a draw from the injector's seeded rng."""
+
+    site: str
+    kind: str
+    probability: float = 1.0
+    times: int | None = None
+    after: int = 0
+    delay_s: float = 0.01
+    where: str | None = None
+    # bookkeeping (mutated by the injector)
+    seen: int = 0
+    fired: int = 0
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; one of {KINDS}")
+
+
+def rule(site: str, kind: str, **kw) -> FaultRule:
+    """Convenience constructor: ``rule("rpc.get", "error", times=2)``."""
+    return FaultRule(site=site, kind=kind, **kw)
+
+
+def _corruptor(pos_draw: int):
+    def corrupt(payload: bytes) -> bytes:
+        if not payload:
+            return payload
+        out = bytearray(payload)
+        out[pos_draw % len(out)] ^= 0xFF
+        return bytes(out)
+
+    return corrupt
+
+
+class FaultInjector:
+    """Seeded decision engine over a set of FaultRules.
+
+    ``schedule`` records every strike as ``(seq, site, kind)`` where
+    ``seq`` is the global fire() ordinal — the determinism artifact
+    chaos tests compare across runs."""
+
+    def __init__(self, rules, seed: int = 0):
+        self.rules = list(rules)
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.schedule: list[tuple[int, str, str]] = []
+        self._seq = 0
+        self._lock = threading.RLock()
+
+    def on_fire(self, site: str, **ctx):
+        """Consult the rules for one boundary crossing. Returns a
+        payload corruptor (or None); raises/sleeps per the struck rules.
+        Decisions happen under the lock; sleeps happen outside it."""
+        corrupt = None
+        actions: list[FaultRule] = []
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            for r in self.rules:
+                if not fnmatch.fnmatch(site, r.site):
+                    continue
+                if r.where is not None and not any(
+                    r.where in str(v) for v in ctx.values()
+                ):
+                    continue
+                r.seen += 1
+                if r.seen <= r.after:
+                    continue
+                if r.times is not None and r.fired >= r.times:
+                    continue
+                if r.probability < 1.0 and self.rng.random() >= r.probability:
+                    continue
+                r.fired += 1
+                self.schedule.append((seq, site, r.kind))
+                if r.kind == "corrupt":
+                    corrupt = _corruptor(self.rng.randrange(1 << 16))
+                else:
+                    actions.append(r)
+        for r in actions:
+            if r.kind == "delay":
+                time.sleep(r.delay_s)
+            elif r.kind == "error":
+                raise TransportFault(f"injected transport error at {site}")
+            elif r.kind == "reset":
+                raise ConnectionResetFault(f"injected connection reset at {site}")
+            elif r.kind == "unavailable":
+                raise DeviceUnavailable(f"injected unavailability at {site}")
+        return corrupt
+
+
+# process-global injector stack: the TOPMOST (innermost ``with``) wins.
+# Global rather than context-local on purpose — server handler threads
+# must observe the injector the test armed.
+_stack: list[FaultInjector] = []
+_stack_lock = threading.Lock()
+
+
+def active() -> FaultInjector | None:
+    return _stack[-1] if _stack else None
+
+
+@contextlib.contextmanager
+def inject(*rules: FaultRule, seed: int = 0, injector: FaultInjector | None = None):
+    """Arm an injector for the dynamic extent of the ``with`` block."""
+    inj = injector if injector is not None else FaultInjector(rules, seed=seed)
+    with _stack_lock:
+        _stack.append(inj)
+    try:
+        yield inj
+    finally:
+        with _stack_lock:
+            _stack.remove(inj)
+
+
+def fire(site: str, **ctx):
+    """Site hook: no-op (None) unless an injector is armed. Returns a
+    payload corruptor when a ``corrupt`` rule strikes; raises for
+    error/reset/unavailable strikes; sleeps for delay strikes."""
+    inj = active()
+    if inj is None:
+        return None
+    return inj.on_fire(site, **ctx)

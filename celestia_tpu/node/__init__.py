@@ -7,6 +7,23 @@ test/util/testnode/full_node.go:70 boots one in-process validator with a
 local ABCI client): a Node that runs the full
 CheckTx -> PrepareProposal -> ProcessProposal -> Deliver -> Commit flow
 against a celestia_tpu.app.App, plus a block store with DAH per block.
+
+Node/Block/Mempool are resolved lazily (PEP 562): the transport-only
+modules in this package (node.client) must stay importable in stripped
+environments where the app stack's crypto dependency is absent — a
+light client or chaos harness needs the wire, not the state machine.
 """
 
-from .node import Block, Mempool, Node  # noqa: F401
+_NODE_NAMES = ("Block", "Mempool", "Node")
+
+
+def __getattr__(name):
+    if name in _NODE_NAMES:
+        from celestia_tpu.node import node as _node
+
+        return getattr(_node, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_NODE_NAMES))

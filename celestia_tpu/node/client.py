@@ -13,8 +13,25 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import random
+import threading
+import time
 import urllib.error
 import urllib.request
+
+from celestia_tpu import faults
+
+
+class TransportError(Exception):
+    """A request failed at the transport layer after exhausting retries.
+
+    The ONLY transport exception RpcClient lets escape — raw
+    urllib.error.URLError / socket errors never leak to callers."""
+
+
+class CircuitOpenError(TransportError):
+    """Fast-fail: the client's circuit breaker is open after a streak of
+    consecutive transport failures; no network attempt was made."""
 
 
 @dataclasses.dataclass
@@ -24,25 +41,134 @@ class BroadcastResult:
     priority: int = 0
 
 
+# 404 must survive the retry wrapper as a distinct value ("not found",
+# not "transport failed"): callers get None, never a retry storm
+_NOT_FOUND = object()
+
+# transport-layer failures worth retrying: connect errors, timeouts,
+# mid-stream resets, injected faults, and corrupted (unparseable)
+# payloads — ValueError, not JSONDecodeError: a flipped byte can also
+# surface as UnicodeDecodeError from json.loads, and both mean "the
+# bytes on the wire were damaged". urllib.error.HTTPError is
+# deliberately handled BEFORE this tuple can see it (it subclasses
+# URLError but means "the server answered").
+_RETRYABLE = (
+    urllib.error.URLError,
+    ConnectionError,
+    TimeoutError,
+    OSError,
+    ValueError,
+    faults.TransportFault,
+)
+
+
 class RpcClient:
-    def __init__(self, base_url: str, timeout: float = 10.0):
+    def __init__(self, base_url: str, timeout: float = 10.0,
+                 retries: int = 3, backoff_base: float = 0.05,
+                 backoff_max: float = 1.0, breaker_threshold: int = 8,
+                 breaker_cooldown: float = 5.0):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.retries = retries
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown = breaker_cooldown
+        self._fail_streak = 0
+        self._open_until = 0.0
+        self._breaker_lock = threading.Lock()
 
-    # --- plumbing ---
+    # --- plumbing: retry with exponential backoff + full jitter, and a
+    # circuit breaker that fast-fails after a streak of consecutive
+    # transport failures (half-open after the cooldown: one probe either
+    # closes it or re-opens it immediately) ---
+
+    def _note_failure(self) -> bool:
+        """Record one transport failure; returns True when it opened
+        (or re-opened) the breaker."""
+        from celestia_tpu.telemetry import metrics
+
+        with self._breaker_lock:
+            self._fail_streak += 1
+            if self._fail_streak < self.breaker_threshold:
+                return False
+            # streak is NOT reset: after the cooldown the next single
+            # probe failure lands here again and re-opens immediately
+            self._open_until = time.monotonic() + self.breaker_cooldown
+            metrics.incr_counter("rpc_breaker_open_total")
+            return True
+
+    def _note_success(self) -> None:
+        with self._breaker_lock:
+            self._fail_streak = 0
+            self._open_until = 0.0
+
+    def _with_retry(self, site: str, path: str, attempt_fn):
+        from celestia_tpu.telemetry import metrics
+
+        with self._breaker_lock:
+            remaining = self._open_until - time.monotonic()
+            if remaining > 0:
+                raise CircuitOpenError(
+                    f"{self.base_url}: circuit open for another "
+                    f"{remaining:.2f}s ({site} {path})"
+                )
+        last = None
+        attempt = 0
+        for attempt in range(self.retries + 1):
+            try:
+                out = attempt_fn()
+            except TransportError:
+                raise  # already typed (4xx, nested breaker) — no retry
+            except _RETRYABLE as e:
+                last = e
+                opened = self._note_failure()
+                if attempt >= self.retries or opened:
+                    break
+                metrics.incr_counter("rpc_retry_total", site=site)
+                delay = min(self.backoff_max,
+                            self.backoff_base * (2 ** attempt))
+                time.sleep(random.uniform(0.0, delay))  # full jitter
+                continue
+            self._note_success()
+            return out
+        raise TransportError(
+            f"{site} {self.base_url}{path} failed after {attempt + 1} "
+            f"attempts: {last!r}"
+        ) from last
 
     def _get(self, path: str):
+        out = self._with_retry("rpc.get", path, lambda: self._once_get(path))
+        return None if out is _NOT_FOUND else out
+
+    def _once_get(self, path: str):
+        corrupt = faults.fire("rpc.get", url=self.base_url + path)
         try:
             with urllib.request.urlopen(
                 self.base_url + path, timeout=self.timeout
             ) as resp:
-                return json.loads(resp.read())
+                raw = resp.read()
         except urllib.error.HTTPError as e:
             if e.code == 404:
-                return None
-            raise
+                return _NOT_FOUND
+            if e.code >= 500:
+                # a 5xx is a server hiccup — retryable like a dropped
+                # connection
+                raise faults.TransportFault(f"HTTP {e.code}") from e
+            raise TransportError(
+                f"GET {self.base_url}{path}: HTTP {e.code}"
+            ) from e
+        if corrupt is not None:
+            raw = corrupt(raw)
+        return json.loads(raw)
 
     def _post(self, path: str, body: dict):
+        return self._with_retry(
+            "rpc.post", path, lambda: self._once_post(path, body)
+        )
+
+    def _once_post(self, path: str, body: dict):
+        corrupt = faults.fire("rpc.post", url=self.base_url + path)
         req = urllib.request.Request(
             self.base_url + path,
             data=json.dumps(body).encode(),
@@ -50,15 +176,20 @@ class RpcClient:
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return json.loads(resp.read())
+                raw = resp.read()
         except urllib.error.HTTPError as e:
             # the server wraps handler exceptions as {"error": ...} with a
             # 5xx status; surface that as a result the caller can inspect,
-            # like the in-process transport's caught ValueError
+            # like the in-process transport's caught ValueError. A reply
+            # (any status) means the server PROCESSED the request — never
+            # retried, so a non-idempotent POST cannot double-apply here.
             try:
                 return json.loads(e.read())
             except ValueError:
                 return {"error": f"HTTP {e.code}"}
+        if corrupt is not None:
+            raw = corrupt(raw)
+        return json.loads(raw)
 
     # --- the Signer transport surface ---
 
@@ -90,7 +221,9 @@ class RpcClient:
         return self._get(f"/block/{height}")
 
     def balance(self, address: str, denom: str = "utia") -> int:
-        return self._get(f"/balance/{address}/{denom}")["balance"]
+        # an unknown account is a 404 (None), not an error: balance 0
+        res = self._get(f"/balance/{address}/{denom}")
+        return 0 if res is None else int(res.get("balance", 0))
 
     def params(self, module: str):
         return self._get(f"/params/{module}")
@@ -196,8 +329,18 @@ class FraudAwareLightClient:
     against the header's own data_hash before it is believed, so a
     malicious watchtower cannot frame an honest chain."""
 
-    def __init__(self, primary: RpcClient, watchtowers: list[RpcClient]):
-        self.primary = primary
+    def __init__(self, primary, watchtowers: list[RpcClient]):
+        # `primary` is one RpcClient or an ordered failover list: the
+        # client sticks with the current primary until its transport
+        # fails (breaker open / retries exhausted), then advances to the
+        # next and stays there — every primary serves the same chain, so
+        # verification is unaffected by which one answered.
+        prims = list(primary) if isinstance(primary, (list, tuple)) \
+            else [primary]
+        if not prims:
+            raise ValueError("need at least one primary")
+        self.primaries: list[RpcClient] = prims
+        self._primary_idx = 0
         self.watchtowers = list(watchtowers)
         self.headers: dict[int, dict] = {}
         # wires already screened as harmless for a given header
@@ -206,8 +349,30 @@ class FraudAwareLightClient:
         # proofs. The data_hash MUST be part of the key — a proof
         # dismissed as "wrong DAH" under header X may be exactly the
         # proof that condemns a DIFFERENT header Y the primary serves
-        # at that height after a reorg/equivocation.
-        self._screened: set[tuple[int, str, str]] = set()
+        # at that height after a reorg/equivocation. Insertion-ordered
+        # (dict) so the eviction policy can drop the OLDEST entries.
+        self._screened: dict[tuple[int, str, str], None] = {}
+
+    @property
+    def primary(self) -> RpcClient:
+        return self.primaries[self._primary_idx]
+
+    def _with_primary(self, fn):
+        """Run `fn(client)` against the current primary; on a transport
+        failure (typed — breaker open or retries exhausted) advance to
+        the next primary and retry, once around the ring."""
+        last = None
+        n = len(self.primaries)
+        for i in range(n):
+            idx = (self._primary_idx + i) % n
+            try:
+                out = fn(self.primaries[idx])
+            except TransportError as e:
+                last = e
+                continue
+            self._primary_idx = idx  # sticky: keep the one that answered
+            return out
+        raise last
 
     def accept_header(self, height: int) -> dict | None:
         """Fetch + screen one header. Returns the header dict, None when
@@ -219,7 +384,7 @@ class FraudAwareLightClient:
         the header was already screened clean. Call rescreen()
         periodically — it re-checks every accepted header and evicts
         (raising) on late-arriving proofs."""
-        hdr = self.primary.header(height)
+        hdr = self._with_primary(lambda c: c.header(height))
         if hdr is None:
             return None
         self._screen(height, hdr)
@@ -257,8 +422,15 @@ class FraudAwareLightClient:
 
     def _memo(self, key) -> None:
         if len(self._screened) >= self.MAX_SCREENED_MEMO:
-            self._screened.clear()
-        self._screened.add(key)
+            # evict the oldest half, not everything: a full clear forced
+            # re-verification of EVERY known-harmless proof at once —
+            # exactly the amplification a junk-flooding watchtower wants.
+            # Old entries are the ones most likely to belong to long-
+            # pruned headers anyway.
+            drop = max(1, len(self._screened) // 2)
+            for k in list(self._screened)[:drop]:
+                del self._screened[k]
+        self._screened[key] = None
 
     def sample_availability(self, height: int, n: int = 16,
                             rng=None) -> dict:
@@ -294,7 +466,7 @@ class FraudAwareLightClient:
         if hdr is None:
             raise ValueError(f"header {height} not accepted yet")
         try:
-            dah_json = self.primary.dah(height)
+            dah_json = self._with_primary(lambda c: c.dah(height))
         except Exception as e:  # noqa: BLE001 — stonewalling = unavailable
             raise Unavailable(
                 f"height {height}: DAH fetch failed: {e}"
@@ -319,7 +491,9 @@ class FraudAwareLightClient:
         for _ in range(n):
             i, j = rng.randrange(w), rng.randrange(w)
             try:
-                res = self.primary.sample(height, i, j)
+                res = self._with_primary(
+                    lambda c, i=i, j=j: c.sample(height, i, j)
+                )
                 share = bytes.fromhex(res["share"])
                 p = res["proof"]
                 proof = NmtRangeProof(
@@ -351,6 +525,7 @@ class FraudAwareLightClient:
             # hex) means "this tower has no usable proof", never a
             # crash — only a VERIFIED proof may affect the client
             try:
+                faults.fire("watchtower.befp", url=tower.base_url)
                 res = tower.befp(height)
                 wires = list((res or {}).get("proofs", []))
             except Exception:  # noqa: BLE001 — a broken watchtower is no proof

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from celestia_tpu import faults
 from celestia_tpu import namespace as ns
 from celestia_tpu.appconsts import (
     CONTINUATION_SPARSE_SHARE_CONTENT_SIZE as CONT_SPARSE,
@@ -201,6 +202,7 @@ def _jitted_roots_for_k(k: int):
 def extend_roots_device(shares: np.ndarray):
     """Host deployment entry: (k,k,512) uint8 -> numpy (eds, row_roots,
     col_roots); the caller computes the DAH hash host-side (da module)."""
+    faults.fire("device.extend", entry="extend_roots_device")
     k = shares.shape[0]
     eds, rows, cols = _jitted_roots_for_k(k)(jnp.asarray(shares))
     return np.asarray(eds), np.asarray(rows), np.asarray(cols)
@@ -215,6 +217,7 @@ def extend_roots_device_resident(shares: np.ndarray):
     block store actually serves shares; the repair path consumes the
     handle directly (ops/repair_tpu.stage_resident_repair) with no
     host round-trip. ref: app/extend_block.go:14."""
+    faults.fire("device.extend", entry="extend_roots_device_resident")
     k = int(shares.shape[0])
     eds, rows, cols = _jitted_roots_for_k(k)(jnp.asarray(shares))
     return eds, np.asarray(rows), np.asarray(cols)
@@ -500,6 +503,7 @@ def _jitted_roots_noeds(k: int):
 def roots_device(shares: np.ndarray):
     """Host entry: (k,k,512) uint8 -> numpy (row_roots, col_roots),
     jit-cached, EDS never materialized as an output."""
+    faults.fire("device.extend", entry="roots_device")
     k = int(shares.shape[0])
     rows, cols = _jitted_roots_noeds(k)(jnp.asarray(shares))
     return np.asarray(rows), np.asarray(cols)
@@ -534,6 +538,7 @@ def batched_roots_device(shares):
 
 def extend_and_root_device(shares: np.ndarray):
     """Host entry: (k,k,512) uint8 numpy -> numpy (eds, row_roots, col_roots, dah)."""
+    faults.fire("device.extend", entry="extend_and_root_device")
     k = shares.shape[0]
     fn = _jitted_for_k(k)
     eds, rows, cols, dah = fn(jnp.asarray(shares))

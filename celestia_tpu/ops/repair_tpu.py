@@ -31,6 +31,7 @@ import functools
 
 import numpy as np
 
+from celestia_tpu import faults
 from celestia_tpu.ops import gf256
 from celestia_tpu.ops.rs_tpu import expand_bit_matrix, pack_bits, unpack_bits
 
@@ -307,6 +308,7 @@ def repair_resident_verified(
     the DAH roots host-side (2·2k·90 bytes fetched, not (2k)²·512).
     Returns the repaired square as a DEVICE buffer; fetching bytes is
     the caller's lazy decision. Raises ValueError on root mismatch."""
+    faults.fire("device.repair", entry="repair_resident_verified")
     from celestia_tpu.ops import extend_tpu
 
     run, _ = stage_resident_repair(eds, present, device)
@@ -330,6 +332,7 @@ def repair_tpu(
     is fetched once at the end. Bit-exact vs da.repair (tests pin all
     three implementations together).
     """
+    faults.fire("device.repair", entry="repair_tpu")
     import jax
 
     run, _ = stage_resident_repair(eds, present, device)

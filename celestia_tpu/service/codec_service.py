@@ -19,10 +19,13 @@ from __future__ import annotations
 
 import concurrent.futures
 import logging
+import random
+import time
 
 import grpc
 import numpy as np
 
+from celestia_tpu import faults
 from celestia_tpu.appconsts import SHARE_SIZE
 from celestia_tpu.service import wire
 
@@ -32,12 +35,54 @@ log = logging.getLogger("celestia_tpu.codec_service")
 
 
 class CodecBackend:
-    """Dispatches to the fastest available implementation."""
+    """Dispatches to the fastest available implementation, degrading
+    gracefully: a TPU-path failure falls back to the host path for that
+    request (byte-identical DAH by construction — both paths are pinned
+    against each other), and `tpu_strike_limit` CONSECUTIVE failures
+    flip `use_tpu` off so a flaky device serves correct-but-slower
+    instead of erroring on every call. Fallbacks and the flip are
+    counted in telemetry.metrics (codec_tpu_fallback_total,
+    codec_tpu_disabled_total)."""
 
-    def __init__(self, use_tpu: bool | None = None):
+    def __init__(self, use_tpu: bool | None = None,
+                 tpu_strike_limit: int = 3):
         if use_tpu is None:
             use_tpu = self._tpu_available()
         self.use_tpu = use_tpu
+        self.tpu_strike_limit = tpu_strike_limit
+        self._tpu_strikes = 0
+
+    def _tpu(self, op: str, fn, fallback):
+        """Run the TPU path; on any runtime failure count a strike,
+        serve the request from the host path, and after the strike
+        limit degrade stickily to host-only."""
+        from celestia_tpu.telemetry import metrics
+
+        try:
+            out = fn()
+        except Exception as e:  # noqa: BLE001 — any device failure degrades
+            from celestia_tpu.da.repair import UnrepairableError
+
+            if isinstance(e, (ValueError, UnrepairableError)):
+                # a data/shape condition, not a device fault: the host
+                # path would reject it identically — no strike, no retry
+                raise
+            self._tpu_strikes += 1
+            metrics.incr_counter("codec_tpu_fallback_total", op=op)
+            log.warning(
+                "TPU %s failed (%s) — host fallback, strike %d/%d",
+                op, e, self._tpu_strikes, self.tpu_strike_limit,
+            )
+            if self._tpu_strikes >= self.tpu_strike_limit and self.use_tpu:
+                self.use_tpu = False
+                metrics.incr_counter("codec_tpu_disabled_total")
+                log.error(
+                    "TPU path disabled after %d consecutive failures — "
+                    "serving from the host backend", self._tpu_strikes,
+                )
+            return fallback()
+        self._tpu_strikes = 0  # only CONSECUTIVE failures degrade
+        return out
 
     @staticmethod
     def _tpu_available() -> bool:
@@ -61,29 +106,43 @@ class CodecBackend:
 
     def encode(self, k: int, share_size: int, shares: bytes) -> bytes:
         arr = self._to_array(shares, k, share_size)
-        if self.use_tpu and share_size == SHARE_SIZE:
-            from celestia_tpu.ops import extend_tpu
 
-            eds, _rows, _cols = extend_tpu.extend_roots_device(arr)
-            return eds.tobytes()
-        from celestia_tpu import da
-
-        eds = da.extend_shares(arr.reshape(k * k, share_size))
-        return np.asarray(eds.data, dtype=np.uint8).tobytes()
-
-    def extend_and_root(self, k: int, share_size: int, shares: bytes):
-        arr = self._to_array(shares, k, share_size)
-        if self.use_tpu and share_size == SHARE_SIZE:
-            from celestia_tpu.ops import extend_tpu
-
-            _eds, rows, cols = extend_tpu.extend_roots_device(arr)
-            row_roots = [r.tobytes() for r in rows]
-            col_roots = [c.tobytes() for c in cols]
-        else:
+        def host() -> bytes:
             from celestia_tpu import da
 
             eds = da.extend_shares(arr.reshape(k * k, share_size))
-            row_roots, col_roots = eds.row_roots(), eds.col_roots()
+            return np.asarray(eds.data, dtype=np.uint8).tobytes()
+
+        if self.use_tpu and share_size == SHARE_SIZE:
+            def device() -> bytes:
+                from celestia_tpu.ops import extend_tpu
+
+                eds, _rows, _cols = extend_tpu.extend_roots_device(arr)
+                return eds.tobytes()
+
+            return self._tpu("encode", device, host)
+        return host()
+
+    def extend_and_root(self, k: int, share_size: int, shares: bytes):
+        arr = self._to_array(shares, k, share_size)
+
+        def host():
+            from celestia_tpu import da
+
+            eds = da.extend_shares(arr.reshape(k * k, share_size))
+            return eds.row_roots(), eds.col_roots()
+
+        if self.use_tpu and share_size == SHARE_SIZE:
+            def device():
+                from celestia_tpu.ops import extend_tpu
+
+                _eds, rows, cols = extend_tpu.extend_roots_device(arr)
+                return ([r.tobytes() for r in rows],
+                        [c.tobytes() for c in cols])
+
+            row_roots, col_roots = self._tpu("extend_and_root", device, host)
+        else:
+            row_roots, col_roots = host()
         from celestia_tpu.ops.nmt_host import merkle_root
 
         dah = merkle_root(row_roots + col_roots)
@@ -102,24 +161,36 @@ class CodecBackend:
                present: bytes) -> bytes:
         arr = self._to_array(eds_bytes, 2 * k, share_size)
         mask = np.frombuffer(present, dtype=np.uint8).reshape(2 * k, 2 * k) != 0
+
+        def host() -> bytes:
+            from celestia_tpu.da.repair import repair
+
+            return repair(arr, mask).tobytes()
+
         if self.use_tpu and share_size == SHARE_SIZE:
             # same backend ordering as encode: the accelerated
             # host-planned/device-swept decode (bench config 4), byte-
             # exact vs the host path (tests pin all implementations)
-            from celestia_tpu.ops.repair_tpu import repair_tpu
+            def device() -> bytes:
+                from celestia_tpu.ops.repair_tpu import repair_tpu
 
-            return repair_tpu(arr, mask).tobytes()
-        from celestia_tpu.da.repair import repair
+                return repair_tpu(arr, mask).tobytes()
 
-        return repair(arr, mask).tobytes()
+            return self._tpu("repair", device, host)
+        return host()
 
 
 def _handler(fn, req_cls, resp_marshal):
     def handle(request_bytes, context):
         try:
+            faults.fire("codec.backend")
             return resp_marshal(fn(req_cls.unmarshal(request_bytes)))
         except ValueError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except (faults.DeviceUnavailable, faults.TransportFault) as e:
+            # transient backend loss maps to UNAVAILABLE — the status a
+            # well-behaved client retries (CodecClient._call does)
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         except Exception as e:  # noqa: BLE001 — surfaced as INTERNAL
             log.exception("codec RPC failed")
             context.abort(grpc.StatusCode.INTERNAL, str(e))
@@ -183,22 +254,53 @@ class CodecServer:
 
 class CodecClient:
     """Python client over the same hand-rolled codecs (a Go client uses
-    protoc-generated stubs from tpu_codec.proto instead)."""
+    protoc-generated stubs from tpu_codec.proto instead).
 
-    def __init__(self, target: str):
+    Every call carries a deadline (`timeout`, seconds) — a hung server
+    yields DEADLINE_EXCEEDED instead of blocking forever — and
+    UNAVAILABLE / DEADLINE_EXCEEDED statuses are retried `retries`
+    times with exponential backoff + full jitter before the RpcError
+    propagates."""
+
+    _RETRY_CODES = (grpc.StatusCode.UNAVAILABLE,
+                    grpc.StatusCode.DEADLINE_EXCEEDED)
+
+    def __init__(self, target: str, timeout: float = 5.0,
+                 retries: int = 2, backoff_base: float = 0.05):
         opts = [
             ("grpc.max_receive_message_length", 64 * 1024 * 1024),
             ("grpc.max_send_message_length", 64 * 1024 * 1024),
         ]
         self.channel = grpc.insecure_channel(target, options=opts)
+        self.timeout = timeout
+        self.retries = retries
+        self.backoff_base = backoff_base
 
     def _call(self, method: str, request_bytes: bytes) -> bytes:
+        from celestia_tpu.telemetry import metrics
+
         fn = self.channel.unary_unary(
             f"/{SERVICE_NAME}/{method}",
             request_serializer=lambda b: b,
             response_deserializer=lambda b: b,
         )
-        return fn(request_bytes)
+        last = None
+        for attempt in range(self.retries + 1):
+            try:
+                corrupt = faults.fire("codec.call", method=method)
+                out = fn(request_bytes, timeout=self.timeout)
+                return corrupt(out) if corrupt is not None else out
+            except faults.TransportFault as e:
+                last, code = e, grpc.StatusCode.UNAVAILABLE
+            except grpc.RpcError as e:
+                last, code = e, e.code()
+            if code not in self._RETRY_CODES or attempt >= self.retries:
+                raise last
+            metrics.incr_counter("codec_call_retry_total", method=method)
+            time.sleep(random.uniform(
+                0.0, self.backoff_base * (2 ** attempt)
+            ))
+        raise last  # pragma: no cover — loop always returns or raises
 
     def encode(self, shares: np.ndarray) -> np.ndarray:
         k, _, share_size = shares.shape

@@ -24,6 +24,11 @@ class Registry:
         with self._lock:
             self.counters[_key(name, labels)] += value
 
+    def get_counter(self, name: str, **labels) -> float:
+        """Read a counter (0.0 if never incremented) — test/assert helper."""
+        with self._lock:
+            return self.counters.get(_key(name, labels), 0.0)
+
     def set_gauge(self, name: str, value: float, **labels) -> None:
         with self._lock:
             self.gauges[_key(name, labels)] = value

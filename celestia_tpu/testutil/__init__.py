@@ -1,17 +1,21 @@
 """Test harnesses: single-process devnet, malicious apps, multi-validator
 network simulation (reference: test/util/testnode, test/util/malicious,
-test/e2e)."""
+test/e2e).
 
-from celestia_tpu.app import App
-from celestia_tpu.crypto import PrivateKey
-from celestia_tpu.node import Node
+Imports stay inside the helpers: submodules like testutil.chaosnet are
+DA/transport-only and must be importable in environments where the app
+stack's crypto dependency is absent.
+"""
 
 
 def testnode(accounts: dict[str, int] | None = None, home: str | None = None,
-             **app_kwargs) -> Node:
+             **app_kwargs):
     """Boot a single-validator in-process chain with the first (empty)
     block committed — the testnode.NewNetwork analogue
     (test/util/testnode/full_node.go:70)."""
+    from celestia_tpu.app import App
+    from celestia_tpu.node import Node
+
     app = App(**app_kwargs)
     app.init_chain(accounts or {}, genesis_time=0.0)
     node = Node(app, home=home)
@@ -21,5 +25,7 @@ def testnode(accounts: dict[str, int] | None = None, home: str | None = None,
 
 def funded_keys(n: int, amount: int = 10_000_000_000):
     """n deterministic keys + the genesis account map funding them."""
+    from celestia_tpu.crypto import PrivateKey
+
     keys = [PrivateKey.from_secret(f"testnode-{i}".encode()) for i in range(n)]
     return keys, {k.bech32_address(): amount for k in keys}

@@ -1,0 +1,217 @@
+"""Crypto-free in-process DA node for chaos/resilience tests.
+
+The full devnet (testutil.network) exercises consensus + the app state
+machine, which drags in the signing stack. Chaos tests target the layer
+BELOW that: the transport (RpcClient retry/breaker), the light-client
+failover, and the DA query surface. ChaosNode serves real DA artifacts
+— a deterministic chain of extended squares with genuine NMT roots and
+inclusion proofs, byte-compatible with node/rpc.py's route shapes — from
+nothing but the da/proof modules, so the whole harness runs in a
+stripped environment with no crypto dependency.
+
+Extra chaos controls a real node doesn't have:
+
+    node.fail_next(n)       next n requests answer HTTP 500 (exercises
+                            the client's real 5xx retry path, not just
+                            injected faults)
+    node.fraud_wires[h]     raw wires served from /fraud/befp/<h>
+                            (junk by default tests watchtower hygiene)
+    node.balances[(a, d)]   balances served from /balance/<a>/<d>
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+
+from celestia_tpu import da
+
+
+def chain_shares(k: int, height: int, seed: int = 7) -> list[bytes]:
+    """k*k deterministic 512-byte shares for one height (seed-stable)."""
+    ns = bytes([7] * da.NAMESPACE_SIZE)
+    shares = []
+    for i in range(k * k):
+        body = bytes(
+            (seed * 131 + height * 17 + i * 7 + j) % 256
+            for j in range(da.SHARE_SIZE - da.NAMESPACE_SIZE)
+        )
+        shares.append(ns + body)
+    return shares
+
+
+class ChaosNode:
+    """A block store + query surface; no mempool, no consensus."""
+
+    def __init__(self, heights: int = 2, k: int = 2, seed: int = 7,
+                 chain_id: str = "chaos-net"):
+        self.chain_id = chain_id
+        self.blocks: dict[int, tuple] = {}  # height -> (eds, dah)
+        for h in range(1, heights + 1):
+            eds = da.extend_shares(chain_shares(k, h, seed))
+            self.blocks[h] = (eds, da.new_data_availability_header(eds))
+        self.balances: dict[tuple[str, str], int] = {}
+        self.fraud_wires: dict[int, list] = {}
+        self.broadcasts: list[str] = []
+        self._fail_next = 0
+        self._lock = threading.Lock()
+
+    def latest_height(self) -> int:
+        return max(self.blocks, default=0)
+
+    def dah(self, height: int):
+        entry = self.blocks.get(height)
+        return entry[1] if entry else None
+
+    def fail_next(self, n: int) -> None:
+        """Make the server answer HTTP 500 for the next n requests."""
+        with self._lock:
+            self._fail_next = n
+
+    def _consume_failure(self) -> bool:
+        with self._lock:
+            if self._fail_next > 0:
+                self._fail_next -= 1
+                return True
+            return False
+
+
+def _handler_for(node: ChaosNode):
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _reply(self, payload: dict, status: int = 200) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if node._consume_failure():
+                self._reply({"error": "injected server failure"}, 500)
+                return
+            parts = [p for p in self.path.split("/") if p]
+            try:
+                if parts == ["status"]:
+                    self._reply(
+                        {
+                            "chain_id": node.chain_id,
+                            "height": node.latest_height(),
+                        }
+                    )
+                elif len(parts) == 2 and parts[0] == "header":
+                    entry = node.blocks.get(int(parts[1]))
+                    if entry is None:
+                        self._reply({"error": "block not found"}, 404)
+                    else:
+                        eds, dah = entry
+                        self._reply(
+                            {
+                                "height": int(parts[1]),
+                                "time": float(parts[1]),
+                                "square_size": eds.original_width,
+                                "data_hash": dah.hash().hex(),
+                                "app_hash": bytes(32).hex(),
+                            }
+                        )
+                elif len(parts) == 2 and parts[0] == "dah":
+                    entry = node.blocks.get(int(parts[1]))
+                    if entry is None:
+                        self._reply({"error": "block not found"}, 404)
+                    else:
+                        self._reply(entry[1].to_json())
+                elif len(parts) == 4 and parts[0] == "sample":
+                    h, i, j = int(parts[1]), int(parts[2]), int(parts[3])
+                    entry = node.blocks.get(h)
+                    if entry is None:
+                        self._reply({"error": "block not found"}, 404)
+                        return
+                    eds = entry[0]
+                    w = eds.width
+                    if not (0 <= i < w and 0 <= j < w):
+                        self._reply({"error": "coordinate out of range"}, 400)
+                        return
+                    from celestia_tpu.proof import nmt_prove_range
+
+                    row_cells = eds.row(i)
+                    leaves = da.erasured_axis_leaves(
+                        row_cells, i, eds.original_width
+                    )
+                    proof = nmt_prove_range(leaves, j, j + 1)
+                    self._reply(
+                        {
+                            "share": row_cells[j].hex(),
+                            "proof": {
+                                "start": proof.start,
+                                "end": proof.end,
+                                "nodes": [n.hex() for n in proof.nodes],
+                                "tree_size": proof.tree_size,
+                            },
+                        }
+                    )
+                elif len(parts) == 3 and parts[0] == "fraud" \
+                        and parts[1] == "befp":
+                    h = int(parts[2])
+                    wires = node.fraud_wires.get(h)
+                    if not wires:
+                        self._reply({"error": "no fraud proof at height"}, 404)
+                    else:
+                        self._reply({"height": h, "proofs": wires})
+                elif len(parts) == 3 and parts[0] == "balance":
+                    bal = node.balances.get((parts[1], parts[2]))
+                    if bal is None:
+                        self._reply({"error": "unknown account"}, 404)
+                    else:
+                        self._reply({"balance": bal})
+                elif len(parts) == 2 and parts[0] == "account":
+                    self._reply({"error": "account not found"}, 404)
+                else:
+                    self._reply({"error": "unknown route"}, 404)
+            except Exception as e:  # noqa: BLE001
+                self._reply({"error": str(e)}, 500)
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if node._consume_failure():
+                self._reply({"error": "injected server failure"}, 500)
+                return
+            parts = [p for p in self.path.split("/") if p]
+            if parts == ["broadcast_tx"]:
+                node.broadcasts.append(body.get("tx", ""))
+                self._reply({"code": 0, "log": "", "priority": 0})
+            else:
+                self._reply({"error": "unknown route"}, 404)
+
+    return Handler
+
+
+class ChaosServer:
+    """ThreadingHTTPServer over a ChaosNode; port 0 = ephemeral."""
+
+    def __init__(self, node: ChaosNode, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.node = node
+        self.server = http.server.ThreadingHTTPServer(
+            (host, port), _handler_for(node)
+        )
+        self.port = self.server.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "ChaosServer":
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()

@@ -1,0 +1,519 @@
+"""End-to-end chaos suite: deterministic fault injection across the
+transport, light-client, and codec-service boundaries.
+
+Everything here runs crypto-free against testutil.chaosnet (real HTTP,
+real DA artifacts) and the gRPC codec service — the layers whose
+resilience the fault harness (celestia_tpu/faults.py) targets:
+
+  * same seed -> same fault schedule (the determinism contract)
+  * RpcClient: retry/backoff, typed TransportError (urllib never
+    leaks), circuit breaker open/half-open/re-open
+  * FraudAwareLightClient: primary failover, watchtower fault hygiene,
+    screened-memo eviction bound
+  * CodecBackend: TPU->host graceful degradation with byte-identical
+    DAH, strike counting, sticky use_tpu flip (the acceptance pin)
+  * CodecClient: per-call deadline (DEADLINE_EXCEEDED, never a hang),
+    UNAVAILABLE retry through a faulted server
+
+The full-devnet case (consensus under transport faults) needs the
+signing stack and is marked slow + skipped where cryptography is
+absent.
+"""
+
+import os
+import random
+import socket
+import urllib.error
+
+import numpy as np
+import pytest
+
+from celestia_tpu import da, faults
+from celestia_tpu.node.client import (
+    CircuitOpenError,
+    FraudAwareLightClient,
+    RpcClient,
+    TransportError,
+)
+from celestia_tpu.telemetry import metrics
+from celestia_tpu.testutil.chaosnet import ChaosNode, ChaosServer, chain_shares
+
+CHAOS_SEED = int(os.environ.get("CELESTIA_CHAOS_SEED", "1337"))
+
+
+@pytest.fixture(scope="module")
+def net():
+    """One chain, two HTTP frontends (for failover tests)."""
+    node = ChaosNode(heights=2, k=2, seed=CHAOS_SEED)
+    servers = [ChaosServer(node).start() for _ in range(2)]
+    try:
+        yield node, servers
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def fast_client(url: str, **kw) -> RpcClient:
+    kw.setdefault("timeout", 5.0)
+    kw.setdefault("retries", 3)
+    kw.setdefault("backoff_base", 0.001)
+    kw.setdefault("backoff_max", 0.01)
+    return RpcClient(url, **kw)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestDeterminism:
+    def _run_once(self, url: str, seed: int):
+        client = fast_client(url)
+        with faults.inject(
+            faults.rule("rpc.get", "error", probability=0.4),
+            faults.rule("rpc.get", "delay", probability=0.2, delay_s=0.0),
+            seed=seed,
+        ) as inj:
+            for _ in range(10):
+                try:
+                    client.status()
+                except TransportError:
+                    pass
+            return list(inj.schedule)
+
+    def test_same_seed_same_schedule(self, net):
+        _, servers = net
+        one = self._run_once(servers[0].url, CHAOS_SEED)
+        two = self._run_once(servers[0].url, CHAOS_SEED)
+        assert one, "chaos run struck no faults — rules never fired"
+        assert one == two
+
+    def test_different_seed_different_schedule(self, net):
+        _, servers = net
+        one = self._run_once(servers[0].url, CHAOS_SEED)
+        other = self._run_once(servers[0].url, CHAOS_SEED + 1)
+        assert one != other
+
+    def test_injection_is_scoped(self, net):
+        _, servers = net
+        with faults.inject(faults.rule("rpc.get", "error"), seed=0):
+            with pytest.raises(TransportError):
+                fast_client(servers[0].url, retries=0).status()
+        assert faults.active() is None
+        assert fast_client(servers[0].url).status()["chain_id"] == "chaos-net"
+
+
+class TestRpcResilience:
+    def test_transient_error_retried_to_success(self, net):
+        _, servers = net
+        client = fast_client(servers[0].url)
+        before = metrics.get_counter("rpc_retry_total", site="rpc.get")
+        with faults.inject(
+            faults.rule("rpc.get", "error", times=2), seed=CHAOS_SEED
+        ):
+            assert client.status()["chain_id"] == "chaos-net"
+        assert metrics.get_counter(
+            "rpc_retry_total", site="rpc.get"
+        ) == before + 2
+
+    def test_transient_reset_retried(self, net):
+        _, servers = net
+        client = fast_client(servers[0].url)
+        with faults.inject(
+            faults.rule("rpc.get", "reset", times=1), seed=CHAOS_SEED
+        ):
+            assert client.status()["height"] == 2
+
+    def test_corrupted_payload_retried(self, net):
+        # a flipped response byte must read as a damaged wire (retry),
+        # never a crash or a silently wrong decode of valid-looking JSON
+        _, servers = net
+        client = fast_client(servers[0].url)
+        with faults.inject(
+            faults.rule("rpc.get", "corrupt", times=1), seed=CHAOS_SEED
+        ) as inj:
+            assert client.status()["chain_id"] == "chaos-net"
+        assert [kind for _, _, kind in inj.schedule] == ["corrupt"]
+
+    def test_http_500_retried(self, net):
+        node, servers = net
+        client = fast_client(servers[0].url)
+        node.fail_next(2)
+        assert client.status()["chain_id"] == "chaos-net"
+
+    def test_persistent_failure_is_typed(self, net):
+        _, servers = net
+        client = fast_client(servers[0].url, retries=2)
+        with faults.inject(faults.rule("rpc.get", "error"), seed=CHAOS_SEED):
+            with pytest.raises(TransportError) as exc:
+                client.status()
+        # the whole point: raw urllib/socket errors never escape
+        assert not isinstance(exc.value, urllib.error.URLError)
+        assert "rpc.get" in str(exc.value)
+
+    def test_connection_refused_is_typed(self):
+        client = fast_client(f"http://127.0.0.1:{free_port()}", retries=1)
+        with pytest.raises(TransportError) as exc:
+            client.status()
+        assert not isinstance(exc.value, urllib.error.URLError)
+
+    def test_breaker_opens_and_fast_fails(self, net):
+        _, servers = net
+        client = fast_client(
+            servers[0].url, retries=5,
+            breaker_threshold=2, breaker_cooldown=30.0,
+        )
+        with faults.inject(
+            faults.rule("rpc.get", "error"), seed=CHAOS_SEED
+        ) as inj:
+            with pytest.raises(TransportError):
+                client.status()
+            # opening consumed exactly `threshold` attempts, not retries+1
+            assert len(inj.schedule) == 2
+            # while open: fast-fail with NO network attempt (schedule
+            # does not grow)
+            with pytest.raises(CircuitOpenError):
+                client.status()
+            assert len(inj.schedule) == 2
+
+    def test_breaker_half_open_probe(self, net):
+        _, servers = net
+        client = fast_client(
+            servers[0].url, retries=0,
+            breaker_threshold=1, breaker_cooldown=0.05,
+        )
+        import time as _time
+
+        with faults.inject(
+            faults.rule("rpc.get", "error", times=2), seed=CHAOS_SEED
+        ):
+            with pytest.raises(TransportError):
+                client.status()
+            _time.sleep(0.06)
+            # half-open probe hits the second injected fault: the still-
+            # standing streak re-opens the breaker on ONE failure
+            with pytest.raises(TransportError):
+                client.status()
+            with pytest.raises(CircuitOpenError):
+                client.status()
+            _time.sleep(0.06)
+            # probe after the faults are exhausted: success closes it
+            assert client.status()["chain_id"] == "chaos-net"
+        assert client.status()["height"] == 2
+
+    def test_balance_unknown_account_is_zero(self, net):
+        # regression: a 404 used to come back as None and TypeError at
+        # the caller; "no account" means balance 0
+        node, servers = net
+        client = fast_client(servers[0].url)
+        assert client.balance("nobody-home") == 0
+        node.balances[("alice", "utia")] = 42
+        assert client.balance("alice") == 42
+
+
+class TestLightClientChaos:
+    def test_failover_past_faulted_primary(self, net):
+        _, servers = net
+        a = fast_client(servers[0].url, retries=0)
+        b = fast_client(servers[1].url, retries=0)
+        lc = FraudAwareLightClient([a, b], watchtowers=[])
+        with faults.inject(
+            faults.rule("rpc.get", "error", where=f":{servers[0].port}"),
+            seed=CHAOS_SEED,
+        ) as inj:
+            hdr = lc.accept_header(1)
+            assert hdr is not None
+            # sticky on the primary that answered
+            assert lc.primary is b
+            out = lc.sample_availability(1, n=8, rng=random.Random(0))
+            assert out["sampled"] == 8
+        assert inj.schedule, "the faulted primary was never even tried"
+
+    def test_all_primaries_down_is_typed(self, net):
+        _, servers = net
+        a = fast_client(servers[0].url, retries=0)
+        b = fast_client(servers[1].url, retries=0)
+        lc = FraudAwareLightClient([a, b], watchtowers=[])
+        with faults.inject(faults.rule("rpc.get", "error"), seed=CHAOS_SEED):
+            with pytest.raises(TransportError):
+                lc.accept_header(1)
+
+    def test_watchtower_fault_absorbed(self, net):
+        node, servers = net
+        primary = fast_client(servers[0].url)
+        tower = fast_client(servers[1].url, retries=0)
+        node.fraud_wires[1] = [
+            {"garbage": 1}, None, {"dah": "nothex", "proof": {}},
+        ]
+        try:
+            lc = FraudAwareLightClient(primary, watchtowers=[tower])
+            with faults.inject(
+                faults.rule("watchtower.befp", "error", times=1),
+                seed=CHAOS_SEED,
+            ):
+                assert lc.accept_header(1) is not None
+            # towers answered junk on the rescreen pass: still no crash,
+            # still no false fraud verdict
+            lc.rescreen()
+            assert 1 in lc.headers
+        finally:
+            node.fraud_wires.clear()
+
+    def test_screened_memo_eviction_bound(self, net):
+        _, servers = net
+        lc = FraudAwareLightClient(fast_client(servers[0].url), [])
+        lc.MAX_SCREENED_MEMO = 8
+        for i in range(20):
+            lc._memo((i, "hash", f"wire-{i}"))
+        # bounded, newest kept, oldest (not everything) evicted
+        assert len(lc._screened) <= 8
+        assert (19, "hash", "wire-19") in lc._screened
+        assert (0, "hash", "wire-0") not in lc._screened
+
+
+def chaos_shares_array(k: int = 2) -> np.ndarray:
+    return np.frombuffer(
+        b"".join(chain_shares(k, height=1, seed=CHAOS_SEED)), dtype=np.uint8
+    ).reshape(k, k, da.SHARE_SIZE)
+
+
+class TestCodecDegradation:
+    """The acceptance pin: forced device faults degrade to the host
+    path with a byte-identical DAH, and a strike streak flips the
+    backend to host-only."""
+
+    def _backends(self):
+        from celestia_tpu.service.codec_service import CodecBackend
+
+        return (
+            CodecBackend(use_tpu=True, tpu_strike_limit=3),
+            CodecBackend(use_tpu=False),
+        )
+
+    def test_extend_faults_degrade_byte_identical(self):
+        backend, reference = self._backends()
+        arr = chaos_shares_array()
+        raw = arr.tobytes()
+        ref_rows, ref_cols, ref_dah = reference.extend_and_root(
+            2, da.SHARE_SIZE, raw
+        )
+        fallback0 = metrics.get_counter(
+            "codec_tpu_fallback_total", op="extend_and_root"
+        )
+        disabled0 = metrics.get_counter("codec_tpu_disabled_total")
+        with faults.inject(
+            faults.rule("device.extend", "unavailable"), seed=CHAOS_SEED
+        ):
+            for call in range(4):
+                rows, cols, dah = backend.extend_and_root(
+                    2, da.SHARE_SIZE, raw
+                )
+                assert (rows, cols, dah) == (ref_rows, ref_cols, ref_dah)
+                # strikes 1..3 flip use_tpu off; call 4 is host-only
+                assert backend.use_tpu is (call < 2)
+        assert metrics.get_counter(
+            "codec_tpu_fallback_total", op="extend_and_root"
+        ) == fallback0 + 3
+        assert metrics.get_counter("codec_tpu_disabled_total") == disabled0 + 1
+
+    def test_repair_faults_degrade_byte_identical(self):
+        backend, reference = self._backends()
+        eds = da.extend_shares(chain_shares(2, height=1, seed=CHAOS_SEED))
+        eds_arr = np.asarray(eds.data, dtype=np.uint8)
+        present = np.ones((4, 4), dtype=np.uint8)
+        present[0, 0] = present[1, 2] = 0
+        damaged = np.where(present[..., None].astype(bool), eds_arr, 0)
+        want = reference.repair(
+            2, da.SHARE_SIZE, damaged.tobytes(), present.tobytes()
+        )
+        with faults.inject(
+            faults.rule("device.repair", "unavailable"), seed=CHAOS_SEED
+        ):
+            got = backend.repair(
+                2, da.SHARE_SIZE, damaged.tobytes(), present.tobytes()
+            )
+        assert got == want == eds_arr.tobytes()
+        assert backend._tpu_strikes == 1
+
+    def test_success_resets_strike_streak(self):
+        backend, _ = self._backends()
+        raw = chaos_shares_array().tobytes()
+        with faults.inject(
+            faults.rule("device.extend", "unavailable", times=2),
+            seed=CHAOS_SEED,
+        ):
+            backend.extend_and_root(2, da.SHARE_SIZE, raw)
+            backend.extend_and_root(2, da.SHARE_SIZE, raw)
+            assert backend._tpu_strikes == 2
+            # faults exhausted: the device path answers and the streak
+            # resets — only CONSECUTIVE failures may degrade
+            backend.extend_and_root(2, da.SHARE_SIZE, raw)
+        assert backend._tpu_strikes == 0
+        assert backend.use_tpu is True
+
+    def test_data_errors_are_not_device_strikes(self):
+        backend, _ = self._backends()
+        with pytest.raises(ValueError, match="share buffer"):
+            backend.extend_and_root(2, da.SHARE_SIZE, b"short")
+        assert backend._tpu_strikes == 0
+        assert backend.use_tpu is True
+
+
+class TestCodecServiceChaos:
+    @pytest.fixture()
+    def service(self):
+        grpc = pytest.importorskip("grpc")
+        from celestia_tpu.service.codec_service import CodecClient, CodecServer
+
+        server = CodecServer(port=0, use_tpu=False)
+        server.start()
+        client = CodecClient(
+            f"127.0.0.1:{server.port}",
+            timeout=5.0, retries=2, backoff_base=0.001,
+        )
+        try:
+            yield grpc, server, client
+        finally:
+            client.close()
+            server.stop(0)
+
+    def test_backend_unavailable_retried_e2e(self, service):
+        _, _, client = service
+        arr = chaos_shares_array()
+        before = metrics.get_counter(
+            "codec_call_retry_total", method="ExtendAndRoot"
+        )
+        with faults.inject(
+            faults.rule("codec.backend", "unavailable", times=1),
+            seed=CHAOS_SEED,
+        ):
+            rows, cols, dah = client.extend_and_root(arr)
+        eds = da.extend_shares(arr.reshape(4, da.SHARE_SIZE))
+        assert rows == eds.row_roots()
+        assert metrics.get_counter(
+            "codec_call_retry_total", method="ExtendAndRoot"
+        ) == before + 1
+
+    def test_client_side_fault_retried(self, service):
+        _, _, client = service
+        arr = chaos_shares_array()
+        with faults.inject(
+            faults.rule("codec.call", "error", times=1), seed=CHAOS_SEED
+        ) as inj:
+            out = client.encode(arr)
+        assert out.shape == (4, 4, da.SHARE_SIZE)
+        assert [kind for _, _, kind in inj.schedule] == ["error"]
+
+    def test_stalled_server_hits_deadline(self, service):
+        # satellite: a hung backend must surface as DEADLINE_EXCEEDED
+        # within ~timeout, never block the caller indefinitely
+        grpc, server, _ = service
+        from celestia_tpu.service.codec_service import CodecClient
+
+        impatient = CodecClient(
+            f"127.0.0.1:{server.port}", timeout=0.2, retries=0,
+        )
+        try:
+            with faults.inject(
+                faults.rule("codec.backend", "delay", delay_s=1.5),
+                seed=CHAOS_SEED,
+            ):
+                with pytest.raises(grpc.RpcError) as exc:
+                    impatient.encode(chaos_shares_array())
+            assert exc.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+        finally:
+            impatient.close()
+
+    def test_invalid_argument_not_retried(self, service):
+        grpc, _, client = service
+        bad = np.zeros((2, 3, da.SHARE_SIZE), dtype=np.uint8)  # not square
+        before = metrics.get_counter(
+            "codec_call_retry_total", method="Encode"
+        )
+        with pytest.raises(grpc.RpcError) as exc:
+            client.encode(bad)
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert metrics.get_counter(
+            "codec_call_retry_total", method="Encode"
+        ) == before
+
+    def test_device_degradation_through_the_service(self, service):
+        # e2e acceptance: device faults on the server degrade to host
+        # INSIDE the service; the client sees only correct replies
+        grpc, _, _ = service
+        from celestia_tpu.service.codec_service import CodecClient, CodecServer
+
+        server = CodecServer(port=0, use_tpu=True)
+        server.backend.tpu_strike_limit = 2
+        server.start()
+        client = CodecClient(
+            f"127.0.0.1:{server.port}", timeout=10.0, retries=0,
+        )
+        try:
+            arr = chaos_shares_array()
+            eds = da.extend_shares(arr.reshape(4, da.SHARE_SIZE))
+            with faults.inject(
+                faults.rule("device.extend", "unavailable"), seed=CHAOS_SEED
+            ):
+                for _ in range(3):
+                    rows, _cols, _dah = client.extend_and_root(arr)
+                    assert rows == eds.row_roots()
+            assert server.backend.use_tpu is False
+        finally:
+            client.close()
+            server.stop(0)
+
+
+@pytest.mark.slow
+class TestDevnetChaos:
+    """Consensus over real HTTP with transport faults on the gossip
+    paths: transient rpc.post failures must be absorbed by the peer
+    clients' retries — the round still commits on every validator and
+    no raw urllib error escapes into the consensus loop."""
+
+    def test_round_commits_under_transient_post_faults(self):
+        pytest.importorskip("cryptography")
+        from celestia_tpu.app import App
+        from celestia_tpu.crypto import PrivateKey
+        from celestia_tpu.node import Node
+        from celestia_tpu.node.devnet import ValidatorNode
+        from celestia_tpu.node.rpc import RpcServer
+        from celestia_tpu.testutil.ibc import add_consensus_validator
+
+        keys = [
+            PrivateKey.from_secret(f"chaos-val-{i}".encode())
+            for i in range(3)
+        ]
+        nodes, servers = [], []
+        for _ in range(3):
+            app = App(chain_id="chaos-devnet")
+            app.init_chain({}, genesis_time=0.0)
+            for key in keys:
+                add_consensus_validator(app, key, 10_000_000)
+            node = Node(app)
+            node.produce_block(15.0)
+            srv = RpcServer(node, port=0)
+            srv.start()
+            nodes.append(node)
+            servers.append(srv)
+        urls = [f"http://{s.server.server_address[0]}:{s.port}"
+                for s in servers]
+        validators = [
+            ValidatorNode(nodes[i], keys[i],
+                          [u for j, u in enumerate(urls) if j != i])
+            for i in range(3)
+        ]
+        try:
+            with faults.inject(
+                faults.rule("rpc.post", "error", times=2),
+                faults.rule("rpc.post", "reset", after=4, times=1),
+                seed=CHAOS_SEED,
+            ) as inj:
+                out = validators[0].try_propose(block_time=30.0)
+            assert out is not None, "round did not commit under faults"
+            assert inj.schedule, "no transport fault actually struck"
+            assert all(n.app.height == 2 for n in nodes)
+        finally:
+            for srv in servers:
+                srv.stop()

@@ -282,3 +282,87 @@ class TestNativeRepair:
         present[:3] = True  # 3 < k=4
         with pytest.raises(ValueError, match="not enough shards"):
             native.leo_decode(np.zeros((8, 16), dtype=np.uint8), present)
+
+
+class TestRepairFuzzVsDenseOracle:
+    """Adversarial mask fuzz at the decodability boundary: `repair`
+    (batched Leopard sweeps) against an independent oracle built from
+    `_solve_axis_dense` only. The two must agree on every mask — same
+    recovered bytes on success, UnrepairableError on the same patterns."""
+
+    @staticmethod
+    def oracle_repair(shares, present, k):
+        """Same iterate-to-fixpoint sweep discipline as `repair`, but
+        every axis solved by the dense oracle, one at a time."""
+        from celestia_tpu.da.repair import _solve_axis_dense
+
+        width = 2 * k
+        eds = np.array(shares, dtype=np.uint8, copy=True)
+        eds[~present] = 0
+        present = present.copy()
+        while not present.all():
+            progress = False
+            for transpose in (False, True):
+                view = eds.transpose(1, 0, 2) if transpose else eds
+                mask = present.T if transpose else present
+                for i in range(width):
+                    if mask[i].all() or mask[i].sum() < k:
+                        continue
+                    view[i] = _solve_axis_dense(view[i], mask[i], k)
+                    mask[i] = True
+                    progress = True
+            if not progress:
+                raise UnrepairableError("oracle: no axis can make progress")
+        return eds
+
+    @pytest.mark.parametrize("k", [2, 4])
+    def test_boundary_masks_agree_with_oracle(self, k):
+        eds = make_eds(k, seed=80 + k)
+        rng = np.random.default_rng(90 + k)
+        width = 2 * k
+        agreed_ok = agreed_fail = 0
+        for trial in range(40):
+            # hover around the decodability boundary: erase between
+            # "clearly fine" and "clearly hopeless" cell counts, with a
+            # bias toward clustered (row/col aligned) erasures — the
+            # patterns where greedy sweeps can actually get stuck
+            n_erase = int(rng.integers(k * k, 3 * k * k + 1))
+            present = np.ones((width, width), dtype=bool)
+            if trial % 2:
+                flat = rng.choice(width * width, size=n_erase, replace=False)
+                present.reshape(-1)[flat] = False
+            else:
+                rows = rng.choice(width, size=min(width, k + 1), replace=False)
+                cols = rng.choice(width, size=min(width, k + 1), replace=False)
+                for r in rows:
+                    present[r, rng.choice(width, size=k, replace=False)] = False
+                for c in cols:
+                    present[rng.choice(width, size=k, replace=False), c] = False
+            src = np.where(present[..., None], eds.data, 0)
+            try:
+                want = self.oracle_repair(src, present, k)
+            except UnrepairableError:
+                with pytest.raises(UnrepairableError):
+                    repair(src, present.copy())
+                agreed_fail += 1
+                continue
+            got = repair(src, present.copy())
+            assert np.array_equal(got, want)
+            assert np.array_equal(got, eds.data)
+            agreed_ok += 1
+        # the fuzz must actually exercise BOTH verdicts to mean anything
+        assert agreed_ok > 0 and agreed_fail > 0, (agreed_ok, agreed_fail)
+
+    @pytest.mark.parametrize("k", [2, 4])
+    def test_crafted_block_erasure_unrepairable_in_both(self, k):
+        # a (k+1) x (k+1) fully-erased sub-block leaves every touched
+        # row AND column with at most 2k-(k+1) = k-1 survivors: no axis
+        # can start, so both implementations must refuse identically
+        eds = make_eds(k, seed=70 + k)
+        present = np.ones((2 * k, 2 * k), dtype=bool)
+        present[: k + 1, : k + 1] = False
+        src = np.where(present[..., None], eds.data, 0)
+        with pytest.raises(UnrepairableError):
+            repair(src, present.copy())
+        with pytest.raises(UnrepairableError):
+            self.oracle_repair(src, present, k)
