@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-slow chaos bench dryrun native
+.PHONY: test test-all test-slow chaos bench bench-transfers dryrun native
 
 # Fast developer loop: the default tier skips the slow multi-process
 # suites (devnet, gRPC, multihost, network, race storms). Two FRESH
@@ -47,6 +47,14 @@ chaos:
 # The BASELINE benchmark suite on the real TPU chip (one JSON line).
 bench:
 	$(PY) bench.py
+
+# Transfer-path acceptance run (specs/transfers.md): sliced-sample +
+# k=64 node-path + chunked-repair configs with the fault injector armed
+# at device.extend/device.repair — pins byte-identical DAH/proof output
+# under the async chunked transfer paths. Exits non-zero on any parity
+# failure; never writes the bench cache (fault delays poison walls).
+bench-transfers:
+	$(PY) bench.py --transfers
 
 # The driver's multichip compile/execute check on a virtual CPU mesh.
 dryrun:

@@ -1,4 +1,30 @@
-"""The application layer (ABCI boundary)."""
+"""The application layer (ABCI boundary).
 
-from .app import App, GENESIS_CHAIN_ID  # noqa: F401
-from .context import Context, GasMeter, OutOfGasError  # noqa: F401
+App/Context re-exports are LAZY (PEP 562): `app.app` pulls the full
+state-machine import chain (crypto, x/ modules), but light submodules —
+`app.calibration`, used by bench and the transfer tests — must stay
+importable without it (the crossover table itself is pure stdlib +
+numpy, and should load even where the `cryptography` wheel is absent).
+"""
+
+_EXPORTS = {
+    "App": ("celestia_tpu.app.app", "App"),
+    "GENESIS_CHAIN_ID": ("celestia_tpu.app.app", "GENESIS_CHAIN_ID"),
+    "Context": ("celestia_tpu.app.context", "Context"),
+    "GasMeter": ("celestia_tpu.app.context", "GasMeter"),
+    "OutOfGasError": ("celestia_tpu.app.context", "OutOfGasError"),
+}
+
+
+def __getattr__(name: str):
+    try:
+        module, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+
+    return getattr(importlib.import_module(module), attr)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -110,9 +110,12 @@ class ProposalBlockData:
     hash: bytes
 
 
-# Measured crossover for the auto backend (bench config 1 vs 2): at k=2
-# the device path is dispatch-bound (0.18x native), at k=32 it is ~50x.
-# Below this square size "auto" stays on the native CPU runtime.
+# Static crossover FALLBACK for the auto backend (bench config 1 vs 2):
+# at k=2 the device path is dispatch-bound (0.18x native), at k=32 it is
+# ~50x. Below this square size "auto" stays on the native CPU runtime.
+# A node with a measured CrossoverTable (app/calibration.py, ADR-012)
+# overrides this guess with per-k measured winners — the static gate
+# only governs uncalibrated processes (tests, fresh homes, libraries).
 TPU_MIN_SQUARE = 16
 
 _accel_probe: bool | None = None
@@ -149,6 +152,9 @@ class App:
                 "(want auto|tpu|native|numpy)"
             )
         self._active_backend: str | None = None  # last backend logged
+        # measured per-k backend crossover (app/calibration.py); None
+        # means uncalibrated — auto uses the static TPU_MIN_SQUARE gate
+        self.crossover = None
         self.blob_pool = None  # device blob arena (enable_blob_pool)
         # assembled-vs-fallback proposal counts when the arena is on
         self.arena_stats = {"assembled": 0, "fallback": 0}
@@ -264,17 +270,28 @@ class App:
     def resolve_extend_backend(self, k: int) -> str:
         """Pick the live ExtendBlock backend for a k×k square.
 
-        auto: device when an accelerator is present and k is above the
-        measured dispatch-bound crossover (TPU_MIN_SQUARE); else the
-        native C++ runtime; else numpy. Explicit backends are honored
-        ("tpu" means the jax device path on whatever backend jax has —
-        the CPU-mesh tests exercise it without hardware). All backends
-        are byte-identical (pinned by tests + the DAH oracles)."""
+        auto: the MEASURED winner for this k when a CrossoverTable is
+        attached (self.crossover, app/calibration.py — winners are
+        re-checked against live backend availability, so a table
+        measured elsewhere degrades safely); otherwise the static gate —
+        device when an accelerator is present and k >= TPU_MIN_SQUARE,
+        else the native C++ runtime, else numpy. Explicit backends are
+        honored ("tpu" means the jax device path on whatever backend jax
+        has — the CPU-mesh tests exercise it without hardware). All
+        backends are byte-identical (pinned by tests + the DAH oracles),
+        so the choice is purely a latency call."""
         from celestia_tpu import native
 
         backend = self.extend_backend
         if backend == "auto":
-            if accelerator_available() and k >= TPU_MIN_SQUARE:
+            winner = self.crossover.winner(k) if self.crossover else None
+            if winner == "tpu" and not accelerator_available():
+                winner = None
+            if winner == "native" and not native.available():
+                winner = None
+            if winner is not None:
+                backend = winner
+            elif accelerator_available() and k >= TPU_MIN_SQUARE:
                 backend = "tpu"
             elif native.available():
                 backend = "native"
@@ -287,6 +304,23 @@ class App:
                      configured=self.extend_backend)
             self._active_backend = backend
         return backend
+
+    def calibrate_crossover(self, ks: tuple[int, ...] | None = None,
+                            repeats: int = 2, persist_path=None):
+        """Measure the per-k TPU/native latency table and attach it, so
+        `auto` resolves to the measured winner (app/calibration.py,
+        ADR-012). Refreshable at any time; persists to JSON when a path
+        is given (cli start loads it back on the next boot)."""
+        from celestia_tpu.app import calibration
+
+        table = calibration.measure_crossover(
+            ks or calibration.DEFAULT_KS, repeats
+        )
+        self.crossover = table
+        self._active_backend = None  # re-log the (possibly new) winner
+        if persist_path is not None:
+            table.save(persist_path)
+        return table
 
     def _square_array(self, data_square, k: int):
         import numpy as np

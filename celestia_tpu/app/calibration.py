@@ -1,0 +1,151 @@
+"""Measured TPU/native backend crossover for `auto` (ADR-012).
+
+The static `TPU_MIN_SQUARE = 16` gate was calibrated once from bench
+configs 1–2 and never re-validated at the default governance square
+k=64, where this environment's ~106–218 ms tunnel floor can flip the
+winner. This module replaces the guess with a measurement: at startup
+(or on demand) the node times the actual proposal-path work — square →
+DAH roots — on each available backend at a ladder of square sizes, and
+`auto` then picks the measured winner for the square it is about to
+extend. The table persists as JSON next to the node's TOML config
+(`config/crossover.json`) so restarts skip the measurement, and a
+`--calibrate-crossover` start refreshes it.
+
+The measurement includes the transfers (roots_device uploads the square
+and fetches the roots) — the whole point: the crossover is a property of
+compute AND interconnect, not of the MXU alone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import pathlib
+import time
+
+import numpy as np
+
+from celestia_tpu.appconsts import SHARE_SIZE
+from celestia_tpu.log import logger
+
+log = logger("calibration")
+
+DEFAULT_KS = (16, 32, 64, 128)
+FILENAME = "crossover.json"
+
+
+@dataclasses.dataclass
+class CrossoverTable:
+    """Per-k best-of latencies (ms) per backend, e.g.
+    {64: {"tpu": 120.3, "native": 95.1}}. Only backends that were
+    actually available at measurement time appear; the resolver
+    re-checks availability at decision time, so a table measured on a
+    TPU host degrades safely on a CPU-only one."""
+
+    entries: dict[int, dict[str, float]]
+    measured_at: float = 0.0
+
+    def winner(self, k: int) -> str | None:
+        """Measured fastest backend for a k×k square, or None when the
+        table is empty. Unmeasured k use the nearest measured rung in
+        log2 distance (latency is roughly polynomial in k, so the
+        geometrically nearest measurement extrapolates best); ties go
+        to the smaller rung."""
+        if not self.entries:
+            return None
+        target = math.log2(max(1, k))
+        best_k = min(
+            self.entries,
+            key=lambda m: (abs(math.log2(m) - target), m),
+        )
+        timings = self.entries[best_k]
+        if not timings:
+            return None
+        return min(timings, key=lambda b: timings[b])
+
+    def to_json(self) -> dict:
+        return {
+            "entries": {
+                str(k): dict(v) for k, v in sorted(self.entries.items())
+            },
+            "measured_at": self.measured_at,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "CrossoverTable":
+        return cls(
+            entries={
+                int(k): {str(b): float(ms) for b, ms in v.items()}
+                for k, v in d.get("entries", {}).items()
+            },
+            measured_at=float(d.get("measured_at", 0.0)),
+        )
+
+    def save(self, path: str | pathlib.Path) -> None:
+        p = pathlib.Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(self.to_json(), indent=2))
+
+    @classmethod
+    def load(cls, path: str | pathlib.Path) -> "CrossoverTable | None":
+        """None when missing or unreadable — a corrupt table must never
+        keep a node from starting (auto falls back to the static gate)."""
+        try:
+            return cls.from_json(json.loads(pathlib.Path(path).read_text()))
+        except Exception:  # noqa: BLE001 — absent/corrupt == uncalibrated
+            return None
+
+
+def crossover_path(home: str | pathlib.Path) -> pathlib.Path:
+    # mirrors config.config_dir(home) without importing config (whose
+    # tomllib dependency needs Python 3.11+; this module stays light)
+    return pathlib.Path(home) / "config" / FILENAME
+
+
+def _best_of(fn, repeats: int) -> float:
+    """Best-of wall ms after one untimed warmup (absorbs jit compiles /
+    library init — the steady-state number is what the node lives on)."""
+    fn()
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, (time.perf_counter() - t0) * 1e3)
+    return best
+
+
+def measure_crossover(
+    ks: tuple[int, ...] = DEFAULT_KS, repeats: int = 2
+) -> CrossoverTable:
+    """Time the proposal-path unit of work — square bytes in, DAH axis
+    roots out, transfers included — per available backend per k.
+
+    Share bytes are random (roots cost is content-independent; namespace
+    validity only matters to square construction, which is not what is
+    being timed). numpy is not measured: when neither accelerator nor
+    native toolchain is present the resolver's fallback order already
+    lands there, and timing k=128 host extensions would stall startup."""
+    from celestia_tpu import native
+    from celestia_tpu.app.app import accelerator_available
+
+    entries: dict[int, dict[str, float]] = {}
+    for k in ks:
+        rng = np.random.default_rng(k)
+        arr = rng.integers(0, 256, size=(k, k, SHARE_SIZE), dtype=np.uint8)
+        timings: dict[str, float] = {}
+        if accelerator_available():
+            from celestia_tpu.ops import extend_tpu
+
+            timings["tpu"] = _best_of(
+                lambda: extend_tpu.roots_device(arr), repeats
+            )
+        if native.available():
+            timings["native"] = _best_of(
+                lambda: native.extend_and_root_native(arr), repeats
+            )
+        if timings:
+            entries[k] = timings
+            log.info("crossover rung", k=k,
+                     **{b: round(ms, 3) for b, ms in timings.items()})
+    return CrossoverTable(entries, measured_at=time.time())

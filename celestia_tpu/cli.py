@@ -117,6 +117,19 @@ def cmd_start(args):
     node.app.min_gas_price = cfg.app.min_gas_price
     node.mempool.ttl_blocks = cfg.consensus.mempool.ttl_num_blocks
     node.mempool.max_tx_bytes = cfg.consensus.mempool.max_tx_bytes
+    # calibrated auto crossover (app/calibration.py, ADR-012): load the
+    # persisted per-k table when present; measure + persist a fresh one
+    # when configured or asked (--calibrate-crossover refreshes a stale
+    # table, e.g. after the tunnel/hardware changed)
+    from celestia_tpu.app.calibration import CrossoverTable, crossover_path
+
+    cal_path = crossover_path(home)
+    table = CrossoverTable.load(cal_path)
+    if table is not None:
+        node.app.crossover = table
+    if cfg.app.calibrate_crossover or getattr(args, "calibrate_crossover",
+                                              False):
+        node.app.calibrate_crossover(persist_path=cal_path)
     # resolve + log the live backend up front so the operator sees what
     # this node will actually run on the hot path
     live = node.app.resolve_extend_backend(
@@ -126,6 +139,9 @@ def cmd_start(args):
         # device blob arena: mempool blob bytes stage in HBM at CheckTx,
         # so proposals assemble squares on device (metadata-only upload)
         node.app.enable_blob_pool()
+        # share-serving stays sliced: retain committed EDS handles
+        # device-resident so a DAS sample moves one row, not 32 MB
+        node.extend_blocks = True
     server = RpcServer(node, port=args.port)
     server.start()
     # the reference node serves gRPC alongside RPC (app/app.go:693-719);
@@ -473,6 +489,11 @@ def main(argv=None):
                          choices=["auto", "tpu", "native", "numpy"],
                          help="ExtendBlock backend (default: config "
                               "app.extend_backend, 'auto')")
+    p_start.add_argument("--calibrate-crossover", action="store_true",
+                         help="measure the per-k TPU/native latency "
+                              "crossover now and persist it to "
+                              "config/crossover.json ('auto' then picks "
+                              "the measured winner per square size)")
     p_start.add_argument("--log-level", default="info",
                          choices=["debug", "info", "warning", "error"])
 

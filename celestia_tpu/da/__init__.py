@@ -42,11 +42,24 @@ class ExtendedDataSquare:
     — proposal/verify flows only ever need the DAH roots, so the 32 MB
     EDS crosses the interconnect only when the block store actually
     serves shares (ref: app/extend_block.go:14 recomputes the EDS
-    post-consensus for storage; here storage holds the device handle)."""
+    post-consensus for storage; here storage holds the device handle).
+
+    While device-resident, `row(i)` / `col(j)` / `share(r, c)` are
+    SLICED reads: the device cuts the requested axis/cell and only that
+    slice crosses to host (ops/transfers, specs/transfers.md) — a DAS
+    sample costs one row, not the square. Whole-square consumers
+    (`row_roots`, `flattened_shares`, `.data`) still do the single bulk
+    fetch, after which every accessor serves from host memory."""
+
+    # sliced rows/cols kept per instance so a DAS burst re-sampling the
+    # same axis (one row serves up to 2k samples) hits host memory, not
+    # the interconnect; tiny — the full square stays off-host
+    _SLICE_CACHE_AXES = 8
 
     def __init__(self, squares: np.ndarray | None, original_width: int):
         self._data = squares
         self._device = None
+        self._slice_cache: dict[tuple[str, int], list[bytes]] = {}
         self.original_width = original_width
 
     @classmethod
@@ -68,6 +81,7 @@ class ExtendedDataSquare:
         # the device copy no longer matches — drop it, or device_data
         # consumers (repair_eds prefers it) would repair stale bytes
         self._device = None
+        self._slice_cache.clear()
 
     @property
     def device_data(self):
@@ -79,13 +93,56 @@ class ExtendedDataSquare:
     def width(self) -> int:
         return 2 * self.original_width
 
+    def _sliced_axis(self, kind: str, idx: int) -> list[bytes]:
+        """One row/col of a device-resident square WITHOUT materializing
+        the full EDS: the device cuts the slice (ops/transfers jitted
+        dynamic-slice) and only w·512 bytes cross the interconnect —
+        the DAS serving unit. Byte-identical to the full-fetch path
+        (tests pin this across k and edge indices)."""
+        key = (kind, idx)
+        cached = self._slice_cache.get(key)
+        if cached is not None:
+            return cached
+        from celestia_tpu.ops import transfers
+
+        if kind == "row":
+            arr = transfers.eds_row(self._device, idx)
+        else:
+            arr = transfers.eds_col(self._device, idx)
+        cells = [arr[t].tobytes() for t in range(self.width)]
+        if len(self._slice_cache) >= self._SLICE_CACHE_AXES:
+            self._slice_cache.pop(next(iter(self._slice_cache)))
+        self._slice_cache[key] = cells
+        return cells
+
     def row(self, i: int) -> list[bytes]:
+        if self._data is None and self._device is not None:
+            return self._sliced_axis("row", i)
         return [self.data[i, j].tobytes() for j in range(self.width)]
 
     def col(self, j: int) -> list[bytes]:
+        if self._data is None and self._device is not None:
+            return self._sliced_axis("col", j)
         return [self.data[i, j].tobytes() for i in range(self.width)]
 
+    def share(self, r: int, c: int) -> bytes:
+        """One cell. Device-resident squares transfer 512 bytes (or ride
+        an already-fetched sliced row/col), never the full square."""
+        if self._data is None and self._device is not None:
+            row_hit = self._slice_cache.get(("row", r))
+            if row_hit is not None:
+                return row_hit[c]
+            col_hit = self._slice_cache.get(("col", c))
+            if col_hit is not None:
+                return col_hit[r]
+            from celestia_tpu.ops import transfers
+
+            return transfers.eds_share(self._device, r, c).tobytes()
+        return self.data[r, c].tobytes()
+
     def flattened_shares(self) -> list[bytes]:
+        # whole-square read: one full fetch beats w sliced transfers
+        _ = self.data
         return [
             self.data[i, j].tobytes()
             for i in range(self.width)
@@ -93,9 +150,12 @@ class ExtendedDataSquare:
         ]
 
     def row_roots(self) -> list[bytes]:
+        # roots consume every cell — materialize once, then host rows
+        _ = self.data
         return [_axis_root(self.row(i), i, self.original_width) for i in range(self.width)]
 
     def col_roots(self) -> list[bytes]:
+        _ = self.data
         return [_axis_root(self.col(j), j, self.original_width) for j in range(self.width)]
 
 

@@ -173,8 +173,17 @@ class Block:
 class Node:
     """One-validator chain driver over an App."""
 
-    def __init__(self, app: App, home: str | None = None):
+    def __init__(self, app: App, home: str | None = None,
+                 extend_blocks: bool = False):
         self.app = app
+        # ExtendBlock retention (ref: app/extend_block.go:14 — the
+        # reference recomputes the EDS post-consensus for storage): when
+        # on, each committed block's extended square HANDLE goes into
+        # the serving cache. On the TPU backend that handle is
+        # device-resident and lazy — share-serving routes then fetch
+        # SLICES (one row per DAS sample) instead of reconstructing or
+        # materializing the 32 MB square host-side.
+        self.extend_blocks = extend_blocks
         self.mempool = Mempool()
         self.blocks: dict[int, Block] = {}
         self.tx_index: dict[bytes, tuple[int, int]] = {}  # hash -> (height, idx)
@@ -277,12 +286,13 @@ class Node:
 
             btx, is_blob = blob_pkg.unmarshal_blob_tx(raw)
             if is_blob:
-                for b in btx.blobs:
-                    try:
-                        self.app.blob_pool.put(b.data)
-                    except Exception as e:  # noqa: BLE001 — cache only
-                        log.info("blob staging failed", error=str(e))
-                        break
+                try:
+                    # put_many dispatches every blob's upload before the
+                    # arena inserts — the DMAs overlap instead of
+                    # serializing per blob (ops/blob_pool.py)
+                    self.app.blob_pool.put_many([b.data for b in btx.blobs])
+                except Exception as e:  # noqa: BLE001 — cache only
+                    log.info("blob staging failed", error=str(e))
         return res
 
     # --- block production (the proposer+validator round) ---
@@ -367,6 +377,23 @@ class Node:
             version=build_version,
         )
         self._store_block(block)
+        # (skip retention across an upgrade boundary: extend_block runs
+        # at the POST-commit version, the square was built at the
+        # pre-commit one — block_eds's versioned reconstruction governs)
+        if self.extend_blocks and build_version == self.app.app_version:
+            # ExtendBlock retention: keep the committed square's EDS
+            # handle (device-resident + lazy on the TPU backend) so the
+            # serving routes answer DAS samples with SLICED reads
+            # instead of a pure-host re-extension. Cache-only: any
+            # failure falls back to block_eds reconstruction.
+            try:
+                eds = self.app.extend_block(proposal.txs)
+                with self._lock:
+                    self._eds_cache[block.height] = eds
+                    while len(self._eds_cache) > 2:
+                        self._eds_cache.popitem(last=False)
+            except Exception as e:  # noqa: BLE001 — retention is a cache
+                log.info("eds retention failed", error=str(e))
 
         for i, raw in enumerate(proposal.txs):
             key = tx_hash(raw)
@@ -423,7 +450,14 @@ class Node:
         share-serving source for peers and fraud investigation. A
         MaliciousApp that committed a corrupted extension serves THAT
         square (its `published_eds`): under the DA assumption the data
-        is available, the encoding is what's fraudulent."""
+        is available, the encoding is what's fraudulent.
+
+        Returns either a host numpy array (reconstruction path) or a
+        da.ExtendedDataSquare handle (published / ExtendBlock-retained
+        squares — possibly device-resident and lazy). Serving routes
+        should go through block_width/block_row/block_share, which
+        normalize both and keep device-resident squares SLICED (one row
+        per DAS sample crosses the interconnect, never the full EDS)."""
         published = getattr(self.app, "published_eds", None)
         if published and height in published:
             return published[height]
@@ -454,6 +488,39 @@ class Node:
                 self._eds_cache.popitem(last=False)
         return eds
 
+    def block_width(self, height: int) -> int | None:
+        """Extended-square width of a committed block, source-agnostic
+        (numpy array or ExtendedDataSquare handle — no byte fetch)."""
+        eds = self.block_eds(height)
+        if eds is None:
+            return None
+        if hasattr(eds, "original_width"):
+            return eds.width
+        return int(eds.shape[0])
+
+    def block_row(self, height: int, i: int) -> list[bytes] | None:
+        """Row i of a block's extended square as share bytes — THE DAS
+        serving read (/sample builds the row NMT proof from it). When
+        the square is a device-resident handle only this row's w·512
+        bytes cross the interconnect (ExtendedDataSquare.row sliced
+        path); host sources slice in memory. Byte-identical either way."""
+        eds = self.block_eds(height)
+        if eds is None:
+            return None
+        if hasattr(eds, "original_width"):
+            return eds.row(i)
+        return [bytes(eds[i, c]) for c in range(eds.shape[0])]
+
+    def block_share(self, height: int, r: int, c: int) -> bytes | None:
+        """One cell of a block's extended square (512 bytes moved for a
+        device-resident square, not 32 MB)."""
+        eds = self.block_eds(height)
+        if eds is None:
+            return None
+        if hasattr(eds, "original_width"):
+            return eds.share(r, c)
+        return bytes(eds[r, c])
+
     def block_dah(self, height: int):
         """The DataAvailabilityHeader a block's data_hash commits to —
         the O(w)-sized artifact light clients fetch instead of the
@@ -471,8 +538,9 @@ class Node:
             return None
         from celestia_tpu import da
 
-        k = eds.shape[0] // 2
-        dah = da.new_data_availability_header(da.ExtendedDataSquare(eds, k))
+        if not hasattr(eds, "original_width"):
+            eds = da.ExtendedDataSquare(eds, eds.shape[0] // 2)
+        dah = da.new_data_availability_header(eds)
         self._dah_cache[height] = dah
         return dah
 

@@ -134,6 +134,11 @@ def _handler_for(node: Node):
                     if eds is None:
                         self._reply({"error": "block not found"}, 404)
                     else:
+                        # whole-square route: a device-resident handle
+                        # does its one bulk fetch here (this is the one
+                        # consumer that genuinely reads every byte)
+                        if hasattr(eds, "original_width"):
+                            eds = eds.data
                         self._reply(
                             {
                                 "width": int(eds.shape[0]),
@@ -151,11 +156,10 @@ def _handler_for(node: Node):
                     # already authenticated). O(w) server work, O(log w)
                     # reply.
                     h, i, j = int(parts[1]), int(parts[2]), int(parts[3])
-                    eds = node.block_eds(h)
-                    if eds is None:
+                    w = node.block_width(h)
+                    if w is None:
                         self._reply({"error": "block not found"}, 404)
                         return
-                    w = int(eds.shape[0])
                     if not (0 <= i < w and 0 <= j < w):
                         self._reply({"error": "coordinate out of range"}, 400)
                         return
@@ -163,7 +167,10 @@ def _handler_for(node: Node):
                     from celestia_tpu.proof import nmt_prove_range
 
                     k_orig = w // 2
-                    row_cells = [bytes(eds[i, c]) for c in range(w)]
+                    # block_row keeps device-resident squares SLICED:
+                    # one row (w·512 bytes) crosses the interconnect per
+                    # sample, never the full EDS (specs/transfers.md)
+                    row_cells = node.block_row(h, i)
                     leaves = erasured_axis_leaves(row_cells, i, k_orig)
                     proof = nmt_prove_range(leaves, j, j + 1)
                     self._reply(
@@ -298,8 +305,21 @@ def _handler_for(node: Node):
                     ns_bytes = sq[int(start)].data[:29]
                     import celestia_tpu.namespace as ns_mod
 
+                    # reuse the node's EDS/DAH when they verifiably match
+                    # this block: no re-extension or root recompute, and
+                    # a device-resident handle serves the proof's rows
+                    # via SLICED reads (proof builder re-checks each row
+                    # against the DAH before proving)
+                    proof_src: dict = {}
+                    dah = node.block_dah(int(height))
+                    if dah is not None and dah.hash() == block.data_hash:
+                        proof_src["dah"] = dah
+                        eds_handle = node.block_eds(int(height))
+                        if hasattr(eds_handle, "original_width"):
+                            proof_src["eds"] = eds_handle
                     proof = new_share_inclusion_proof(
-                        sq, ns_mod.from_bytes(ns_bytes), Range(int(start), int(end))
+                        sq, ns_mod.from_bytes(ns_bytes),
+                        Range(int(start), int(end)), **proof_src
                     )
                     proof.validate(block.data_hash)
                     self._reply(_share_proof_json(proof))

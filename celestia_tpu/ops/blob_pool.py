@@ -72,12 +72,21 @@ class DeviceBlobArena:
         import jax.numpy as jnp
 
         self.capacity = int(capacity_bytes)
-        # 4 KB-aligned half; a sub-8 KB arena degenerates to one
-        # wholesale-reset region (half == 0 would make everything
-        # "oversized", so clamp to one slot)
+        # Each half is floor(capacity/2) rounded DOWN to 4 KB; a
+        # sub-8 KB arena degenerates to one wholesale-reset region
+        # (half == 0 would make everything "oversized", so clamp to one
+        # slot). When capacity is not a multiple of 8 KB the remainder
+        # past the usable region is STRANDED by design — equal aligned
+        # halves are what guarantee entries never straddle the flip
+        # boundary (ADR-007 amendment). `tail_bytes` makes the waste
+        # visible so operators size capacities in 8 KB multiples.
         self._half = max(4096, self.capacity // 2 // 4096 * 4096)
         if self._half > self.capacity:
             self._half = self.capacity
+        usable = (
+            self._half * 2 if self._half * 2 <= self.capacity else self._half
+        )
+        self.tail_bytes = self.capacity - usable
         self._device = device
         self._arena = jax.device_put(
             jnp.zeros((self.capacity,), jnp.uint8), device
@@ -103,14 +112,47 @@ class DeviceBlobArena:
 
     # ---- writes (CheckTx admission path) ----
 
+    def _alloc_locked(self, pad: int) -> int:
+        """Bump-allocate `pad` bytes in the active half (caller checked
+        pad <= half), flipping when full: activate the other half and
+        evict only ITS entries; the half we just filled stays resident
+        for one more cycle. Entries never straddle the boundary (pad <=
+        half and allocation flips before overflowing)."""
+        if self._next + pad > self._base + self._half:
+            if self._half * 2 <= self.capacity:
+                self._base = self._half - self._base  # 0 <-> half
+            else:  # degenerate single-region arena
+                self._base = 0
+            self._next = self._base
+            lo, hi = self._base, self._base + self._half
+            self._offsets = {
+                k: (o, ln)
+                for k, (o, ln) in self._offsets.items()
+                if not (lo <= o < hi)
+            }
+        offset = self._next
+        self._next += pad
+        return offset
+
+    def _stage_chunk(self, data: bytes):
+        """Dispatch the padded blob bytes host→device (async DMA —
+        jax.device_put returns before the copy lands) with transfer
+        telemetry at site=arena.stage."""
+        import numpy as np
+
+        from celestia_tpu.ops import transfers
+
+        pad = _pad_len(len(data))
+        chunk = np.zeros((pad,), np.uint8)
+        chunk[: len(data)] = np.frombuffer(data, np.uint8)
+        return transfers.device_put_chunked(
+            chunk, self._device, site="arena.stage"
+        )
+
     def put(self, data: bytes) -> bytes:
         """Stage blob bytes on device; returns the content key.
         Idempotent; flips to the other half when the active one is full
         (transfer cache semantics — see class docstring)."""
-        import jax
-        import jax.numpy as jnp
-        import numpy as np
-
         key = blob_key(data)
         with self._lock:
             if key in self._offsets:
@@ -118,33 +160,45 @@ class DeviceBlobArena:
             pad = _pad_len(len(data))
             if pad > self._half:
                 return key  # oversized: never resident, always fallback
-            if self._next + pad > self._base + self._half:
-                # flip: activate the other half and evict only ITS
-                # entries; the half we just filled stays resident for
-                # one more cycle. Entries never straddle the boundary
-                # (pad <= half and allocation flips before overflowing).
-                if self._half * 2 <= self.capacity:
-                    self._base = self._half - self._base  # 0 <-> half
-                else:  # degenerate single-region arena
-                    self._base = 0
-                self._next = self._base
-                lo, hi = self._base, self._base + self._half
-                self._offsets = {
-                    k: (o, ln)
-                    for k, (o, ln) in self._offsets.items()
-                    if not (lo <= o < hi)
-                }
-            offset = self._next
-            self._next += pad
-            chunk = np.zeros((pad,), np.uint8)
-            chunk[: len(data)] = np.frombuffer(data, np.uint8)
-            self._arena = _jitted_insert(pad)(
-                self._arena, jax.device_put(jnp.asarray(chunk), self._device),
-                offset,
-            )
+            dev = self._stage_chunk(data)
+            offset = self._alloc_locked(pad)
+            self._arena = _jitted_insert(pad)(self._arena, dev, offset)
             self._offsets[key] = (offset, len(data))
             self._publish_metrics()
             return key
+
+    def put_many(self, datas: list[bytes]) -> list[bytes]:
+        """Stage several blobs with upload/insert overlap: every blob's
+        host→device DMA is dispatched FIRST (all async, in flight at
+        once), then the donated arena inserts consume them in order —
+        blob i+1's bytes stream over the interconnect while blob i's
+        insert runs, instead of the strict upload→insert lockstep of
+        sequential put() calls. Allocator/flip/dedup semantics are
+        identical to put(); returns the content keys in input order."""
+        with self._lock:
+            staged: list[tuple[bytes, bytes, object | None]] = []
+            seen: set[bytes] = set()
+            for data in datas:
+                key = blob_key(data)
+                if (
+                    key in self._offsets
+                    or key in seen
+                    or _pad_len(len(data)) > self._half
+                ):
+                    staged.append((key, data, None))  # resident/oversized
+                    continue
+                seen.add(key)
+                staged.append((key, data, self._stage_chunk(data)))
+            keys = []
+            for key, data, dev in staged:
+                if dev is not None:
+                    pad = _pad_len(len(data))
+                    offset = self._alloc_locked(pad)
+                    self._arena = _jitted_insert(pad)(self._arena, dev, offset)
+                    self._offsets[key] = (offset, len(data))
+                keys.append(key)
+            self._publish_metrics()
+            return keys
 
     def _publish_metrics(self) -> None:
         """Operator visibility on /metrics: how much of the mempool's
@@ -162,6 +216,13 @@ class DeviceBlobArena:
                 "blob_arena_used_bytes", float(self._next - self._base)
             )
             metrics.set_gauge("blob_arena_capacity_bytes", float(self.capacity))
+            # the denominator fill-ratio dashboards should divide by:
+            # used_bytes tops out at the ACTIVE HALF, not capacity —
+            # used/capacity plateaus near 50% by design (ADR-007
+            # amendment: the half-capacity residency cap)
+            metrics.set_gauge(
+                "blob_arena_active_half_bytes", float(self._half)
+            )
         except Exception:  # noqa: BLE001 — metrics must never break staging
             pass
 

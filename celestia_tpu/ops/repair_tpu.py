@@ -258,19 +258,28 @@ def stage_resident_repair(
     import jax
     import jax.numpy as jnp
 
+    from celestia_tpu.ops import transfers
+
     w = eds.shape[0]
     k = w // 2
+    if isinstance(eds, np.ndarray):
+        # Dispatch the upload BEFORE planning: the async row-block DMAs
+        # (transfers.device_put_chunked) stream the raw square while the
+        # host derives the sweep schedule from the mask — transfer
+        # overlaps planning instead of serializing after it. Erased
+        # cells are zeroed on DEVICE (same jnp.where the resident path
+        # uses), which also drops the former host-side 32 MB np.where
+        # pass from the critical path. Byte-identical either way.
+        dev_raw = transfers.device_put_chunked(eds, device, site="repair.stage")
+    else:
+        dev_raw = eds
     plans = plan_sweeps(present, k)
 
     # Chunk the axis batch so the int32 matmul accumulator stays bounded
     # (w × 8w × B int32 at k=128 is ~2 GB; 4 chunks keep peaks ~0.5 GB).
     chunks = 4 if w >= 256 else 1
     t2, bitmul = _resident_constants(w)
-    if isinstance(eds, np.ndarray):
-        dev = jax.device_put(np.where(present[..., None], eds, 0), device)
-    else:
-        # device-resident input: clear erased cells on device
-        dev = _jitted_clear()(eds, jnp.asarray(present))
+    dev = _jitted_clear()(dev_raw, jnp.asarray(present))
     step = _jitted_sweep(k, eds.shape[2], chunks)
     staged = [
         (
@@ -333,7 +342,9 @@ def repair_tpu(
     three implementations together).
     """
     faults.fire("device.repair", entry="repair_tpu")
-    import jax
+    from celestia_tpu.ops import transfers
 
     run, _ = stage_resident_repair(eds, present, device)
-    return np.asarray(jax.device_get(run()))
+    # overlapped row-block download (all D2H DMAs in flight at once)
+    # instead of one monolithic blocking device_get
+    return transfers.device_get_chunked(run(), site="repair.fetch")

@@ -358,9 +358,18 @@ class ShareProof:
 
 
 def new_share_inclusion_proof(
-    data_square: list[Share], namespace: Namespace, share_range: Range
+    data_square: list[Share], namespace: Namespace, share_range: Range,
+    eds: "da.ExtendedDataSquare | None" = None,
+    dah: "da.DataAvailabilityHeader | None" = None,
 ) -> ShareProof:
-    """ref: pkg/proof/proof.go:58-165"""
+    """ref: pkg/proof/proof.go:58-165
+
+    A serving node that already holds the block's extended square and
+    DAH passes them in: no re-extension, no root recompute — and when
+    the EDS handle is device-resident, the row reads below go through
+    the SLICED path (ExtendedDataSquare.row), so only the proof's rows
+    cross the interconnect. The per-row root check against the DAH
+    keeps a stale/mismatched handle from ever producing a bad proof."""
     from celestia_tpu import square as square_pkg
 
     square_size = square_pkg.square_size(len(data_square))
@@ -369,9 +378,14 @@ def new_share_inclusion_proof(
     start_leaf = share_range.start % square_size
     end_leaf = (share_range.end - 1) % square_size
 
-    eds = da.extend_shares(to_bytes(data_square))
-    row_roots_all = eds.row_roots()
-    col_roots_all = eds.col_roots()
+    if eds is None:
+        eds = da.extend_shares(to_bytes(data_square))
+    if dah is not None:
+        row_roots_all = list(dah.row_roots)
+        col_roots_all = list(dah.column_roots)
+    else:
+        row_roots_all = eds.row_roots()
+        col_roots_all = eds.col_roots()
 
     _data_root, all_proofs = merkle_proofs(row_roots_all + col_roots_all)
 
