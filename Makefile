@@ -3,7 +3,8 @@
 
 PY ?= python
 
-.PHONY: test test-all test-slow chaos bench bench-transfers dryrun native
+.PHONY: test test-all test-slow chaos bench bench-transfers dryrun native \
+	trace-smoke
 
 # Fast developer loop: the default tier skips the slow multi-process
 # suites (devnet, gRPC, multihost, network, race storms). Two FRESH
@@ -55,6 +56,13 @@ bench:
 # failure; never writes the bench cache (fault delays poison walls).
 bench-transfers:
 	$(PY) bench.py --transfers
+
+# Tracing acceptance gate (specs/observability.md): one k=32 extend
+# under a recording, validates the Chrome trace-event JSON and requires
+# root spans to cover >=90% of the traced wall. CPU-only, seconds warm.
+trace-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/trace_smoke.py \
+		--trace-out /tmp/trace_smoke.json
 
 # The driver's multichip compile/execute check on a virtual CPU mesh.
 dryrun:

@@ -1277,7 +1277,30 @@ def main_transfers():
 
 
 if __name__ == "__main__":
-    if "--transfers" in sys.argv:
-        main_transfers()
-    else:
-        main()
+    # --trace-out PATH rides along with any bench mode; strip it BEFORE
+    # dispatch (main() parses sys.argv[1] positionally as the headline k)
+    _trace_path = None
+    if "--trace-out" in sys.argv:
+        _i = sys.argv.index("--trace-out")
+        if _i + 1 >= len(sys.argv):
+            raise SystemExit("--trace-out requires a PATH argument")
+        _trace_path = sys.argv[_i + 1]
+        del sys.argv[_i:_i + 2]
+    _rec = None
+    if _trace_path is not None:
+        from celestia_tpu import tracing as _tracing
+
+        _rec = _tracing.start_recording()
+    try:
+        if "--transfers" in sys.argv:
+            main_transfers()
+        else:
+            main()
+    finally:
+        if _rec is not None:
+            _rec.stop()
+            _rec.write(_trace_path)
+            print(
+                f"trace written: {_trace_path} ({len(_rec.spans)} spans)",
+                file=sys.stderr,
+            )

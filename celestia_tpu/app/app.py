@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 
-from celestia_tpu import appconsts, da
+from celestia_tpu import appconsts, da, tracing
 from celestia_tpu import blob as blob_pkg
 from celestia_tpu import square as square_pkg
 from celestia_tpu.shares import to_bytes
@@ -137,6 +137,7 @@ def accelerator_available() -> bool:
 
 class App:
     SUPPORTED_VERSIONS = (1, 2)
+    TPU_STRIKE_LIMIT = 3  # consecutive device failures before sticky disable
 
     def __init__(self, chain_id: str = GENESIS_CHAIN_ID, app_version: int = 1,
                  use_tpu: bool = False, upgrade_schedule: dict | None = None,
@@ -152,6 +153,13 @@ class App:
                 "(want auto|tpu|native|numpy)"
             )
         self._active_backend: str | None = None  # last backend logged
+        # TPU→host degradation (specs/observability.md): device-path
+        # extend failures strike; TPU_STRIKE_LIMIT CONSECUTIVE strikes
+        # sticky-disable the device path for this App (a success resets
+        # the count). Every fallback is byte-identical, so degradation
+        # costs latency, never correctness.
+        self._tpu_strikes = 0
+        self._tpu_disabled = False
         # measured per-k backend crossover (app/calibration.py); None
         # means uncalibrated — auto uses the static TPU_MIN_SQUARE gate
         self.crossover = None
@@ -299,6 +307,9 @@ class App:
                 backend = "numpy"
         elif backend == "native" and not native.available():
             backend = "numpy"
+        if backend == "tpu" and self._tpu_disabled:
+            # sticky degradation: the device struck out (_degrade_tpu)
+            backend = "native" if native.available() else "numpy"
         if backend != self._active_backend:
             log.info("extend backend", backend=backend, k=k,
                      configured=self.extend_backend)
@@ -329,6 +340,42 @@ class App:
             b"".join(s.data for s in data_square), dtype=np.uint8
         ).reshape(k, k, appconsts.SHARE_SIZE)
 
+    def _degrade_tpu(self, op: str, exc: Exception) -> str:
+        """One TPU ExtendBlock failure: strike, warn with the block
+        height + cause, and return the host-side fallback backend.
+        TPU_STRIKE_LIMIT consecutive strikes sticky-disable the device
+        path (resolve_extend_backend consults _tpu_disabled); every
+        fallback recomputes byte-identically on the host."""
+        from celestia_tpu import native
+
+        self._tpu_strikes += 1
+        if self._tpu_strikes >= self.TPU_STRIKE_LIMIT:
+            self._tpu_disabled = True
+            self._active_backend = None  # re-log the degraded winner
+        fallback = "native" if native.available() else "numpy"
+        log.warn(
+            "extend degraded tpu->host",
+            height=self.height + 1,
+            cause=f"{type(exc).__name__}: {exc}",
+            op=op,
+            strike=self._tpu_strikes,
+            fallback=fallback,
+            disabled=self._tpu_disabled,
+        )
+        try:
+            from celestia_tpu.telemetry import metrics
+
+            metrics.incr_counter("extend_tpu_fallback_total", op=op)
+            if self._tpu_disabled:
+                metrics.incr_counter("extend_tpu_disabled_total")
+        except Exception:  # noqa: BLE001 — metrics never break proposals
+            pass
+        sp = tracing.current()
+        if sp is not None:
+            sp.set(degraded=True, strikes=self._tpu_strikes,
+                   cause=type(exc).__name__)
+        return fallback
+
     def _proposal_dah(
         self, data_square, builder=None
     ) -> "da.DataAvailabilityHeader":
@@ -346,38 +393,55 @@ class App:
         placement provenance) and only share metadata crosses."""
         from celestia_tpu import native
 
+        from celestia_tpu.telemetry import metrics
+
         k = square_pkg.square_size(len(data_square))
         backend = self.resolve_extend_backend(k)
-        if backend == "tpu":
-            from celestia_tpu.ops import extend_tpu
+        with tracing.span("extend.block", backend=backend, k=k,
+                          height=self.height + 1, path="proposal") as bspan, \
+                metrics.measure("extend_block", path="proposal"):
+            if backend == "tpu":
+                from celestia_tpu.ops import extend_tpu
 
-            if builder is not None and self.blob_pool is not None:
-                dah = self._assembled_proposal_dah(data_square, builder, k)
-                # hit-rate accounting for operators and the bench: under
-                # arena churn (working set > capacity) proposals
-                # oscillate between the assembled and upload paths —
-                # the rate makes that visible (/metrics + bench 8b)
-                stat = "assembled" if dah is not None else "fallback"
-                self.arena_stats[stat] += 1
                 try:
-                    from celestia_tpu.telemetry import metrics
+                    if builder is not None and self.blob_pool is not None:
+                        dah = self._assembled_proposal_dah(
+                            data_square, builder, k
+                        )
+                        # hit-rate accounting for operators and the
+                        # bench: under arena churn (working set >
+                        # capacity) proposals oscillate between the
+                        # assembled and upload paths — the rate makes
+                        # that visible (/metrics + bench 8b)
+                        stat = "assembled" if dah is not None else "fallback"
+                        self.arena_stats[stat] += 1
+                        try:
+                            from celestia_tpu.telemetry import metrics
 
-                    metrics.incr_counter(f"blob_arena_proposal_{stat}")
-                except Exception:  # noqa: BLE001 — metrics never break proposals
-                    pass
-                if dah is not None:
-                    return dah
-            rows, cols = extend_tpu.roots_device(self._square_array(data_square, k))
-            return da.DataAvailabilityHeader(
-                [r.tobytes() for r in rows], [c.tobytes() for c in cols]
-            )
-        if backend == "native":
-            _eds, rows, cols, native_dah = native.extend_and_root_native(
-                self._square_array(data_square, k)
-            )
-            return da.DataAvailabilityHeader(rows, cols, _hash=native_dah)
-        eds = da.extend_shares(to_bytes(data_square))
-        return da.new_data_availability_header(eds)
+                            metrics.incr_counter(f"blob_arena_proposal_{stat}")
+                        except Exception:  # noqa: BLE001 — metrics never break proposals
+                            pass
+                        if dah is not None:
+                            self._tpu_strikes = 0
+                            return dah
+                    rows, cols = extend_tpu.roots_device(
+                        self._square_array(data_square, k)
+                    )
+                    self._tpu_strikes = 0
+                    return da.DataAvailabilityHeader(
+                        [r.tobytes() for r in rows],
+                        [c.tobytes() for c in cols],
+                    )
+                except Exception as exc:  # noqa: BLE001 — degrade to host
+                    backend = self._degrade_tpu("proposal_dah", exc)
+                    bspan.set(backend=backend)
+            if backend == "native":
+                _eds, rows, cols, native_dah = native.extend_and_root_native(
+                    self._square_array(data_square, k)
+                )
+                return da.DataAvailabilityHeader(rows, cols, _hash=native_dah)
+            eds = da.extend_shares(to_bytes(data_square))
+            return da.new_data_availability_header(eds)
 
     def enable_blob_pool(self, capacity_bytes: int = 64 * 1024 * 1024):
         """Attach a device-resident blob arena (ops/blob_pool.py): the
@@ -488,25 +552,42 @@ class App:
         """
         from celestia_tpu import native
 
+        from celestia_tpu.telemetry import metrics
+
         k = square_pkg.square_size(len(data_square))
         backend = self.resolve_extend_backend(k)
-        if backend in ("tpu", "native"):
-            arr = self._square_array(data_square, k)
-            if backend == "tpu":
-                from celestia_tpu.ops import extend_tpu
+        with tracing.span("extend.block", backend=backend, k=k,
+                          height=self.height + 1, path="eds") as bspan, \
+                metrics.measure("extend_block", path="eds"):
+            if backend in ("tpu", "native"):
+                arr = self._square_array(data_square, k)
+                if backend == "tpu":
+                    from celestia_tpu.ops import extend_tpu
 
-                # Device computes EDS + axis roots; the tiny DAH merkle tree
-                # over the roots is host-side (latency-bound on device).
-                eds_dev, rows, cols = extend_tpu.extend_roots_device_resident(arr)
-                dah = da.DataAvailabilityHeader(
-                    [r.tobytes() for r in rows], [c.tobytes() for c in cols]
-                )
-                return da.ExtendedDataSquare.from_device(eds_dev, k), dah
-            eds_arr, rows, cols, native_dah = native.extend_and_root_native(arr)
-            dah = da.DataAvailabilityHeader(rows, cols, _hash=native_dah)
-            return da.ExtendedDataSquare(eds_arr, k), dah
-        eds = da.extend_shares(to_bytes(data_square))
-        return eds, da.new_data_availability_header(eds)
+                    try:
+                        # Device computes EDS + axis roots; the tiny DAH
+                        # merkle tree over the roots is host-side
+                        # (latency-bound on device).
+                        eds_dev, rows, cols = (
+                            extend_tpu.extend_roots_device_resident(arr)
+                        )
+                        dah = da.DataAvailabilityHeader(
+                            [r.tobytes() for r in rows],
+                            [c.tobytes() for c in cols],
+                        )
+                        self._tpu_strikes = 0
+                        return da.ExtendedDataSquare.from_device(eds_dev, k), dah
+                    except Exception as exc:  # noqa: BLE001 — degrade to host
+                        backend = self._degrade_tpu("extend_and_hash", exc)
+                        bspan.set(backend=backend)
+                if backend == "native":
+                    eds_arr, rows, cols, native_dah = (
+                        native.extend_and_root_native(arr)
+                    )
+                    dah = da.DataAvailabilityHeader(rows, cols, _hash=native_dah)
+                    return da.ExtendedDataSquare(eds_arr, k), dah
+            eds = da.extend_shares(to_bytes(data_square))
+            return eds, da.new_data_availability_header(eds)
 
     # ------------------------------------------------------------------ #
     # CheckTx (mempool admission). ref: app/check_tx.go:15-51
@@ -563,7 +644,10 @@ class App:
 
         _start = _time.perf_counter()
         try:
-            return self._prepare_proposal_inner(mempool_txs, block_data_size)
+            with tracing.span("app.prepare_proposal",
+                              height=self.height + 1,
+                              txs=len(mempool_txs)):
+                return self._prepare_proposal_inner(mempool_txs, block_data_size)
         finally:
             # ref: app/prepare_proposal.go:23 telemetry.MeasureSince
             metrics.measure_since("prepare_proposal", _start)
@@ -634,7 +718,10 @@ class App:
 
         _start = _time.perf_counter()
         try:
-            return self._process_proposal_inner(block_data)
+            with tracing.span("app.process_proposal",
+                              height=self.height + 1,
+                              txs=len(block_data.txs)):
+                return self._process_proposal_inner(block_data)
         except Exception:  # noqa: BLE001 — panics vote REJECT, not crash
             metrics.incr_counter("process_proposal_panics")
             return False

@@ -95,10 +95,18 @@ def _build_node(home: pathlib.Path, **app_kwargs):
 
 def cmd_start(args):
     from celestia_tpu import log as log_mod
+    from celestia_tpu import tracing
     from celestia_tpu.config import load_config
     from celestia_tpu.node.rpc import RpcServer
 
     log_mod.configure(args.log_level)
+    # flight recorder live for the whole run (/debug/flight next to
+    # /metrics); --trace-out additionally collects EVERY span and writes
+    # Chrome trace-event JSON (Perfetto-loadable) at shutdown
+    tracing.enable()
+    recording = None
+    if getattr(args, "trace_out", None):
+        recording = tracing.start_recording()
     home = _home(args)
     flag_overrides = {}
     if args.block_time is not None:
@@ -181,6 +189,10 @@ def cmd_start(args):
         if grpc_server is not None:
             grpc_server.stop()
         node.save_snapshot()
+        if recording is not None:
+            recording.stop()
+            path = recording.write(args.trace_out)
+            print(f"trace written: {path} ({len(recording.spans)} spans)")
         print("node stopped")
 
 
@@ -496,6 +508,10 @@ def main(argv=None):
                               "the measured winner per square size)")
     p_start.add_argument("--log-level", default="info",
                          choices=["debug", "info", "warning", "error"])
+    p_start.add_argument("--trace-out", default=None, metavar="PATH",
+                         help="write Chrome trace-event JSON of every "
+                              "span to PATH at shutdown (the flight "
+                              "recorder at /debug/flight is always on)")
 
     p_export = sub.add_parser("export")
     p_export.add_argument("--for-zero-height", action="store_true")

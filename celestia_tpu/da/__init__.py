@@ -18,6 +18,7 @@ import hashlib
 import numpy as np
 
 from celestia_tpu import namespace as ns
+from celestia_tpu import tracing
 from celestia_tpu.appconsts import (
     DEFAULT_SQUARE_SIZE_UPPER_BOUND,
     MIN_SQUARE_SIZE,
@@ -152,11 +153,17 @@ class ExtendedDataSquare:
     def row_roots(self) -> list[bytes]:
         # roots consume every cell — materialize once, then host rows
         _ = self.data
-        return [_axis_root(self.row(i), i, self.original_width) for i in range(self.width)]
+        with tracing.span("extend.nmt.rows", backend="host",
+                          width=self.width):
+            return [_axis_root(self.row(i), i, self.original_width)
+                    for i in range(self.width)]
 
     def col_roots(self) -> list[bytes]:
         _ = self.data
-        return [_axis_root(self.col(j), j, self.original_width) for j in range(self.width)]
+        with tracing.span("extend.nmt.cols", backend="host",
+                          width=self.width):
+            return [_axis_root(self.col(j), j, self.original_width)
+                    for j in range(self.width)]
 
 
 def erasured_leaf_namespace(
@@ -205,20 +212,21 @@ def extend_shares(shares: list[bytes] | np.ndarray) -> ExtendedDataSquare:
     if flat.shape[1] != SHARE_SIZE:
         raise ValueError(f"shares must be {SHARE_SIZE} bytes")
 
-    q0 = flat.reshape(k, k, SHARE_SIZE)
-    eds = np.zeros((2 * k, 2 * k, SHARE_SIZE), dtype=np.uint8)
-    eds[:k, :k] = q0
-    # Q1: extend each original row. leopard_encode is row-batched: shape
-    # (k shards, size); here the "shards" axis is the column index.
-    for i in range(k):
-        eds[i, k:] = gf256.leopard_encode(q0[i])
-    # Q2: extend each original column.
-    for j in range(k):
-        eds[k:, j] = gf256.leopard_encode(q0[:, j])
-    # Q3: extend the Q2 rows (rsmt2d extends the extended rows horizontally).
-    for i in range(k, 2 * k):
-        eds[i, k:] = gf256.leopard_encode(eds[i, :k])
-    return ExtendedDataSquare(eds, k)
+    with tracing.span("extend.rs", backend="host", k=k):
+        q0 = flat.reshape(k, k, SHARE_SIZE)
+        eds = np.zeros((2 * k, 2 * k, SHARE_SIZE), dtype=np.uint8)
+        eds[:k, :k] = q0
+        # Q1: extend each original row. leopard_encode is row-batched: shape
+        # (k shards, size); here the "shards" axis is the column index.
+        for i in range(k):
+            eds[i, k:] = gf256.leopard_encode(q0[i])
+        # Q2: extend each original column.
+        for j in range(k):
+            eds[k:, j] = gf256.leopard_encode(q0[:, j])
+        # Q3: extend the Q2 rows (rsmt2d extends the extended rows horizontally).
+        for i in range(k, 2 * k):
+            eds[i, k:] = gf256.leopard_encode(eds[i, :k])
+        return ExtendedDataSquare(eds, k)
 
 
 @dataclasses.dataclass
@@ -231,7 +239,11 @@ class DataAvailabilityHeader:
         """Merkle root over (row_roots ‖ column_roots).
         ref: pkg/da/data_availability_header.go:92-108"""
         if self._hash is None:
-            self._hash = merkle_root(list(self.row_roots) + list(self.column_roots))
+            with tracing.span("extend.dah", backend="host",
+                              roots=len(self.row_roots) * 2):
+                self._hash = merkle_root(
+                    list(self.row_roots) + list(self.column_roots)
+                )
         return self._hash
 
     def to_json(self) -> dict:

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from celestia_tpu import tracing
 from celestia_tpu.appconsts import SHARE_SIZE
 from celestia_tpu.ops import gf256
 
@@ -86,34 +87,47 @@ def repair(
     UnrepairableError when the erasure pattern is not decodable and
     ValueError when recomputed roots mismatch the provided DAH roots.
     """
+    from celestia_tpu.telemetry import metrics
+
     width = shares.shape[0]
     k = width // 2
-    eds = np.array(shares, dtype=np.uint8, copy=True)
-    eds[~present] = 0
-    present = present.copy()
+    with tracing.span("repair.host", backend="host", k=k,
+                      missing=int((~present).sum())) as rspan, \
+            metrics.measure("repair", backend="host"):
+        eds = np.array(shares, dtype=np.uint8, copy=True)
+        eds[~present] = 0
+        present = present.copy()
 
-    while not present.all():
-        progress = False
-        # rows, then columns
-        for transpose in (False, True):
-            view = eds.transpose(1, 0, 2) if transpose else eds
-            mask = present.T if transpose else present
-            todo = [
-                i
-                for i in range(width)
-                if not mask[i].all() and mask[i].sum() >= k
-            ]
-            if todo:
-                _solve_sweep_batched(view, mask, todo, k)
-                progress = True
-        if not progress:
-            raise UnrepairableError(
-                f"impossible to recover: {int((~present).sum())} cells still missing"
-            )
+        n_sweeps = 0
+        while not present.all():
+            progress = False
+            # rows, then columns
+            for transpose in (False, True):
+                view = eds.transpose(1, 0, 2) if transpose else eds
+                mask = present.T if transpose else present
+                todo = [
+                    i
+                    for i in range(width)
+                    if not mask[i].all() and mask[i].sum() >= k
+                ]
+                if todo:
+                    with tracing.span(
+                        "repair.sweep", backend="host", k=k,
+                        axis="col" if transpose else "row", axes=len(todo),
+                    ):
+                        _solve_sweep_batched(view, mask, todo, k)
+                    n_sweeps += 1
+                    progress = True
+            if not progress:
+                raise UnrepairableError(
+                    f"impossible to recover: {int((~present).sum())} cells still missing"
+                )
+        rspan.set(sweeps=n_sweeps)
 
-    if row_roots is not None or col_roots is not None:
-        _verify_roots(eds, k, row_roots, col_roots)
-    return eds
+        if row_roots is not None or col_roots is not None:
+            with tracing.span("repair.verify", backend="host", k=k):
+                _verify_roots(eds, k, row_roots, col_roots)
+        return eds
 
 
 def repair_eds(

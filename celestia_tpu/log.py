@@ -50,6 +50,16 @@ class StructuredLogger:
 
     def _emit(self, level: int, msg: str, kv: dict) -> None:
         if self._log.isEnabledFor(level):
+            # log↔trace correlation: when a span is open on this thread,
+            # stamp its id so a trace and the log tell one story
+            try:
+                from celestia_tpu import tracing
+
+                sp = tracing.current()
+                if sp is not None and sp.span_id is not None:
+                    kv.setdefault("span_id", sp.span_id)
+            except Exception:  # noqa: BLE001 — logging never breaks on tracing
+                pass
             self._log.log(level, msg, extra={"kv": kv})
 
     def debug(self, msg: str, **kv) -> None:

@@ -10,6 +10,7 @@ import pathlib
 import threading
 import time
 
+from celestia_tpu import tracing
 from celestia_tpu.app import App
 from celestia_tpu.app.app import ProposalBlockData, TxResult
 from celestia_tpu.log import logger
@@ -337,6 +338,15 @@ class Node:
 
     def _apply_block_locked(self, proposal, block_time: float,
                             own: bool, evidence: list | None = None) -> Block:
+        with tracing.span("node.apply_block", height=self.app.height + 1,
+                          txs=len(proposal.txs),
+                          square_size=proposal.square_size):
+            return self._apply_block_traced(
+                proposal, block_time, own, evidence
+            )
+
+    def _apply_block_traced(self, proposal, block_time: float,
+                            own: bool, evidence: list | None = None) -> Block:
         t0 = time.perf_counter()
         if not self.app.process_proposal(proposal):
             if own:
@@ -387,11 +397,13 @@ class Node:
             # instead of a pure-host re-extension. Cache-only: any
             # failure falls back to block_eds reconstruction.
             try:
-                eds = self.app.extend_block(proposal.txs)
-                with self._lock:
-                    self._eds_cache[block.height] = eds
-                    while len(self._eds_cache) > 2:
-                        self._eds_cache.popitem(last=False)
+                with tracing.span("node.extend_retention",
+                                  height=block.height):
+                    eds = self.app.extend_block(proposal.txs)
+                    with self._lock:
+                        self._eds_cache[block.height] = eds
+                        while len(self._eds_cache) > 2:
+                            self._eds_cache.popitem(last=False)
             except Exception as e:  # noqa: BLE001 — retention is a cache
                 log.info("eds retention failed", error=str(e))
 

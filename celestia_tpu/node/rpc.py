@@ -11,9 +11,13 @@ from __future__ import annotations
 import http.server
 import json
 import threading
+from typing import TYPE_CHECKING
 
+from celestia_tpu import tracing
 from celestia_tpu.log import logger
-from celestia_tpu.node.node import Node
+
+if TYPE_CHECKING:  # annotation-only: keeps this module stdlib-importable
+    from celestia_tpu.node.node import Node
 
 log = logger("rpc")
 
@@ -55,6 +59,9 @@ def _handler_for(node: Node):
             pass
 
         def _reply(self, payload: dict, status: int = 200) -> None:
+            sp = tracing.current()  # the rpc.request span, when tracing
+            if sp is not None:
+                sp.set(status=status)
             body = json.dumps(payload).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
@@ -63,6 +70,11 @@ def _handler_for(node: Node):
             self.wfile.write(body)
 
         def do_GET(self):
+            with tracing.span("rpc.request", method="GET",
+                              path=self.path.split("?", 1)[0]):
+                self._route_get()
+
+        def _route_get(self):
             parts = [p for p in self.path.split("/") if p]
             try:
                 if parts == ["metrics"]:
@@ -74,6 +86,17 @@ def _handler_for(node: Node):
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif parts == ["debug", "flight"]:
+                    # the flight recorder: the last N finished spans
+                    # (tracing ring buffer), the post-incident "what was
+                    # the node doing just now" view next to /metrics
+                    self._reply(
+                        {
+                            "enabled": tracing.enabled(),
+                            "capacity": tracing.flight_capacity(),
+                            "spans": tracing.flight(),
+                        }
+                    )
                 elif parts == ["status"]:
                     self._reply(
                         {
@@ -653,6 +676,10 @@ def _handler_for(node: Node):
                 self._reply({"error": "unknown route"}, 404)
 
         def do_POST(self):
+            with tracing.span("rpc.request", method="POST", path=self.path):
+                self._route_post()
+
+        def _route_post(self):
             length = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(length) or b"{}")
             parts = [p for p in self.path.split("/") if p]

@@ -42,6 +42,7 @@ import numpy as np
 
 from celestia_tpu import faults
 from celestia_tpu import namespace as ns
+from celestia_tpu import tracing
 from celestia_tpu.appconsts import (
     CONTINUATION_SPARSE_SHARE_CONTENT_SIZE as CONT_SPARSE,
     FIRST_SPARSE_SHARE_CONTENT_SIZE as FIRST_SPARSE,
@@ -202,10 +203,18 @@ def _jitted_roots_for_k(k: int):
 def extend_roots_device(shares: np.ndarray):
     """Host deployment entry: (k,k,512) uint8 -> numpy (eds, row_roots,
     col_roots); the caller computes the DAH hash host-side (da module)."""
-    faults.fire("device.extend", entry="extend_roots_device")
-    k = shares.shape[0]
-    eds, rows, cols = _jitted_roots_for_k(k)(jnp.asarray(shares))
-    return np.asarray(eds), np.asarray(rows), np.asarray(cols)
+    k = int(shares.shape[0])
+    with tracing.span("extend.device", backend="tpu", k=k,
+                      entry="extend_roots_device"):
+        faults.fire("device.extend", entry="extend_roots_device")
+        with tracing.span("extend.stage", backend="tpu", k=k):
+            dev = jnp.asarray(shares)
+        # RS extend + NMT reduction are ONE fused XLA program; the span
+        # covers dispatch through the host fetch of all three outputs
+        with tracing.span("extend.rs_nmt", backend="tpu", k=k,
+                          fused="rs+nmt"):
+            eds, rows, cols = _jitted_roots_for_k(k)(dev)
+            return np.asarray(eds), np.asarray(rows), np.asarray(cols)
 
 
 def extend_roots_device_resident(shares: np.ndarray):
@@ -217,10 +226,16 @@ def extend_roots_device_resident(shares: np.ndarray):
     block store actually serves shares; the repair path consumes the
     handle directly (ops/repair_tpu.stage_resident_repair) with no
     host round-trip. ref: app/extend_block.go:14."""
-    faults.fire("device.extend", entry="extend_roots_device_resident")
     k = int(shares.shape[0])
-    eds, rows, cols = _jitted_roots_for_k(k)(jnp.asarray(shares))
-    return eds, np.asarray(rows), np.asarray(cols)
+    with tracing.span("extend.device", backend="tpu", k=k,
+                      entry="extend_roots_device_resident"):
+        faults.fire("device.extend", entry="extend_roots_device_resident")
+        with tracing.span("extend.stage", backend="tpu", k=k):
+            dev = jnp.asarray(shares)
+        with tracing.span("extend.rs_nmt", backend="tpu", k=k,
+                          fused="rs+nmt"):
+            eds, rows, cols = _jitted_roots_for_k(k)(dev)
+            return eds, np.asarray(rows), np.asarray(cols)
 
 
 @functools.lru_cache(maxsize=8)
@@ -239,8 +254,10 @@ def eds_roots_device(eds):
     from Q0 on device, so a device-resident EDS (repair output, extend
     handle) is verified without fetching a single share byte."""
     k = int(eds.shape[0]) // 2
-    rows, cols = _jitted_eds_roots(k)(jnp.asarray(eds))
-    return np.asarray(rows), np.asarray(cols)
+    with tracing.span("extend.nmt", backend="tpu", k=k,
+                      entry="eds_roots_device"):
+        rows, cols = _jitted_eds_roots(k)(jnp.asarray(eds))
+        return np.asarray(rows), np.asarray(cols)
 
 
 # ------------------------------------------------------------------ #
@@ -385,6 +402,16 @@ def assembled_roots(
         # cells if starts are not strictly ascending — fail LOUDLY here
         # rather than sign a proposal with corrupt roots
         raise ValueError("blob_start must be strictly ascending")
+    with tracing.span("extend.assemble", backend="tpu", k=k,
+                      blobs=len(ns_table), host_cells=len(host_pos)):
+        return _assembled_roots_traced(
+            arena, host_shares, host_pos, host_row, blob_start,
+            blob_nshares, blob_off, blob_len, ns_table, k, s)
+
+
+def _assembled_roots_traced(arena, host_shares, host_pos, host_row,
+                            blob_start, blob_nshares, blob_off, blob_len,
+                            ns_table, k, s):
     h_pad = _pow2_at_least(max(len(host_shares), 1), 16)
     b_pad = _pow2_at_least(max(len(ns_table), 1), 8)
     hc_pad = _pow2_at_least(max(len(host_pos), 1), 16)
@@ -514,10 +541,16 @@ def _jitted_roots_noeds(k: int):
 def roots_device(shares: np.ndarray):
     """Host entry: (k,k,512) uint8 -> numpy (row_roots, col_roots),
     jit-cached, EDS never materialized as an output."""
-    faults.fire("device.extend", entry="roots_device")
     k = int(shares.shape[0])
-    rows, cols = _jitted_roots_noeds(k)(jnp.asarray(shares))
-    return np.asarray(rows), np.asarray(cols)
+    with tracing.span("extend.device", backend="tpu", k=k,
+                      entry="roots_device"):
+        faults.fire("device.extend", entry="roots_device")
+        with tracing.span("extend.stage", backend="tpu", k=k):
+            dev = jnp.asarray(shares)
+        with tracing.span("extend.rs_nmt", backend="tpu", k=k,
+                          fused="rs+nmt"):
+            rows, cols = _jitted_roots_noeds(k)(dev)
+            return np.asarray(rows), np.asarray(cols)
 
 
 def batched_roots_device(shares):
@@ -535,22 +568,30 @@ def batched_roots_device(shares):
     same `_rows_cols_only` core, so results cannot diverge."""
     b = len(shares)
     k = int(shares[0].shape[0])
-    if _batch_chunk(k, b) >= b:
-        stacked = shares if isinstance(shares, np.ndarray) else np.stack(shares)
-        rows, cols = _jitted_batched_roots(k)(jnp.asarray(stacked))
-        return np.asarray(rows), np.asarray(cols)
-    fn = _jitted_roots_noeds(k)
-    outs = [fn(jnp.asarray(shares[i])) for i in range(b)]  # async queue
-    return (
-        np.stack([np.asarray(r) for r, _c in outs]),
-        np.stack([np.asarray(c) for _r, c in outs]),
-    )
+    with tracing.span("extend.device", backend="tpu", k=k, batch=b,
+                      entry="batched_roots_device"):
+        if _batch_chunk(k, b) >= b:
+            stacked = shares if isinstance(shares, np.ndarray) else np.stack(shares)
+            rows, cols = _jitted_batched_roots(k)(jnp.asarray(stacked))
+            return np.asarray(rows), np.asarray(cols)
+        fn = _jitted_roots_noeds(k)
+        outs = [fn(jnp.asarray(shares[i])) for i in range(b)]  # async queue
+        return (
+            np.stack([np.asarray(r) for r, _c in outs]),
+            np.stack([np.asarray(c) for _r, c in outs]),
+        )
 
 
 def extend_and_root_device(shares: np.ndarray):
     """Host entry: (k,k,512) uint8 numpy -> numpy (eds, row_roots, col_roots, dah)."""
-    faults.fire("device.extend", entry="extend_and_root_device")
-    k = shares.shape[0]
-    fn = _jitted_for_k(k)
-    eds, rows, cols, dah = fn(jnp.asarray(shares))
-    return (np.asarray(eds), np.asarray(rows), np.asarray(cols), np.asarray(dah))
+    k = int(shares.shape[0])
+    with tracing.span("extend.device", backend="tpu", k=k,
+                      entry="extend_and_root_device"):
+        faults.fire("device.extend", entry="extend_and_root_device")
+        with tracing.span("extend.stage", backend="tpu", k=k):
+            dev = jnp.asarray(shares)
+        with tracing.span("extend.rs_nmt", backend="tpu", k=k,
+                          fused="rs+nmt+dah"):
+            eds, rows, cols, dah = _jitted_for_k(k)(dev)
+            return (np.asarray(eds), np.asarray(rows), np.asarray(cols),
+                    np.asarray(dah))

@@ -31,7 +31,7 @@ import functools
 
 import numpy as np
 
-from celestia_tpu import faults
+from celestia_tpu import faults, tracing
 from celestia_tpu.ops import gf256
 from celestia_tpu.ops.rs_tpu import expand_bit_matrix, pack_bits, unpack_bits
 
@@ -270,10 +270,16 @@ def stage_resident_repair(
         # cells are zeroed on DEVICE (same jnp.where the resident path
         # uses), which also drops the former host-side 32 MB np.where
         # pass from the critical path. Byte-identical either way.
-        dev_raw = transfers.device_put_chunked(eds, device, site="repair.stage")
+        with tracing.span("repair.upload", backend="tpu", k=k):
+            dev_raw = transfers.device_put_chunked(
+                eds, device, site="repair.stage"
+            )
     else:
         dev_raw = eds
-    plans = plan_sweeps(present, k)
+    with tracing.span("repair.plan", backend="host", k=k,
+                      missing=int((~present).sum())) as _plan_span:
+        plans = plan_sweeps(present, k)
+        _plan_span.set(sweeps=len(plans))
 
     # Chunk the axis batch so the int32 matmul accumulator stays bounded
     # (w × 8w × B int32 at k=128 is ~2 GB; 4 chunks keep peaks ~0.5 GB).
@@ -292,10 +298,12 @@ def stage_resident_repair(
     ]
 
     def run():
-        out = dev
-        for sb, ub, wr, tr in staged:
-            out = step(out, sb, ub, wr, t2, bitmul, transpose=tr)
-        return out
+        with tracing.span("repair.sweep", backend="tpu", k=k,
+                          n_sweeps=len(staged)):
+            out = dev
+            for sb, ub, wr, tr in staged:
+                out = step(out, sb, ub, wr, t2, bitmul, transpose=tr)
+            return out
 
     return run, len(plans)
 
@@ -317,18 +325,30 @@ def repair_resident_verified(
     the DAH roots host-side (2·2k·90 bytes fetched, not (2k)²·512).
     Returns the repaired square as a DEVICE buffer; fetching bytes is
     the caller's lazy decision. Raises ValueError on root mismatch."""
-    faults.fire("device.repair", entry="repair_resident_verified")
-    from celestia_tpu.ops import extend_tpu
+    from celestia_tpu.telemetry import metrics
 
-    run, _ = stage_resident_repair(eds, present, device)
-    fixed = run()
-    if row_roots is not None or col_roots is not None:
-        rows, cols = extend_tpu.eds_roots_device(fixed)
-        if row_roots is not None and [r.tobytes() for r in rows] != list(row_roots):
-            raise ValueError("repaired row roots do not match DAH")
-        if col_roots is not None and [c.tobytes() for c in cols] != list(col_roots):
-            raise ValueError("repaired column roots do not match DAH")
-    return fixed
+    k = int(eds.shape[0]) // 2
+    with tracing.span("repair.device", backend="tpu", k=k,
+                      entry="repair_resident_verified",
+                      missing=int((~present).sum())), \
+            metrics.measure("repair", backend="tpu"):
+        faults.fire("device.repair", entry="repair_resident_verified")
+        from celestia_tpu.ops import extend_tpu
+
+        run, _ = stage_resident_repair(eds, present, device)
+        fixed = run()
+        if row_roots is not None or col_roots is not None:
+            with tracing.span("repair.verify", backend="tpu", k=k):
+                rows, cols = extend_tpu.eds_roots_device(fixed)
+                if row_roots is not None and [
+                    r.tobytes() for r in rows
+                ] != list(row_roots):
+                    raise ValueError("repaired row roots do not match DAH")
+                if col_roots is not None and [
+                    c.tobytes() for c in cols
+                ] != list(col_roots):
+                    raise ValueError("repaired column roots do not match DAH")
+        return fixed
 
 
 def repair_tpu(
@@ -341,10 +361,16 @@ def repair_tpu(
     is fetched once at the end. Bit-exact vs da.repair (tests pin all
     three implementations together).
     """
-    faults.fire("device.repair", entry="repair_tpu")
-    from celestia_tpu.ops import transfers
+    from celestia_tpu.telemetry import metrics
 
-    run, _ = stage_resident_repair(eds, present, device)
-    # overlapped row-block download (all D2H DMAs in flight at once)
-    # instead of one monolithic blocking device_get
-    return transfers.device_get_chunked(run(), site="repair.fetch")
+    k = int(eds.shape[0]) // 2
+    with tracing.span("repair.device", backend="tpu", k=k,
+                      entry="repair_tpu", missing=int((~present).sum())), \
+            metrics.measure("repair", backend="tpu"):
+        faults.fire("device.repair", entry="repair_tpu")
+        from celestia_tpu.ops import transfers
+
+        run, _ = stage_resident_repair(eds, present, device)
+        # overlapped row-block download (all D2H DMAs in flight at once)
+        # instead of one monolithic blocking device_get
+        return transfers.device_get_chunked(run(), site="repair.fetch")

@@ -52,19 +52,39 @@ def _record(site: str, direction: str, nbytes: int, start: float) -> None:
 
     For async uploads the ms counter measures time spent *in the call*
     (dispatch wall), not DMA completion — that is the quantity overlap
-    is supposed to shrink. Bytes are exact either way."""
+    is supposed to shrink. Bytes are exact either way.
+
+    The same timing doubles as a finished `transfer.<site>` span
+    (tracing.emit): the span's duration and the transfer_ms increment
+    come from one measurement, and the span carries the CUMULATIVE
+    per-site counters as attributes so a trace shows both this call and
+    the running total the budgets are asserted against."""
     try:
         from celestia_tpu.telemetry import metrics
 
         metrics.incr_counter(
             "transfer_bytes", float(nbytes), site=site, direction=direction
         )
+        elapsed = time.perf_counter() - start
         metrics.incr_counter(
-            "transfer_ms",
-            (time.perf_counter() - start) * 1e3,
-            site=site,
-            direction=direction,
+            "transfer_ms", elapsed * 1e3, site=site, direction=direction
         )
+        # same measurement, histogram form: /metrics gets per-site
+        # transfer_seconds buckets next to the running counters
+        metrics.observe("transfer", elapsed, site=site, direction=direction)
+        from celestia_tpu import tracing
+
+        if tracing.enabled():
+            tracing.emit(
+                f"transfer.{site}", start,
+                site=site, direction=direction, bytes=nbytes,
+                total_bytes=metrics.get_counter(
+                    "transfer_bytes", site=site, direction=direction
+                ),
+                total_ms=round(metrics.get_counter(
+                    "transfer_ms", site=site, direction=direction
+                ), 3),
+            )
     except Exception:  # noqa: BLE001 — metrics must never break transfers
         pass
 

@@ -25,7 +25,7 @@ import time
 import grpc
 import numpy as np
 
-from celestia_tpu import faults
+from celestia_tpu import faults, tracing
 from celestia_tpu.appconsts import SHARE_SIZE
 from celestia_tpu.service import wire
 
@@ -58,31 +58,36 @@ class CodecBackend:
         limit degrade stickily to host-only."""
         from celestia_tpu.telemetry import metrics
 
-        try:
-            out = fn()
-        except Exception as e:  # noqa: BLE001 — any device failure degrades
-            from celestia_tpu.da.repair import UnrepairableError
+        with tracing.span("codec.backend", op=op, backend="tpu") as bspan:
+            try:
+                out = fn()
+            except Exception as e:  # noqa: BLE001 — any device failure degrades
+                from celestia_tpu.da.repair import UnrepairableError
 
-            if isinstance(e, (ValueError, UnrepairableError)):
-                # a data/shape condition, not a device fault: the host
-                # path would reject it identically — no strike, no retry
-                raise
-            self._tpu_strikes += 1
-            metrics.incr_counter("codec_tpu_fallback_total", op=op)
-            log.warning(
-                "TPU %s failed (%s) — host fallback, strike %d/%d",
-                op, e, self._tpu_strikes, self.tpu_strike_limit,
-            )
-            if self._tpu_strikes >= self.tpu_strike_limit and self.use_tpu:
-                self.use_tpu = False
-                metrics.incr_counter("codec_tpu_disabled_total")
-                log.error(
-                    "TPU path disabled after %d consecutive failures — "
-                    "serving from the host backend", self._tpu_strikes,
+                if isinstance(e, (ValueError, UnrepairableError)):
+                    # a data/shape condition, not a device fault: the host
+                    # path would reject it identically — no strike, no retry
+                    raise
+                self._tpu_strikes += 1
+                metrics.incr_counter("codec_tpu_fallback_total", op=op)
+                log.warning(
+                    "TPU %s failed (%s) — host fallback, strike %d/%d",
+                    op, e, self._tpu_strikes, self.tpu_strike_limit,
                 )
-            return fallback()
-        self._tpu_strikes = 0  # only CONSECUTIVE failures degrade
-        return out
+                if self._tpu_strikes >= self.tpu_strike_limit and self.use_tpu:
+                    self.use_tpu = False
+                    metrics.incr_counter("codec_tpu_disabled_total")
+                    log.error(
+                        "TPU path disabled after %d consecutive failures — "
+                        "serving from the host backend", self._tpu_strikes,
+                    )
+                bspan.set(backend="host", degraded=True,
+                          strikes=self._tpu_strikes,
+                          disabled=not self.use_tpu,
+                          cause=type(e).__name__)
+                return fallback()
+            self._tpu_strikes = 0  # only CONSECUTIVE failures degrade
+            return out
 
     @staticmethod
     def _tpu_available() -> bool:
@@ -180,11 +185,13 @@ class CodecBackend:
         return host()
 
 
-def _handler(fn, req_cls, resp_marshal):
+def _handler(fn, req_cls, resp_marshal, method: str = ""):
     def handle(request_bytes, context):
         try:
-            faults.fire("codec.backend")
-            return resp_marshal(fn(req_cls.unmarshal(request_bytes)))
+            with tracing.span("codec.rpc", method=method,
+                              request_bytes=len(request_bytes)):
+                faults.fire("codec.backend")
+                return resp_marshal(fn(req_cls.unmarshal(request_bytes)))
         except ValueError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         except (faults.DeviceUnavailable, faults.TransportFault) as e:
@@ -238,10 +245,14 @@ class CodecServer:
             ).marshal()
 
         handlers = {
-            "Encode": _handler(encode, wire.EncodeRequest, lambda x: x),
-            "ExtendAndRoot": _handler(extend_and_root, wire.EncodeRequest, lambda x: x),
-            "Roots": _handler(roots, wire.EdsRequest, lambda x: x),
-            "Repair": _handler(repair, wire.RepairRequest, lambda x: x),
+            "Encode": _handler(encode, wire.EncodeRequest, lambda x: x,
+                               method="Encode"),
+            "ExtendAndRoot": _handler(extend_and_root, wire.EncodeRequest,
+                                      lambda x: x, method="ExtendAndRoot"),
+            "Roots": _handler(roots, wire.EdsRequest, lambda x: x,
+                              method="Roots"),
+            "Repair": _handler(repair, wire.RepairRequest, lambda x: x,
+                               method="Repair"),
         }
         return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
 
@@ -286,14 +297,17 @@ class CodecClient:
         )
         last = None
         for attempt in range(self.retries + 1):
-            try:
-                corrupt = faults.fire("codec.call", method=method)
-                out = fn(request_bytes, timeout=self.timeout)
-                return corrupt(out) if corrupt is not None else out
-            except faults.TransportFault as e:
-                last, code = e, grpc.StatusCode.UNAVAILABLE
-            except grpc.RpcError as e:
-                last, code = e, e.code()
+            with tracing.span("codec.call", method=method,
+                              attempt=attempt) as cspan:
+                try:
+                    corrupt = faults.fire("codec.call", method=method)
+                    out = fn(request_bytes, timeout=self.timeout)
+                    return corrupt(out) if corrupt is not None else out
+                except faults.TransportFault as e:
+                    last, code = e, grpc.StatusCode.UNAVAILABLE
+                except grpc.RpcError as e:
+                    last, code = e, e.code()
+                cspan.set(error=code.name)
             if code not in self._RETRY_CODES or attempt >= self.retries:
                 raise last
             metrics.incr_counter("codec_call_retry_total", method=method)

@@ -1,28 +1,110 @@
-"""Telemetry: counters, gauges, and timers with a Prometheus text export.
+"""Telemetry: counters, gauges, and histogram timers with a Prometheus
+text export.
 
 Reference semantics: Cosmos SDK telemetry timers/counters on the proposal
 paths (app/prepare_proposal.go:23, app/process_proposal.go:25,31,
 app/validate_txs.go:60,89) and CometBFT's Prometheus metrics endpoint
 (node.DefaultMetricsProvider, test/util/testnode/full_node.go:56).
+
+Timings are FIXED-BUCKET histograms (ADR-013): the earlier count+sum
+implementation appended every sample to a per-key list, which is an
+unbounded memory leak under sustained serving (a node doing 10 blocks/s
+accumulates ~3.5M floats/key/day) and cannot answer "what is p99".
+A histogram stores len(BUCKETS)+1 integers per key regardless of
+traffic, renders as the standard Prometheus `_bucket`/`_sum`/`_count`
+series, and derives quantiles by linear interpolation within the
+straddling bucket — the same estimator PromQL's histogram_quantile uses.
+
+The exposition format follows the Prometheus text format v0.0.4:
+`# HELP`/`# TYPE` metadata lines, counters exported with the `_total`
+suffix, and label values escaped (`\\`, `\"`, newline).
 """
 
 from __future__ import annotations
 
+import bisect
 import collections
 import threading
 import time
 
+# Bucket bounds in seconds, ~1-2.5-5 per decade from 100 µs to 60 s
+# (ADR-013): sliced transfers sit in the 0.1-1 ms decade, single-square
+# device extends in 1-100 ms, repairs + tunnel-bound fetches in 0.1-10 s,
+# and the 30/60 s tail catches pathological (fault-injected or degraded)
+# requests without folding them into +Inf.
+DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class Histogram:
+    """Fixed-bucket histogram: len(bounds)+1 integer cells + sum/count.
+
+    Memory is O(len(bounds)) regardless of observation count — the
+    regression test observes 1M samples and asserts the footprint is
+    unchanged (tests/test_telemetry.py)."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds=DEFAULT_BUCKETS):
+        self.bounds = tuple(bounds)
+        self.counts = [0] * (len(self.bounds) + 1)  # last cell = +Inf
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        # le is an INCLUSIVE upper bound: first bound >= value
+        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+        self.sum += value
+        self.count += 1
+
+    def quantile(self, q: float) -> float:
+        """Quantile estimate by linear interpolation within the bucket
+        the rank falls in (PromQL histogram_quantile's estimator)."""
+        if self.count == 0:
+            return float("nan")
+        rank = q * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = (
+                    self.bounds[i]
+                    if i < len(self.bounds)
+                    else self.bounds[-1]  # +Inf bucket clamps to last bound
+                )
+                return lo + (hi - lo) * ((rank - cum) / c)
+            cum += c
+        return self.bounds[-1]
+
 
 class Registry:
-    def __init__(self):
+    def __init__(self, buckets=DEFAULT_BUCKETS):
         self._lock = threading.Lock()
+        self._buckets = tuple(buckets)
         self.counters: dict[str, float] = collections.defaultdict(float)
         self.gauges: dict[str, float] = {}
-        self.timings: dict[str, list[float]] = collections.defaultdict(list)
+        self.timings: dict[str, Histogram] = {}
+        # rendered key -> (metric name, sorted (label, value) pairs):
+        # the exposition needs the name/labels split back apart for
+        # HELP/TYPE grouping, suffixing, and label escaping
+        self._families: dict[str, tuple[str, tuple[tuple[str, str], ...]]] = {}
+
+    def _register(self, key: str, name: str, labels: dict) -> None:
+        if key not in self._families:
+            self._families[key] = (
+                name,
+                tuple(sorted((k, str(v)) for k, v in labels.items())),
+            )
 
     def incr_counter(self, name: str, value: float = 1.0, **labels) -> None:
+        key = _key(name, labels)
         with self._lock:
-            self.counters[_key(name, labels)] += value
+            self._register(key, name, labels)
+            self.counters[key] += value
 
     def get_counter(self, name: str, **labels) -> float:
         """Read a counter (0.0 if never incremented) — test/assert helper."""
@@ -30,37 +112,99 @@ class Registry:
             return self.counters.get(_key(name, labels), 0.0)
 
     def set_gauge(self, name: str, value: float, **labels) -> None:
+        key = _key(name, labels)
         with self._lock:
-            self.gauges[_key(name, labels)] = value
+            self._register(key, name, labels)
+            self.gauges[key] = value
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        """Record one histogram observation (seconds)."""
+        key = _key(name, labels)
+        with self._lock:
+            self._register(key, name, labels)
+            hist = self.timings.get(key)
+            if hist is None:
+                hist = self.timings[key] = Histogram(self._buckets)
+            hist.observe(value)
 
     def measure_since(self, name: str, start: float, **labels) -> None:
-        with self._lock:
-            self.timings[_key(name, labels)].append(time.perf_counter() - start)
+        self.observe(name, time.perf_counter() - start, **labels)
 
     def measure(self, name: str, **labels):
         """Context manager timing a block."""
         return _Timer(self, name, labels)
 
-    def prometheus_text(self) -> str:
-        """Render in the Prometheus exposition format."""
-        lines = []
+    def get_timing(self, name: str, **labels) -> Histogram | None:
+        """The histogram behind a timing key (test/assert helper)."""
         with self._lock:
-            for key, value in sorted(self.counters.items()):
-                lines.append(f"{key} {value}")
-            for key, value in sorted(self.gauges.items()):
-                lines.append(f"{key} {value}")
-            for key, samples in sorted(self.timings.items()):
-                base = key.split("{")[0]
-                labels = key[len(base):]
-                lines.append(f"{base}_seconds_count{labels} {len(samples)}")
-                lines.append(f"{base}_seconds_sum{labels} {sum(samples)}")
+            return self.timings.get(_key(name, labels))
+
+    def timing_quantile(self, name: str, q: float, **labels) -> float:
+        """Derive a quantile (e.g. p99: q=0.99) from the bucket counts."""
+        hist = self.get_timing(name, **labels)
+        return float("nan") if hist is None else hist.quantile(q)
+
+    def prometheus_text(self) -> str:
+        """Render in the Prometheus exposition format v0.0.4 (HELP/TYPE
+        metadata, `_total`-suffixed counters, escaped label values,
+        histogram `_bucket`/`_sum`/`_count` series)."""
+        lines: list[str] = []
+        with self._lock:
+            self._render_simple(lines, self.counters, "counter")
+            self._render_simple(lines, self.gauges, "gauge")
+            self._render_histograms(lines)
         return "\n".join(lines) + "\n"
+
+    def _family(self, key: str) -> tuple[str, tuple[tuple[str, str], ...]]:
+        fam = self._families.get(key)
+        if fam is None:  # direct dict writes (tests): bare name, no labels
+            base = key.split("{", 1)[0]
+            fam = (base, ())
+        return fam
+
+    def _render_simple(self, lines: list[str], table: dict,
+                       mtype: str) -> None:
+        by_name: dict[str, list[tuple[tuple[tuple[str, str], ...], float]]] = {}
+        for key, value in table.items():
+            name, labels = self._family(key)
+            if mtype == "counter" and not name.endswith("_total"):
+                name += "_total"
+            by_name.setdefault(name, []).append((labels, value))
+        for name in sorted(by_name):
+            lines.append(f"# HELP {name} {mtype} {name}")
+            lines.append(f"# TYPE {name} {mtype}")
+            for labels, value in sorted(by_name[name]):
+                lines.append(f"{name}{_label_str(labels)} {value}")
+
+    def _render_histograms(self, lines: list[str]) -> None:
+        by_name: dict[str, list[tuple[tuple[tuple[str, str], ...], Histogram]]] = {}
+        for key, hist in self.timings.items():
+            name, labels = self._family(key)
+            by_name.setdefault(f"{name}_seconds", []).append((labels, hist))
+        for name in sorted(by_name):
+            lines.append(f"# HELP {name} histogram {name}")
+            lines.append(f"# TYPE {name} histogram")
+            for labels, hist in sorted(by_name[name], key=lambda e: e[0]):
+                cum = 0
+                for bound, count in zip(hist.bounds, hist.counts):
+                    cum += count
+                    le = (("le", _fmt_bound(bound)),)
+                    lines.append(
+                        f"{name}_bucket{_label_str(labels + le)} {cum}"
+                    )
+                lines.append(
+                    f"{name}_bucket{_label_str(labels + (('le', '+Inf'),))} "
+                    f"{hist.count}"
+                )
+                lines.append(f"{name}_sum{_label_str(labels)} {hist.sum}")
+                lines.append(f"{name}_count{_label_str(labels)} {hist.count}")
 
     def reset(self) -> None:
         with self._lock:
             self.counters.clear()
             self.gauges.clear()
             self.timings.clear()
+            self._families.clear()
 
 
 class _Timer:
@@ -83,6 +227,26 @@ def _key(name: str, labels: dict) -> str:
         return name
     inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
     return f"{name}{{{inner}}}"
+
+
+def _escape(value: str) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline."""
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _label_str(labels: tuple[tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in labels)
+    return f"{{{inner}}}"
+
+
+def _fmt_bound(bound: float) -> str:
+    """Bucket bound rendering: plain decimal, no float noise."""
+    text = f"{bound:.10f}".rstrip("0").rstrip(".")
+    return text if text else "0"
 
 
 # process-global registry (the SDK telemetry singleton analogue)

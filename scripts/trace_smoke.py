@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Trace smoke gate (specs/observability.md acceptance).
+
+Runs one k=32 extend+root through the device entry under a tracing
+recording, writes the Chrome trace-event JSON, and fails (non-zero
+exit) unless:
+
+  1. the file round-trips through json.load and passes
+     tracing.validate_chrome_trace with zero problems,
+  2. the expected extend-stage spans are present
+     (extend.device > extend.stage / extend.rs_nmt), and
+  3. root spans cover >= 90% of the measured wall time of the traced
+     region (the "spans explain the block" acceptance bar).
+
+Runs fine on CPU — JAX_PLATFORMS defaults to cpu here so `make
+trace-smoke` needs no accelerator. The compile happens in a warm-up
+pass OUTSIDE the recording so the traced run reflects steady-state
+dispatch, same convention as bench.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+REQUIRED_SPANS = ("extend.device", "extend.stage", "extend.rs_nmt")
+COVERAGE_FLOOR = 0.90
+
+
+def build_square(k: int, seed: int = 42) -> np.ndarray:
+    """Same construction as bench.py: random payloads, sorted v0
+    namespaces so the NMT ordering invariant holds."""
+    import celestia_tpu.namespace as ns
+
+    rng = np.random.default_rng(seed)
+    flat = rng.integers(0, 256, size=(k * k, 512), dtype=np.uint8)
+    subs = sorted(
+        rng.integers(0, 200, size=(k * k, 10), dtype=np.uint8).tolist()
+    )
+    for i, sub in enumerate(subs):
+        flat[i, :29] = np.frombuffer(
+            ns.new_v0(bytes(sub)).bytes, dtype=np.uint8
+        )
+    return flat.reshape(k, k, 512)
+
+
+def run(k: int, trace_out: str) -> list[str]:
+    """Execute the smoke run; returns a list of problems (empty = pass)."""
+    from celestia_tpu import tracing
+    from celestia_tpu.ops import extend_tpu
+
+    sq = build_square(k)
+    extend_tpu.extend_and_root_device(sq)  # warm-up: compile outside the trace
+
+    with tracing.record() as rec:
+        t0 = time.perf_counter()
+        extend_tpu.extend_and_root_device(sq)
+        wall = time.perf_counter() - t0
+    rec.write(trace_out)
+
+    problems: list[str] = []
+    with open(trace_out) as f:
+        doc = json.load(f)
+    problems += tracing.validate_chrome_trace(doc)
+
+    names = {s.name for s in rec.spans}
+    for want in REQUIRED_SPANS:
+        if want not in names:
+            problems.append(f"missing span {want!r}")
+
+    root_dur = sum(s.duration for s in rec.spans if s.parent_id is None)
+    coverage = root_dur / wall if wall > 0 else 0.0
+    if coverage < COVERAGE_FLOOR:
+        problems.append(
+            f"root-span coverage {coverage:.1%} < {COVERAGE_FLOOR:.0%} "
+            f"of {wall * 1e3:.2f}ms wall"
+        )
+
+    print(
+        f"trace-smoke: k={k} spans={len(rec.spans)} "
+        f"wall={wall * 1e3:.2f}ms coverage={coverage:.1%} -> {trace_out}"
+    )
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--k", type=int, default=32)
+    ap.add_argument("--trace-out", default="/tmp/trace_smoke.json",
+                    metavar="PATH")
+    args = ap.parse_args(argv)
+    problems = run(args.k, args.trace_out)
+    for p in problems:
+        print(f"trace-smoke: FAIL: {p}", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

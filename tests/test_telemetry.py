@@ -1,0 +1,149 @@
+"""Telemetry tests (ADR-013): bounded histogram memory under 1M
+observations, the Prometheus v0.0.4 exposition format (HELP/TYPE,
+`_total` suffixing, label escaping), and the bucket-interpolation
+quantile against a numpy oracle."""
+
+import sys
+
+import numpy as np
+
+from celestia_tpu.telemetry import (
+    DEFAULT_BUCKETS,
+    Histogram,
+    Registry,
+    _escape,
+)
+
+
+class TestHistogramMemory:
+    def test_bounded_after_1m_observations(self):
+        """The regression the histogram rewrite exists for: the old
+        count+sum timer appended every sample to a list. A histogram's
+        footprint must be IDENTICAL after 1M observations."""
+        fresh = Histogram()
+        h = Histogram()
+        baseline = (
+            sys.getsizeof(h.counts)
+            + sys.getsizeof(h.bounds)
+            + sum(sys.getsizeof(c) for c in h.counts)
+        )
+        rng = np.random.default_rng(0)
+        # spread across every decade the bounds cover, plus the +Inf tail
+        for v in rng.lognormal(mean=-6.0, sigma=3.0, size=1_000_000):
+            h.observe(float(v))
+        after = (
+            sys.getsizeof(h.counts)
+            + sys.getsizeof(h.bounds)
+            + sum(sys.getsizeof(c) for c in h.counts)
+        )
+        assert h.count == 1_000_000
+        assert len(h.counts) == len(h.bounds) + 1 == len(fresh.counts)
+        # small-int interning aside, the container sizes cannot grow
+        assert sys.getsizeof(h.counts) == sys.getsizeof(fresh.counts)
+        # per-cell ints stay machine ints (no unbounded object growth)
+        assert after <= baseline + 32 * len(h.counts)
+
+    def test_bucket_assignment_le_is_inclusive(self):
+        h = Histogram(bounds=(0.001, 0.01, 0.1))
+        for v in (0.0005, 0.001, 0.0011, 0.1, 5.0):
+            h.observe(v)
+        # le="0.001" holds exactly-on-bound samples; 5.0 lands in +Inf
+        assert h.counts == [2, 1, 1, 1]
+        assert h.sum == sum((0.0005, 0.001, 0.0011, 0.1, 5.0))
+
+
+class TestPrometheusText:
+    def test_help_type_and_total_suffix(self):
+        r = Registry()
+        r.incr_counter("rpc_requests", route="/status")
+        r.incr_counter("rpc_requests", route="/block")
+        r.incr_counter("repair_ok_total")  # already suffixed: no doubling
+        r.set_gauge("mempool_size", 3)
+        text = r.prometheus_text()
+        assert "# HELP rpc_requests_total counter rpc_requests_total" in text
+        assert "# TYPE rpc_requests_total counter" in text
+        assert 'rpc_requests_total{route="/status"} 1.0' in text
+        assert "repair_ok_total 1.0" in text
+        assert "repair_ok_total_total" not in text
+        assert "# TYPE mempool_size gauge" in text
+        assert "mempool_size 3" in text
+
+    def test_label_value_escaping(self):
+        r = Registry()
+        r.incr_counter("weird", path='a\\b"c\nd')
+        text = r.prometheus_text()
+        assert 'path="a\\\\b\\"c\\nd"' in text
+        assert _escape('\\"' + "\n") == '\\\\\\"\\n'
+
+    def test_histogram_exposition(self):
+        r = Registry()
+        for v in (0.0002, 0.003, 0.003, 0.04, 120.0):
+            r.observe("extend_block", v, path="proposal")
+        text = r.prometheus_text()
+        assert "# TYPE extend_block_seconds histogram" in text
+        # cumulative buckets: le="0.0025" has 1 sample, le="0.005" has 3
+        assert 'extend_block_seconds_bucket{path="proposal",le="0.0025"} 1' in text
+        assert 'extend_block_seconds_bucket{path="proposal",le="0.005"} 3' in text
+        assert 'extend_block_seconds_bucket{path="proposal",le="0.05"} 4' in text
+        # 120 s exceeds every bound: only +Inf sees it
+        assert 'extend_block_seconds_bucket{path="proposal",le="60"} 4' in text
+        assert 'extend_block_seconds_bucket{path="proposal",le="+Inf"} 5' in text
+        assert 'extend_block_seconds_count{path="proposal"} 5' in text
+        sum_line = next(
+            l for l in text.splitlines()
+            if l.startswith("extend_block_seconds_sum")
+        )
+        assert float(sum_line.split()[-1]) == sum((0.0002, 0.003, 0.003, 0.04, 120.0))
+
+    def test_bucket_series_is_monotone(self):
+        r = Registry()
+        rng = np.random.default_rng(1)
+        for v in rng.uniform(0, 2, size=500):
+            r.observe("t", float(v))
+        counts = [
+            int(l.split()[-1])
+            for l in r.prometheus_text().splitlines()
+            if l.startswith("t_seconds_bucket")
+        ]
+        assert counts == sorted(counts)
+        assert counts[-1] == 500  # +Inf == _count
+
+    def test_measure_and_quantile_helpers(self):
+        r = Registry()
+        with r.measure("op", backend="host"):
+            pass
+        h = r.get_timing("op", backend="host")
+        assert h is not None and h.count == 1
+        assert r.timing_quantile("op", 0.5, backend="host") >= 0.0
+        assert np.isnan(r.timing_quantile("missing", 0.5))
+
+
+class TestQuantileOracle:
+    def test_against_numpy_within_straddling_bucket(self):
+        """The interpolated estimate must land inside the bucket that
+        contains the true (numpy) quantile — the precision contract of
+        a fixed-bucket histogram."""
+        import bisect
+
+        rng = np.random.default_rng(42)
+        samples = rng.lognormal(mean=-4.5, sigma=1.5, size=20_000)
+        samples = np.clip(samples, 1e-5, 59.0)  # stay inside the bounds
+        h = Histogram()
+        for v in samples:
+            h.observe(float(v))
+        for q in (0.10, 0.50, 0.90, 0.99):
+            oracle = float(np.quantile(samples, q))
+            est = h.quantile(q)
+            i = bisect.bisect_left(DEFAULT_BUCKETS, oracle)
+            lo = DEFAULT_BUCKETS[i - 1] if i > 0 else 0.0
+            hi = DEFAULT_BUCKETS[i]
+            assert lo <= est <= hi, (
+                f"q={q}: estimate {est} outside bucket [{lo}, {hi}] "
+                f"containing numpy quantile {oracle}"
+            )
+
+    def test_quantile_edge_cases(self):
+        h = Histogram()
+        assert np.isnan(h.quantile(0.5))  # empty
+        h.observe(1e9)  # +Inf bucket only
+        assert h.quantile(0.99) == DEFAULT_BUCKETS[-1]  # clamped, finite

@@ -1,0 +1,223 @@
+"""Tracing tests (specs/observability.md): span nesting/ordering,
+explicit parent handoff, fault-site attribution through an ops call,
+the Chrome trace-event export schema, and the /debug/flight recorder
+round-trip over a live RPC server."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from celestia_tpu import faults, tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    tracing.reset()
+    yield
+    tracing.reset()
+
+
+def _square(k: int, seed: int = 7) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=(k, k, 512), dtype=np.uint8)
+
+
+class TestSpans:
+    def test_disabled_path_is_shared_noop(self):
+        assert not tracing.enabled()
+        s1 = tracing.span("a", k=1)
+        s2 = tracing.span("b")
+        assert s1 is s2  # one stateless object serves every call site
+        with s1 as sp:
+            assert sp.set(x=1) is sp
+            assert tracing.current() is None
+        assert tracing.flight() == []
+
+    def test_nesting_ordering_and_parent_ids(self):
+        with tracing.record() as rec:
+            with tracing.span("outer", k=32) as outer:
+                with tracing.span("mid") as mid:
+                    assert tracing.current() is mid
+                    with tracing.span("inner"):
+                        pass
+                with tracing.span("sibling"):
+                    pass
+        # children finish before parents: inner, mid, sibling, outer
+        names = [s.name for s in rec.spans]
+        assert names == ["inner", "mid", "sibling", "outer"]
+        by_name = {s.name: s for s in rec.spans}
+        assert by_name["outer"].parent_id is None
+        assert by_name["mid"].parent_id == outer.span_id
+        assert by_name["inner"].parent_id == mid.span_id
+        assert by_name["sibling"].parent_id == outer.span_id
+        assert by_name["outer"].attrs["k"] == 32
+        # children are contained in the parent's interval
+        for child in ("mid", "inner", "sibling"):
+            s = by_name[child]
+            assert s.start >= by_name["outer"].start
+            assert s.start + s.duration <= (
+                by_name["outer"].start + by_name["outer"].duration + 1e-6
+            )
+
+    def test_explicit_parent_handoff_across_threads(self):
+        got = {}
+        with tracing.record() as rec:
+            with tracing.span("producer") as prod:
+                handle = tracing.current()
+
+                def consumer():
+                    # fresh thread: empty stack, so parent= is the only link
+                    assert tracing.current() is None
+                    with tracing.span("consumer", parent=handle) as sp:
+                        got["parent"] = sp.parent_id
+
+                t = threading.Thread(target=consumer)
+                t.start()
+                t.join()
+        assert got["parent"] == prod.span_id
+        assert {s.name for s in rec.spans} == {"producer", "consumer"}
+
+    def test_error_status_and_emit(self):
+        with tracing.record() as rec:
+            with pytest.raises(ValueError):
+                with tracing.span("boom"):
+                    raise ValueError("nope")
+            import time
+
+            t0 = time.perf_counter()
+            tracing.emit("pre.timed", t0, end=t0 + 0.25, site="x")
+        boom = next(s for s in rec.spans if s.name == "boom")
+        assert boom.status == "error"
+        assert boom.attrs["error"] == "ValueError"
+        timed = next(s for s in rec.spans if s.name == "pre.timed")
+        assert timed.duration == pytest.approx(0.25)
+        assert timed.attrs["site"] == "x"
+
+    def test_fault_attribution_through_ops_call(self):
+        """A chaos-armed extend records WHICH fault sites struck inside
+        the span (delay kind: fires without raising)."""
+        from celestia_tpu.ops import extend_tpu
+
+        sq = _square(8)
+        with tracing.record() as rec:
+            with faults.inject(
+                faults.rule("device.extend", "delay", delay_s=0.0)
+            ):
+                extend_tpu.extend_roots_device(sq)
+        dev = next(s for s in rec.spans if s.name == "extend.device")
+        assert dev.attrs["backend"] == "tpu"
+        assert dev.attrs["fault_hits"] == 1
+        assert dev.attrs["fault_sites"] == "device.extend:delay"
+        # the stage spans nest under the device span
+        children = {s.name for s in rec.spans if s.parent_id == dev.span_id}
+        assert {"extend.stage", "extend.rs_nmt"} <= children
+
+
+class TestChromeExport:
+    def test_schema_golden(self):
+        """The exported document's structural contract — what Perfetto
+        and the trace-smoke gate both rely on."""
+        with tracing.record() as rec:
+            with tracing.span("extend.block", backend="host", k=4):
+                with tracing.span("extend.rs"):
+                    pass
+        doc = json.loads(json.dumps(rec.chrome()))  # must round-trip
+        assert tracing.validate_chrome_trace(doc) == []
+        assert doc["displayTimeUnit"] == "ms"
+        events = doc["traceEvents"]
+        meta, *xs = events
+        assert meta["ph"] == "M" and meta["name"] == "process_name"
+        assert meta["args"] == {"name": "celestia_tpu"}
+        assert [e["name"] for e in xs] == ["extend.rs", "extend.block"]
+        for e in xs:
+            assert set(e) == {"name", "cat", "ph", "ts", "dur",
+                              "pid", "tid", "args"}
+            assert e["ph"] == "X"
+            assert e["cat"] == "extend"
+            assert e["dur"] >= 0
+            assert isinstance(e["args"]["span_id"], int)
+        child, parent = xs
+        assert child["args"]["parent_id"] == parent["args"]["span_id"]
+        assert parent["args"]["backend"] == "host"
+        assert "parent_id" not in parent["args"]  # root span
+
+    def test_validator_catches_malformed_docs(self):
+        assert tracing.validate_chrome_trace([]) == [
+            "top level is not an object"
+        ]
+        assert tracing.validate_chrome_trace({}) == [
+            "traceEvents is not a list"
+        ]
+        bad = {"traceEvents": [
+            {"ph": "Q"},
+            {"ph": "X", "name": "x", "pid": 1, "ts": 0.0, "dur": -1.0,
+             "args": {}},
+            {"ph": "X", "name": "y", "pid": 1, "args": {}},
+        ]}
+        problems = tracing.validate_chrome_trace(bad)
+        assert any("unexpected ph" in p for p in problems)
+        assert any("negative dur" in p for p in problems)
+        assert any("missing ts" in p for p in problems)
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_ordered(self):
+        tracing.enable(flight_capacity=8)
+        for i in range(20):
+            with tracing.span(f"s{i}"):
+                pass
+        ring = tracing.flight()
+        assert tracing.flight_capacity() == 8
+        assert [d["name"] for d in ring] == [f"s{i}" for i in range(12, 20)]
+        assert all(d["status"] == "ok" for d in ring)
+
+    def test_debug_flight_roundtrip_over_rpc(self):
+        """A traced request lands in /debug/flight, served next to
+        /metrics (which must carry the v0.0.4 content type).
+
+        Uses a stub node: the routes exercised here read only scalar
+        app fields, and the stub keeps this test independent of the
+        signing stack (full-node RPC coverage lives in test_node.py)."""
+        from celestia_tpu.node.rpc import RpcServer
+
+        class _App:
+            chain_id = "trace-test"
+            app_version = 3
+            extend_backend = "numpy"
+            _active_backend = None
+
+        class _Node:
+            app = _App()
+            mempool = ()
+
+            def latest_height(self):
+                return 0
+
+        srv = RpcServer(_Node(), port=0)
+        srv.start()
+        tracing.enable()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            urllib.request.urlopen(f"{base}/status").read()
+            with urllib.request.urlopen(f"{base}/metrics") as resp:
+                assert resp.headers["Content-Type"] == (
+                    "text/plain; version=0.0.4"
+                )
+            doc = json.loads(
+                urllib.request.urlopen(f"{base}/debug/flight").read()
+            )
+        finally:
+            srv.stop()
+        assert doc["enabled"] is True
+        assert doc["capacity"] == tracing.flight_capacity()
+        reqs = [s for s in doc["spans"] if s["name"] == "rpc.request"]
+        assert any(s["attrs"]["path"] == "/status" for s in reqs)
+        status_span = next(
+            s for s in reqs if s["attrs"]["path"] == "/status"
+        )
+        assert status_span["attrs"]["method"] == "GET"
+        assert status_span["attrs"]["status"] == 200
+        assert status_span["dur_us"] >= 0
