@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test test-all test-slow chaos bench bench-transfers dryrun native \
-	trace-smoke
+	trace-smoke bench-gate obs-smoke
 
 # Fast developer loop: the default tier skips the slow multi-process
 # suites (devnet, gRPC, multihost, network, race storms). Two FRESH
@@ -63,6 +63,22 @@ bench-transfers:
 trace-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/trace_smoke.py \
 		--trace-out /tmp/trace_smoke.json
+
+# Perf-regression gate (specs/slo.md, ADR-014): judge the committed
+# BENCH_r*.json + bench_cache.json trajectory — exits non-zero with a
+# readable table when any tracked wall (extend, repair, node-path,
+# transfer) regresses beyond threshold vs its median±MAD baseline.
+# Pure ledger math, never touches the accelerator.
+bench-gate:
+	$(PY) bench.py --check-regressions
+
+# Observability smoke gate (specs/slo.md): boot a devnet node, pin the
+# /readyz 503→200 flip across startup, run the DAS prober for a few
+# verified cycles, check /healthz + /debug/slo contracts, then prove
+# the bench gate passes on committed history and catches a synthetic
+# 2x regression. CPU-only, seconds.
+obs-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/obs_smoke.py
 
 # The driver's multichip compile/execute check on a virtual CPU mesh.
 dryrun:

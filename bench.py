@@ -1277,6 +1277,15 @@ def main_transfers():
 
 
 if __name__ == "__main__":
+    # --check-regressions never touches the accelerator: it gates the
+    # committed BENCH_r*.json + bench_cache.json ledger and exits with
+    # the sentinel's verdict (`make bench-gate`, specs/slo.md)
+    if "--check-regressions" in sys.argv:
+        from celestia_tpu.tools import perf_ledger
+
+        sys.exit(perf_ledger.main(
+            [a for a in sys.argv[1:] if a != "--check-regressions"]
+        ))
     # --trace-out PATH rides along with any bench mode; strip it BEFORE
     # dispatch (main() parses sys.argv[1] positionally as the headline k)
     _trace_path = None

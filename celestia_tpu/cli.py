@@ -152,6 +152,18 @@ def cmd_start(args):
         node.extend_blocks = True
     server = RpcServer(node, port=args.port)
     server.start()
+    # synthetic DAS prober (node/prober.py): black-box samples through
+    # the node's OWN rpc surface, feeding the probe_* counters the SLO
+    # availability objective reads. Off unless asked — the disabled
+    # path must cost nothing.
+    prober = None
+    if getattr(args, "probe_interval", None):
+        from celestia_tpu.node.prober import Prober
+
+        prober = Prober(f"http://127.0.0.1:{server.port}",
+                        interval=args.probe_interval)
+        node.prober = prober
+        prober.start()
     # the reference node serves gRPC alongside RPC (app/app.go:693-719);
     # enabled via app.toml grpc_enable or the --grpc-port flag
     grpc_server = None
@@ -185,6 +197,8 @@ def cmd_start(args):
             print(f"height {block.height} txs {len(block.txs)} "
                   f"square {block.square_size} data {block.data_hash.hex()[:16]}")
     except KeyboardInterrupt:
+        if prober is not None:
+            prober.stop()
         server.stop()
         if grpc_server is not None:
             grpc_server.stop()
@@ -406,6 +420,49 @@ def cmd_query(args):
     print(json.dumps(_rpc(args, "GET", args.path)))
 
 
+def cmd_slo(args):
+    """`celestia-tpu slo check`: one-shot health/readiness/SLO verdict
+    against a running node. Exit codes: 0 fit, 1 not ready or an SLO
+    objective breaching, 2 node unreachable — scriptable as a probe."""
+    import urllib.error
+    import urllib.request
+
+    base = f"http://127.0.0.1:{args.port}"
+
+    def fetch(path):
+        req = urllib.request.Request(base + path, method="GET")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            # /readyz answers 503 WITH a JSON body — that is a verdict,
+            # not an unreachable node
+            try:
+                return e.code, json.loads(e.read())
+            except ValueError:
+                return e.code, {"error": f"HTTP {e.code}"}
+
+    try:
+        _, health = fetch("/healthz")
+        ready_status, ready = fetch("/readyz")
+        _, debug = fetch("/debug/slo")
+    except (OSError, ValueError) as e:
+        print(json.dumps({"error": f"node unreachable: {e}"}),
+              file=sys.stderr)
+        sys.exit(2)
+    slo_ok = bool(debug.get("slo", {}).get("ok", False))
+    verdict = {
+        "healthy": bool(health.get("ok")),
+        "ready": ready_status == 200,
+        "checks": ready.get("checks", []),
+        "slo_ok": slo_ok,
+        "objectives": debug.get("slo", {}).get("objectives", []),
+        "probe_last": debug.get("probe_last"),
+    }
+    print(json.dumps(verdict, indent=2))
+    sys.exit(0 if (verdict["ready"] and slo_ok) else 1)
+
+
 def cmd_light(args):
     """Fraud-aware light client (specs/fraud_proofs.md consumer role):
     follow headers from a primary full node, screen each against
@@ -512,6 +569,12 @@ def main(argv=None):
                          help="write Chrome trace-event JSON of every "
                               "span to PATH at shutdown (the flight "
                               "recorder at /debug/flight is always on)")
+    p_start.add_argument("--probe-interval", type=float, default=None,
+                         metavar="SECONDS",
+                         help="run the synthetic DAS prober against "
+                              "this node every SECONDS (verified "
+                              "/sample + /proof/share probes feeding "
+                              "the availability SLO; default: off)")
 
     p_export = sub.add_parser("export")
     p_export.add_argument("--for-zero-height", action="store_true")
@@ -538,6 +601,10 @@ def main(argv=None):
 
     p_query = sub.add_parser("query")
     p_query.add_argument("path")
+
+    p_slo = sub.add_parser(
+        "slo", help="SLO/readiness checks against a running node")
+    p_slo.add_argument("slo_cmd", choices=["check"])
 
     p_dl = sub.add_parser("download-genesis")
     p_dl.add_argument("--node", required=True,
@@ -588,6 +655,7 @@ def main(argv=None):
         "keys": cmd_keys,
         "tx": cmd_tx,
         "query": cmd_query,
+        "slo": cmd_slo,
         "download-genesis": cmd_download_genesis,
         "addrbook": cmd_addrbook,
         "rollback": cmd_rollback,

@@ -218,6 +218,12 @@ class Node:
         # lock at Commit, and state proofs pair root+proof under the
         # store's own SMT lock).
         self._lock = threading.RLock()
+        # observability attachments: /status uptime anchor, the lazily
+        # built SLO engine (slo.engine_for), and the optional synthetic
+        # DAS prober (cli --probe-interval)
+        self.started_at = time.monotonic()
+        self.slo = None
+        self.prober = None
 
     MAX_FRAUD_PROOFS_PER_HEIGHT = 4
 

@@ -1,0 +1,236 @@
+"""Synthetic DAS prober: black-box sampling of the node's own serve path.
+
+The SLO engine's availability objective (celestia_tpu/slo.py) needs a
+signal that is TRUE end-to-end — a node can have healthy counters while
+its share-serving path returns garbage. This prober is that signal: a
+background thread that periodically plays light client against the
+node's real HTTP surface — ``/status`` → ``/dah/<h>`` → random
+``/sample/<h>/<i>/<j>`` cells — and VERIFIES every returned NMT proof
+against the DAH row roots, exactly as node/client.py's
+``sample_availability`` does. Optionally it also exercises the
+``/proof/share`` route and checks the returned range proof against the
+DAH. Nothing is trusted on shape alone: a sample only counts as ok when
+the proof recomputes the authenticated root.
+
+Every probe outcome lands in telemetry:
+
+    probe_sample_total / probe_sample_ok_total        per-cell counters
+    probe_share_proof_total / probe_share_proof_ok_total
+    probe_cycle_total / probe_cycle_ok_total          per-cycle counters
+    probe_sample (histogram, seconds)                 per-cell latency
+    probe_availability_ratio (gauge)                  running ok/total
+
+The fetches pass through the ``probe.request`` fault site, so a chaos
+test arms ``faults.inject(rule("probe.request", "error"), seed=N)`` and
+deterministically drives the availability objective into breach
+(tests/test_prober.py) — the acceptance path for "the SLO engine reads
+black-box truth, including under fault injection".
+
+The prober is OFF by default (``celestia-tpu start --probe-interval``
+turns it on): with no thread running the serve path pays nothing, which
+keeps the disabled-path overhead inside the ≤2% bench bar.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import urllib.request
+
+from celestia_tpu import faults
+from celestia_tpu.log import logger
+
+log = logger("prober")
+
+
+class Prober:
+    """Background DAS self-probe against one node RPC base URL."""
+
+    def __init__(self, base_url: str, interval: float = 5.0,
+                 samples_per_cycle: int = 4, timeout: float = 5.0,
+                 share_proofs: bool = True, rng: random.Random | None = None,
+                 registry=None):
+        if registry is None:
+            from celestia_tpu.telemetry import metrics as registry
+        self.base_url = base_url.rstrip("/")
+        self.interval = interval
+        self.samples_per_cycle = samples_per_cycle
+        self.timeout = timeout
+        self.share_proofs = share_proofs
+        # seedable for deterministic tests; SystemRandom in production
+        # so a probing pattern cannot be predicted/special-cased
+        self.rng = rng if rng is not None else random.SystemRandom()
+        self.metrics = registry
+        self.last: dict = {}  # newest cycle summary (served in /debug/slo)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- transport ----------------------------------------------------- #
+
+    def _get(self, path: str):
+        """One GET through the probe.request fault site. Raises on any
+        transport/HTTP/parse failure — the caller counts it."""
+        url = self.base_url + path
+        faults.fire("probe.request", url=url)
+        with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+    # -- one probe cycle ----------------------------------------------- #
+
+    def probe_cycle(self) -> dict:
+        """Synchronously run one cycle (the thread body and tests share
+        it). Returns the cycle summary; never raises."""
+        summary = {"ok": False, "samples": 0, "sample_ok": 0,
+                   "share_proofs": 0, "share_proof_ok": 0, "error": None}
+        try:
+            status = self._get("/status")
+            height = int(status.get("height", 0))
+        except Exception as e:  # noqa: BLE001 — unreachable node: cycle fails
+            summary["error"] = f"status: {e}"
+            self._finish(summary)
+            return summary
+        if height < 1:
+            # nothing to sample yet — not a failure, not a data point
+            summary["error"] = "no blocks yet"
+            self.last = summary
+            return summary
+        try:
+            dah = self._fetch_dah(height)
+        except Exception as e:  # noqa: BLE001
+            summary["error"] = f"dah: {e}"
+            self._finish(summary)
+            return summary
+        w = len(dah.row_roots)
+        k = w // 2
+        for _ in range(self.samples_per_cycle):
+            i, j = self.rng.randrange(w), self.rng.randrange(w)
+            summary["samples"] += 1
+            if self._probe_sample(height, i, j, dah, k, w):
+                summary["sample_ok"] += 1
+        if self.share_proofs:
+            summary["share_proofs"] = 1
+            if self._probe_share_proof(height, self.rng.randrange(k * k),
+                                       dah):
+                summary["share_proof_ok"] += 1
+        summary["ok"] = (
+            summary["sample_ok"] == summary["samples"]
+            and summary["share_proof_ok"] == summary["share_proofs"]
+        )
+        summary["height"] = height
+        self._finish(summary)
+        return summary
+
+    def _fetch_dah(self, height: int):
+        from celestia_tpu.da import DataAvailabilityHeader
+
+        doc = self._get(f"/dah/{height}")
+        dah = DataAvailabilityHeader.from_json(doc)
+        if len(dah.row_roots) < 2:
+            raise ValueError("DAH has no rows")
+        return dah
+
+    def _probe_sample(self, height: int, i: int, j: int, dah, k: int,
+                      w: int) -> bool:
+        """Fetch + cryptographically verify one extended-square cell
+        (the node/client.py sample_availability verification, inlined
+        so the prober stays dependency-light)."""
+        from celestia_tpu.da import erasured_leaf_namespace
+        from celestia_tpu.proof import NmtRangeProof
+
+        start = time.perf_counter()
+        ok = False
+        try:
+            res = self._get(f"/sample/{height}/{i}/{j}")
+            share = bytes.fromhex(res["share"])
+            p = res["proof"]
+            proof = NmtRangeProof(
+                start=int(p["start"]), end=int(p["end"]),
+                nodes=[bytes.fromhex(x) for x in p["nodes"]],
+                tree_size=int(p["tree_size"]),
+            )
+            if (proof.start, proof.end) != (j, j + 1) or \
+                    proof.tree_size != w:
+                raise ValueError("proof shape mismatch")
+            ns = erasured_leaf_namespace(i, j, share, k)
+            proof.verify_inclusion(dah.row_roots[i], [ns], [share])
+            ok = True
+        except Exception as e:  # noqa: BLE001 — ANY failure = unavailable
+            log.debug("probe sample failed", height=height, row=i, col=j,
+                      error=str(e))
+        self.metrics.measure_since("probe_sample", start)
+        self.metrics.incr_counter("probe_sample_total")
+        if ok:
+            self.metrics.incr_counter("probe_sample_ok_total")
+        return ok
+
+    def _probe_share_proof(self, height: int, idx: int, dah) -> bool:
+        """Exercise /proof/share for one ODS share and verify the
+        returned NMT range proof against the DAH row root it claims."""
+        from celestia_tpu.proof import NmtRangeProof
+
+        ok = False
+        try:
+            res = self._get(f"/proof/share/{height}:{idx}:{idx + 1}")
+            ns = bytes.fromhex(res["namespace"])
+            data = [bytes.fromhex(s) for s in res["data"]]
+            sp = res["share_proofs"][0]
+            row = int(res["row_proof"]["start_row"])
+            served_root = bytes.fromhex(res["row_proof"]["row_roots"][0])
+            # the proof must chain to a root WE authenticated (the
+            # DAH), not merely to one the reply carries
+            if served_root != dah.row_roots[row]:
+                raise ValueError("row root not in the DAH")
+            proof = NmtRangeProof(
+                start=int(sp["start"]), end=int(sp["end"]),
+                nodes=[bytes.fromhex(x) for x in sp["nodes"]],
+                tree_size=len(dah.row_roots),
+            )
+            proof.verify_inclusion(
+                dah.row_roots[row], [ns] * len(data), data
+            )
+            ok = True
+        except Exception as e:  # noqa: BLE001
+            log.debug("probe share proof failed", height=height, idx=idx,
+                      error=str(e))
+        self.metrics.incr_counter("probe_share_proof_total")
+        if ok:
+            self.metrics.incr_counter("probe_share_proof_ok_total")
+        return ok
+
+    def _finish(self, summary: dict) -> None:
+        self.last = summary
+        self.metrics.incr_counter("probe_cycle_total")
+        if summary["ok"]:
+            self.metrics.incr_counter("probe_cycle_ok_total")
+        total = self.metrics.get_counter("probe_sample_total")
+        good = self.metrics.get_counter("probe_sample_ok_total")
+        if total:
+            self.metrics.set_gauge("probe_availability_ratio", good / total)
+
+    # -- thread lifecycle ---------------------------------------------- #
+
+    def start(self) -> "Prober":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="das-prober")
+        self._thread.start()
+        log.info("prober started", base_url=self.base_url,
+                 interval_s=self.interval,
+                 samples=self.samples_per_cycle)
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.probe_cycle()
+            except Exception as e:  # noqa: BLE001 — the loop never dies
+                log.error("probe cycle crashed", error=str(e))
+            self._stop.wait(self.interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.timeout + 1.0)
+            self._thread = None

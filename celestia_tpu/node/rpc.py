@@ -11,6 +11,7 @@ from __future__ import annotations
 import http.server
 import json
 import threading
+import time
 from typing import TYPE_CHECKING
 
 from celestia_tpu import tracing
@@ -69,6 +70,16 @@ def _handler_for(node: Node):
             self.end_headers()
             self.wfile.write(body)
 
+        def _not_found(self) -> None:
+            """The one unknown-route body every miss returns (GET,
+            gateway, and POST fallthroughs share it): consistent JSON,
+            the path echoed so a client log line is self-explanatory."""
+            self._reply(
+                {"error": "unknown route",
+                 "path": self.path.split("?", 1)[0], "status": 404},
+                404,
+            )
+
         def do_GET(self):
             with tracing.span("rpc.request", method="GET",
                               path=self.path.split("?", 1)[0]):
@@ -106,8 +117,46 @@ def _handler_for(node: Node):
                             "mempool_size": len(node.mempool),
                             "extend_backend": node.app.extend_backend,
                             "extend_backend_live": node.app._active_backend,
+                            "uptime_s": round(
+                                time.monotonic() - node.started_at, 3
+                            ),
+                            "tpu_strikes": node.app._tpu_strikes,
+                            "tpu_disabled": node.app._tpu_disabled,
                         }
                     )
+                elif parts == ["healthz"]:
+                    # liveness: the process answers — nothing more. A
+                    # degraded node is still ALIVE (restarting it would
+                    # lose the flight recorder); fitness is /readyz.
+                    self._reply({
+                        "ok": True,
+                        "uptime_s": round(
+                            time.monotonic() - node.started_at, 3
+                        ),
+                    })
+                elif parts == ["readyz"]:
+                    # serving-fit (specs/slo.md): 503 tells the load
+                    # balancer to route around this node; the body
+                    # names exactly which check is unfit
+                    from celestia_tpu.slo import readiness
+
+                    ready, checks = readiness(node)
+                    self._reply({"ready": ready, "checks": checks},
+                                200 if ready else 503)
+                elif parts == ["debug", "slo"]:
+                    # full judgment view: every objective's evaluation
+                    # (multi-window burn rates included), the serving-
+                    # fit checks, and the newest prober cycle
+                    from celestia_tpu.slo import engine_for, readiness
+
+                    ready, checks = readiness(node)
+                    prober = getattr(node, "prober", None)
+                    self._reply({
+                        "slo": engine_for(node).evaluate(),
+                        "ready": ready,
+                        "checks": checks,
+                        "probe_last": prober.last if prober else None,
+                    })
                 elif parts == ["genesis"]:
                     # the download-genesis source (ref: cmd/celestia-appd/
                     # cmd/download-genesis.go fetches a chain's genesis;
@@ -574,10 +623,12 @@ def _handler_for(node: Node):
                         self._reply(
                             {"nonce": att["nonce"], "proof": proof.to_json()}
                         )
-                elif parts[0] == "cosmos":
+                elif parts and parts[0] == "cosmos":
                     self._gateway_get(parts)
                 else:
-                    self._reply({"error": "unknown route"}, 404)
+                    # includes GET / (empty parts), which used to fall
+                    # into the cosmos check and 500 on the index access
+                    self._not_found()
             except Exception as e:  # noqa: BLE001
                 log.error("query failed", path=self.path, error=str(e))
                 self._reply({"error": str(e)}, 500)
@@ -673,7 +724,7 @@ def _handler_for(node: Node):
                     }
                 })
             else:
-                self._reply({"error": "unknown route"}, 404)
+                self._not_found()
 
         def do_POST(self):
             with tracing.span("rpc.request", method="POST", path=self.path):
@@ -766,7 +817,7 @@ def _handler_for(node: Node):
                     else:
                         self._reply(validator.handle_fraud(body))
                 else:
-                    self._reply({"error": "unknown route"}, 404)
+                    self._not_found()
             except Exception as e:  # noqa: BLE001
                 log.error("broadcast failed", path=self.path, error=str(e))
                 self._reply({"error": str(e)}, 500)

@@ -144,6 +144,18 @@ class Registry:
         hist = self.get_timing(name, **labels)
         return float("nan") if hist is None else hist.quantile(q)
 
+    def histogram_family(self, name: str) -> list[tuple[dict, Histogram]]:
+        """Every (labels, histogram) of one timing family — the SLO
+        engine merges these bucketwise for family-wide quantiles
+        (bounds are registry-wide, so the merge is exact)."""
+        with self._lock:
+            out = []
+            for key, hist in self.timings.items():
+                fam, labels = self._family(key)
+                if fam == name:
+                    out.append((dict(labels), hist))
+            return out
+
     def prometheus_text(self) -> str:
         """Render in the Prometheus exposition format v0.0.4 (HELP/TYPE
         metadata, `_total`-suffixed counters, escaped label values,

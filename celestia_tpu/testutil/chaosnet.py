@@ -24,6 +24,7 @@ from __future__ import annotations
 import http.server
 import json
 import threading
+import time
 
 from celestia_tpu import da
 
@@ -75,6 +76,91 @@ class ChaosNode:
                 self._fail_next -= 1
                 return True
             return False
+
+
+class _StubApp:
+    """Just enough App surface for node/rpc.py's status/readiness
+    routes: the degradation-state fields specs/slo.md reads, with no
+    crypto or state-machine dependency."""
+
+    TPU_STRIKE_LIMIT = 3
+
+    def __init__(self, chain_id: str):
+        self.chain_id = chain_id
+        self.app_version = 3
+        self.extend_backend = "numpy"
+        self._active_backend: str | None = None
+        self._tpu_strikes = 0
+        self._tpu_disabled = False
+        self.crossover = None
+        self.blob_pool = None
+        self.arena_stats = {"assembled": 0, "fallback": 0}
+
+    def resolve_extend_backend(self, k: int) -> str:
+        if self._tpu_disabled and self.extend_backend == "tpu":
+            return "numpy"
+        self._active_backend = self.extend_backend
+        return self.extend_backend
+
+    def gov_square_size_upper_bound(self) -> int:
+        return 128
+
+
+class RpcChaosNode(ChaosNode):
+    """ChaosNode dressed as a node/rpc.py Node: the REAL RPC handler
+    (node/rpc.py, not this module's stripped one) serves it, so the
+    observability routes — /status, /healthz, /readyz, /debug/slo,
+    /dah, /sample — are exercised end-to-end without the signing stack.
+    This is the in-process probing harness the synthetic DAS prober
+    tests and `make obs-smoke` boot in crypto-free environments."""
+
+    def __init__(self, heights: int = 2, k: int = 2, seed: int = 7,
+                 chain_id: str = "chaos-net"):
+        super().__init__(heights=heights, k=k, seed=seed,
+                         chain_id=chain_id)
+        self.k = k
+        self.seed = seed
+        self.app = _StubApp(chain_id)
+        self.mempool: list = []
+        self.started_at = time.monotonic()
+        self.slo = None
+        self.prober = None
+
+    def grow(self) -> int:
+        """Append the next height (the produce_block analogue): what
+        flips /readyz's has_blocks check across 'startup'."""
+        h = self.latest_height() + 1
+        eds = da.extend_shares(chain_shares(self.k, h, self.seed))
+        self.blocks[h] = (eds, da.new_data_availability_header(eds))
+        return h
+
+    # -- the Node query surface node/rpc.py's served routes touch ------ #
+
+    def block_dah(self, height: int):
+        return self.dah(height)
+
+    def block_eds(self, height: int):
+        entry = self.blocks.get(height)
+        return entry[0] if entry else None
+
+    def block_width(self, height: int) -> int | None:
+        entry = self.blocks.get(height)
+        return entry[0].width if entry else None
+
+    def block_row(self, height: int, i: int):
+        entry = self.blocks.get(height)
+        return entry[0].row(i) if entry else None
+
+    def get_block(self, height: int):
+        return None  # no block bodies: body routes answer 404
+
+    def get_tx(self, key: bytes):
+        return None
+
+    def fraud_proofs_at(self, height: int) -> list:
+        return list(self.fraud_wires.get(height, []))
+
+    home = None
 
 
 def _handler_for(node: ChaosNode):

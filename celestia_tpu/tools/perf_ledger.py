@@ -1,0 +1,288 @@
+"""Perf-regression sentinel over the bench ledger (`make bench-gate`).
+
+The repo root accumulates one ``BENCH_r<N>.json`` record per bench
+round plus the best-of-session ``bench_cache.json`` — but until now the
+trajectory was write-only: a kernel regression (losing the repair
+speedup, a transfer path going quadratic) would ship silently. This
+module turns the history into a per-metric LEDGER and gates on it:
+``python bench.py --check-regressions`` / ``make bench-gate`` exits
+nonzero with a readable table when any tracked wall regresses beyond
+threshold against its own noise-aware baseline.
+
+Input reality (ADR-014): the round records are heterogeneous —
+``parsed`` may be a clean dict, null (the stored ``tail`` keeps only
+the LAST 2000 chars of output, decapitating the JSON line), or an
+error record from a round where the accelerator was unreachable. The
+loader therefore parses in three tiers:
+
+    1. ``parsed`` dict (not an error record) — trust it outright;
+    2. a full ``{``-prefixed JSON line found in ``tail``;
+    3. SALVAGE: balanced-brace extraction of individual
+       ``"<config>": {...}`` objects out of the truncated tail — the
+       decapitated rounds still carry complete per-config objects.
+
+Baselines are median ± MAD over the metric's history (ADR-014: the
+median ignores the odd outlier round; MAD is the matching robust
+spread — a couple of noisy tunnel rounds cannot widen a stdev-based
+band into uselessness). The newest point regresses only when it is
+BOTH beyond ``threshold ×`` the baseline AND outside the noise band
+(baseline + 3·1.4826·MAD, floored at 5% of baseline) — the double
+gate keeps a low-noise metric from tripping on a rounding wiggle and a
+high-noise metric from hiding a real 2× loss. Metrics with fewer than
+``min_history`` points report informationally and never gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+
+# every tracked wall is milliseconds, lower-is-better. Each entry lists
+# (config, field) extraction paths tried in order — the bench output
+# schema grew across rounds, so older rounds expose the same wall under
+# the headline record while newer ones nest it in configs.
+TRACKED: dict[str, list[tuple[str | None, str]]] = {
+    # extend: the headline k=128 device wall
+    "extend_k128_tpu_ms": [(None, "value"), ("3_headline_k128", "tpu_ms")],
+    # repair: k=128 25% erasure device wall
+    "repair_k128_tpu_ms": [("4_repair_k128_25pct", "tpu_ms")],
+    # node-path: proposal wall, roots-only (the serving-critical wall)
+    "node_path_k128_wall_ms": [("8_node_path_k128",
+                                "tpu_wall_roots_only_ms")],
+    # transfer: the two transfer-dominated walls (tunnel-bound)
+    "repair_k128_transfers_wall_ms": [("4_repair_k128_25pct",
+                                       "tpu_wall_with_transfers_ms")],
+    "node_path_k128_eds_fetch_ms": [("8_node_path_k128",
+                                     "tpu_wall_with_eds_fetch_ms")],
+}
+
+DEFAULT_THRESHOLD = 1.5  # newest/baseline ratio that counts as regression
+DEFAULT_MIN_HISTORY = 3  # points before a metric gates
+
+
+# ---------------------------------------------------------------------- #
+# tier-3 salvage: pull per-config objects out of a decapitated JSON line
+
+
+def salvage_configs(tail: str) -> dict:
+    """Balanced-brace extraction of ``"<name>": {...}`` objects from a
+    truncated bench line. Only top-level-looking config names (leading
+    digit, e.g. ``4_repair_k128_25pct``) are kept; fragments that do
+    not parse are skipped — a half-truncated object yields nothing
+    rather than garbage."""
+    out: dict = {}
+    for m in re.finditer(r'"([0-9][0-9a-z_]*)"\s*:\s*\{', tail):
+        name, start = m.group(1), m.end() - 1
+        depth = 0
+        for i in range(start, len(tail)):
+            ch = tail[i]
+            if ch == "{":
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+                if depth == 0:
+                    try:
+                        out[name] = json.loads(tail[start:i + 1])
+                    except ValueError:
+                        pass
+                    break
+        # unbalanced to EOF: the object itself was truncated — drop it
+    return out
+
+
+def parse_round(doc: dict) -> dict | None:
+    """One BENCH_r*.json record -> {"headline": float|None,
+    "configs": dict} or None when the round carries no usable data
+    (nonzero rc / error record)."""
+    if doc.get("rc", 1) != 0:
+        return None
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and "error" in parsed:
+        return None
+    headline = None
+    configs: dict = {}
+    if isinstance(parsed, dict):
+        headline = parsed.get("value")
+        configs = parsed.get("configs") or {}
+    if not configs:
+        tail = doc.get("tail", "") or ""
+        for line in tail.splitlines():
+            if line.startswith("{"):
+                try:
+                    j = json.loads(line)
+                except ValueError:
+                    continue
+                headline = headline if headline is not None else j.get("value")
+                configs = j.get("configs") or {}
+                break
+        if not configs:
+            configs = salvage_configs(tail)
+    if headline is None and not configs:
+        return None
+    return {"headline": headline, "configs": configs}
+
+
+def _extract(metric: str, parsed: dict) -> float | None:
+    for config, field in TRACKED[metric]:
+        if config is None:
+            v = parsed.get("headline")
+        else:
+            cfg = parsed.get("configs", {}).get(config)
+            v = cfg.get(field) if isinstance(cfg, dict) else None
+        if isinstance(v, (int, float)):
+            return float(v)
+    return None
+
+
+# ---------------------------------------------------------------------- #
+# ledger assembly
+
+
+def load_ledger(root: str) -> dict[str, list[tuple[str, float]]]:
+    """Repo-root history -> {metric: [(round_label, value_ms), ...]}
+    oldest→newest. ``bench_cache.json`` (freshest measured state) is
+    the final point of every series it covers."""
+    ledger: dict[str, list[tuple[str, float]]] = {m: [] for m in TRACKED}
+    rounds = sorted(
+        glob.glob(os.path.join(root, "BENCH_r*.json")),
+        key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p)).group(1)),
+    )
+    for path in rounds:
+        label = os.path.basename(path)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = parse_round(doc)
+        if parsed is None:
+            continue
+        for metric in TRACKED:
+            v = _extract(metric, parsed)
+            if v is not None:
+                ledger[metric].append((label, v))
+    cache_path = os.path.join(root, "bench_cache.json")
+    if os.path.exists(cache_path):
+        try:
+            with open(cache_path) as f:
+                cache = json.load(f)
+        except (OSError, ValueError):
+            cache = None
+        if isinstance(cache, dict):
+            headlines = cache.get("headlines") or {}
+            headline = None
+            for rec in headlines.values():
+                if isinstance(rec, dict) and "value" in rec:
+                    headline = rec["value"]
+                    break
+            parsed = {"headline": headline,
+                      "configs": cache.get("configs") or {}}
+            for metric in TRACKED:
+                v = _extract(metric, parsed)
+                if v is not None:
+                    ledger[metric].append(("bench_cache.json", v))
+    return ledger
+
+
+# ---------------------------------------------------------------------- #
+# baselines + verdicts
+
+
+def judge(history: list[tuple[str, float]], threshold: float,
+          min_history: int) -> dict:
+    """Newest point vs the median±MAD baseline of its predecessors."""
+    values = [v for _, v in history]
+    n = len(values)
+    if n < min_history:
+        return {"n": n, "gating": False, "regressed": False,
+                "note": f"informational (<{min_history} points)"}
+    current_label, current = history[-1]
+    prior = values[:-1]
+    baseline = statistics.median(prior)
+    mad = statistics.median(abs(v - baseline) for v in prior)
+    # 1.4826·MAD ≈ σ for normal noise; floor at 5% of baseline so a
+    # zero-MAD series (best-of cache repeats identical values) still
+    # tolerates measurement wiggle
+    band = max(3 * 1.4826 * mad, 0.05 * baseline)
+    ratio = current / baseline if baseline else float("inf")
+    regressed = ratio > threshold and current > baseline + band
+    return {
+        "n": n, "gating": True, "regressed": regressed,
+        "current": current, "current_label": current_label,
+        "baseline": baseline, "mad": mad, "band": band,
+        "ratio": ratio,
+    }
+
+
+def check(root: str, threshold: float = DEFAULT_THRESHOLD,
+          min_history: int = DEFAULT_MIN_HISTORY) -> dict:
+    ledger = load_ledger(root)
+    report = {}
+    for metric, history in ledger.items():
+        report[metric] = judge(history, threshold, min_history)
+        report[metric]["history"] = history
+    report_ok = not any(r["regressed"] for r in report.values())
+    return {"ok": report_ok, "threshold": threshold,
+            "min_history": min_history, "metrics": report}
+
+
+def render_table(result: dict) -> str:
+    """The human-readable gate output (one row per tracked wall)."""
+    rows = [("metric", "n", "baseline", "current", "ratio", "verdict")]
+    for metric, r in sorted(result["metrics"].items()):
+        if not r["gating"]:
+            rows.append((metric, str(r["n"]), "-", "-", "-", r["note"]))
+            continue
+        verdict = "REGRESSED" if r["regressed"] else "ok"
+        rows.append((
+            metric, str(r["n"]),
+            f"{r['baseline']:.3f}", f"{r['current']:.3f}",
+            f"{r['ratio']:.2f}x", verdict,
+        ))
+    widths = [max(len(row[i]) for row in rows) for i in range(len(rows[0]))]
+    lines = []
+    for i, row in enumerate(rows):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    tail = ("PASS: no tracked wall regressed beyond "
+            f"{result['threshold']}x its baseline"
+            if result["ok"] else
+            "FAIL: tracked wall regression detected (see table)")
+    return "\n".join(lines) + "\n" + tail
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="perf_ledger",
+        description="Gate on bench-ledger perf regressions",
+    )
+    ap.add_argument("--root", default=os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        help="directory holding BENCH_r*.json + bench_cache.json "
+             "(default: the repo root)")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="current/baseline ratio that counts as a "
+                         f"regression (default {DEFAULT_THRESHOLD})")
+    ap.add_argument("--min-history", type=int, default=DEFAULT_MIN_HISTORY,
+                    help="points a metric needs before it gates "
+                         f"(default {DEFAULT_MIN_HISTORY})")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full machine-readable report")
+    args = ap.parse_args(argv)
+    result = check(args.root, threshold=args.threshold,
+                   min_history=args.min_history)
+    if args.json:
+        print(json.dumps(result, indent=2))
+    else:
+        print(render_table(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,172 @@
+"""Synthetic DAS prober tests (specs/slo.md): real NMT verification
+through the real node/rpc.py serve path, tamper detection, and the
+acceptance e2e — a deterministic fault at the probe boundary drives the
+availability objective into breach through the SLO engine.
+
+Crypto-free: the RpcChaosNode facade (testutil/chaosnet.py) stands in
+for the full node behind the genuine RPC handler."""
+
+import random
+
+import pytest
+
+from celestia_tpu import faults
+from celestia_tpu.node.prober import Prober
+from celestia_tpu.node.rpc import RpcServer
+from celestia_tpu.slo import Objective, SloEngine
+from celestia_tpu.telemetry import Registry
+from celestia_tpu.testutil.chaosnet import RpcChaosNode
+
+
+@pytest.fixture()
+def served(request):
+    node_cls = getattr(request, "param", RpcChaosNode)
+    node = node_cls(heights=2, k=4)
+    server = RpcServer(node, port=0)
+    server.start()
+    try:
+        yield node, f"http://127.0.0.1:{server.port}"
+    finally:
+        server.stop()
+
+
+def new_prober(base, registry, **kw):
+    kw.setdefault("share_proofs", False)  # the facade has no block bodies
+    kw.setdefault("rng", random.Random(0))
+    return Prober(base, registry=registry, **kw)
+
+
+class TestProbeCycle:
+    def test_all_samples_verify(self, served):
+        _node, base = served
+        r = Registry()
+        prober = new_prober(base, r, samples_per_cycle=6)
+        summary = prober.probe_cycle()
+        assert summary["ok"], summary
+        assert summary["sample_ok"] == summary["samples"] == 6
+        assert summary["height"] == 2
+        assert r.get_counter("probe_sample_total") == 6.0
+        assert r.get_counter("probe_sample_ok_total") == 6.0
+        assert r.get_counter("probe_cycle_ok_total") == 1.0
+        assert r.gauges["probe_availability_ratio"] == 1.0
+        hist = r.get_timing("probe_sample")
+        assert hist is not None and hist.count == 6
+        assert prober.last is summary  # /debug/slo serves this
+
+    def test_no_blocks_is_not_a_failure(self):
+        node = RpcChaosNode(heights=0)
+        server = RpcServer(node, port=0)
+        server.start()
+        try:
+            r = Registry()
+            prober = new_prober(f"http://127.0.0.1:{server.port}", r)
+            summary = prober.probe_cycle()
+            assert not summary["ok"]
+            assert summary["error"] == "no blocks yet"
+            # pre-genesis silence is not counted against availability
+            assert r.get_counter("probe_sample_total") == 0.0
+            assert r.get_counter("probe_cycle_total") == 0.0
+        finally:
+            server.stop()
+
+    def test_unreachable_node_fails_the_cycle(self):
+        r = Registry()
+        prober = new_prober("http://127.0.0.1:1", r, timeout=0.5)
+        summary = prober.probe_cycle()
+        assert not summary["ok"] and "status" in summary["error"]
+        assert r.get_counter("probe_cycle_total") == 1.0
+        assert r.get_counter("probe_cycle_ok_total") == 0.0
+
+
+class TamperedNode(RpcChaosNode):
+    """Serves rows with a flipped payload byte in every cell: the
+    handler proves over the TAMPERED leaves, so the proof is internally
+    consistent but chains to a root that is NOT in the DAH — exactly
+    the lie the prober must catch."""
+
+    def block_row(self, height, i):
+        row = super().block_row(height, i)
+        if row is None:
+            return None
+        return [cell[:-1] + bytes([cell[-1] ^ 1]) for cell in row]
+
+
+class TestTamperDetection:
+    @pytest.mark.parametrize("served", [TamperedNode], indirect=True)
+    def test_consistent_proof_over_wrong_data_is_unavailable(self, served):
+        _node, base = served
+        r = Registry()
+        prober = new_prober(base, r, samples_per_cycle=5)
+        summary = prober.probe_cycle()
+        assert not summary["ok"]
+        assert summary["sample_ok"] == 0 and summary["samples"] == 5
+        assert r.get_counter("probe_sample_ok_total") == 0.0
+
+
+class TestFaultTripsAvailabilitySlo:
+    """The PR's acceptance e2e: arm the deterministic injector at the
+    probe boundary, run cycles, and watch the burn-rate objective
+    breach — black-box truth reaching the SLO verdict."""
+
+    def test_breach_under_injected_sample_faults(self, served):
+        _node, base = served
+        r = Registry()
+        clock_t = [0.0]
+        eng = SloEngine(
+            [Objective(name="sample_availability", kind="ratio",
+                       good="probe_sample_ok_total",
+                       total="probe_sample_total", target=0.999)],
+            registry=r, clock=lambda: clock_t[0],
+        )
+        prober = new_prober(base, r, samples_per_cycle=4)
+
+        assert eng.evaluate()["ok"]  # baseline: no traffic, no burn
+        # healthy cycle first: the breach below is a TRANSITION
+        assert prober.probe_cycle()["ok"]
+        clock_t[0] = 10.0
+        assert eng.evaluate()["ok"]
+
+        # fault only the /sample fetches: /status + /dah stay clean so
+        # every failed sample is COUNTED (a dead node would be a cycle
+        # error, not availability data)
+        with faults.inject(
+            faults.rule("probe.request", "error", where="/sample/"),
+            seed=1337,
+        ):
+            for _ in range(3):
+                summary = prober.probe_cycle()
+                assert not summary["ok"]
+                assert summary["sample_ok"] == 0
+        clock_t[0] = 20.0
+        res = eng.evaluate()
+        assert not res["ok"]
+        obj = res["objectives"][0]
+        assert any(w["breaching"] for w in obj["windows"])
+        assert r.get_counter("slo_breach_total",
+                             objective="sample_availability") == 1.0
+
+        # recovery: faults disarmed, healthy probing resumes, windows
+        # age past the burst -> the objective clears
+        for _ in range(40):
+            assert prober.probe_cycle()["ok"]
+        clock_t[0] = 4000.0
+        assert eng.evaluate()["ok"]
+
+
+class TestProberThread:
+    def test_start_stop_runs_cycles(self, served):
+        _node, base = served
+        r = Registry()
+        prober = new_prober(base, r, interval=0.01)
+        prober.start()
+        try:
+            import time as _time
+
+            deadline = _time.monotonic() + 5.0
+            while (r.get_counter("probe_cycle_total") < 2.0
+                   and _time.monotonic() < deadline):
+                _time.sleep(0.01)
+        finally:
+            prober.stop()
+        assert r.get_counter("probe_cycle_total") >= 2.0
+        assert prober._thread is None  # stop() joins and clears

@@ -147,3 +147,74 @@ class TestQuantileOracle:
         assert np.isnan(h.quantile(0.5))  # empty
         h.observe(1e9)  # +Inf bucket only
         assert h.quantile(0.99) == DEFAULT_BUCKETS[-1]  # clamped, finite
+
+
+class TestConcurrentHammer:
+    """The prober + SLO engine made the registry genuinely
+    multi-writer (probe thread observing while RPC threads render
+    /metrics and the engine reads families): hammer observe()/
+    incr_counter() from many threads against both SHARED and private
+    keys while prometheus_text() renders concurrently — final counts
+    must be exact and no exposition may be torn."""
+
+    def test_exact_counts_and_untorn_exposition(self):
+        import threading
+
+        r = Registry()
+        threads_n, per_thread = 8, 2_000
+        renders: list[str] = []
+        stop = threading.Event()
+
+        def writer(tid: int) -> None:
+            for i in range(per_thread):
+                r.observe("hammer", 0.001 * (i % 7 + 1), shared="yes")
+                r.observe("hammer", 0.002, worker=str(tid))
+                r.incr_counter("hammer_ops")
+
+        def renderer() -> None:
+            while not stop.is_set():
+                renders.append(r.prometheus_text())
+
+        render_thread = threading.Thread(target=renderer)
+        render_thread.start()
+        workers = [
+            threading.Thread(target=writer, args=(t,))
+            for t in range(threads_n)
+        ]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        stop.set()
+        render_thread.join()
+
+        total = threads_n * per_thread
+        assert r.get_counter("hammer_ops") == float(total)
+        shared = r.get_timing("hammer", shared="yes")
+        assert shared.count == total
+        assert sum(shared.counts) == total  # bucket cells never lost
+        for t in range(threads_n):
+            assert r.get_timing("hammer", worker=str(t)).count == per_thread
+
+        # every mid-hammer render must be internally consistent: the
+        # lock makes each exposition a point-in-time snapshot, so any
+        # bucket series in it is monotone and +Inf == _count
+        assert renders
+        for text in (renders[0], renders[len(renders) // 2], renders[-1]):
+            by_series: dict[str, list[int]] = {}
+            counts_by_series: dict[str, int] = {}
+            for line in text.splitlines():
+                if line.startswith("hammer_seconds_bucket"):
+                    key = line[: line.rindex("le=")]
+                    by_series.setdefault(key, []).append(
+                        int(line.split()[-1])
+                    )
+                elif line.startswith("hammer_seconds_count"):
+                    counts_by_series[line.split()[0]] = int(
+                        line.split()[-1]
+                    )
+            for key, series in by_series.items():
+                assert series == sorted(series), f"torn buckets in {key}"
+        # after the barrier the newest render may predate the last
+        # writes; a fresh render must show the exact totals
+        assert f"hammer_ops_total {float(total)}" in r.prometheus_text()

@@ -188,10 +188,13 @@ class TestFlightRecorder:
             app_version = 3
             extend_backend = "numpy"
             _active_backend = None
+            _tpu_strikes = 0
+            _tpu_disabled = False
 
         class _Node:
             app = _App()
             mempool = ()
+            started_at = 0.0
 
             def latest_height(self):
                 return 0
