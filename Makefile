@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test test-all test-slow chaos bench bench-transfers dryrun native \
-	trace-smoke bench-gate obs-smoke
+	trace-smoke bench-gate obs-smoke sdc-smoke
 
 # Fast developer loop: the default tier skips the slow multi-process
 # suites (devnet, gRPC, multihost, network, race storms). Two FRESH
@@ -79,6 +79,14 @@ bench-gate:
 # 2x regression. CPU-only, seconds.
 obs-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/obs_smoke.py
+
+# SDC defense drill (ADR-015): arm a seeded bitflip at every integrity
+# injection point (extend output, repair output, transfer chunk), prove
+# detection fires before any DAH commit, the host recompute restores
+# byte parity, /readyz reflects quarantine, and audits-off is a single
+# boolean check. CPU-only, crypto-free, seconds.
+sdc-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/sdc_smoke.py
 
 # The driver's multichip compile/execute check on a virtual CPU mesh.
 dryrun:

@@ -1277,6 +1277,24 @@ def main_transfers():
 
 
 if __name__ == "__main__":
+    # --audit-level LEVEL rides along with any bench mode: strip it
+    # BEFORE dispatch (main() parses sys.argv[1] positionally as the
+    # headline k; perf_ledger's parser would reject it), install the
+    # global integrity engine so every benched extend/repair pays (and
+    # reports) the audit cost (ADR-015)
+    if "--audit-level" in sys.argv:
+        _i = sys.argv.index("--audit-level")
+        if _i + 1 >= len(sys.argv):
+            raise SystemExit("--audit-level requires off|sampled|full")
+        _audit_level = sys.argv[_i + 1]
+        del sys.argv[_i:_i + 2]
+        from celestia_tpu import integrity as _integrity
+
+        try:
+            _integrity.configure(_audit_level)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
+        print(f"audit-level {_audit_level}", file=sys.stderr)
     # --check-regressions never touches the accelerator: it gates the
     # committed BENCH_r*.json + bench_cache.json ledger and exits with
     # the sentinel's verdict (`make bench-gate`, specs/slo.md)
@@ -1286,8 +1304,7 @@ if __name__ == "__main__":
         sys.exit(perf_ledger.main(
             [a for a in sys.argv[1:] if a != "--check-regressions"]
         ))
-    # --trace-out PATH rides along with any bench mode; strip it BEFORE
-    # dispatch (main() parses sys.argv[1] positionally as the headline k)
+    # --trace-out PATH rides along the same way
     _trace_path = None
     if "--trace-out" in sys.argv:
         _i = sys.argv.index("--trace-out")

@@ -141,7 +141,8 @@ class App:
 
     def __init__(self, chain_id: str = GENESIS_CHAIN_ID, app_version: int = 1,
                  use_tpu: bool = False, upgrade_schedule: dict | None = None,
-                 extend_backend: str | None = None):
+                 extend_backend: str | None = None,
+                 audit_level: str | None = None, audit_q: int = 4):
         self.chain_id = chain_id
         self.app_version = app_version
         self.use_tpu = use_tpu
@@ -160,6 +161,19 @@ class App:
         # costs latency, never correctness.
         self._tpu_strikes = 0
         self._tpu_disabled = False
+        # SDC defense (ADR-015): an explicit audit_level installs the
+        # process-global integrity engine; either way the App mirrors
+        # the live level for /status. Quarantine latches on the first
+        # detected corruption (sticky like _tpu_disabled, but skipping
+        # the strike grace — wrongness is worse than absence).
+        from celestia_tpu import integrity
+
+        if audit_level is not None:
+            integrity.configure(audit_level, q=audit_q)
+        self.audit_level = integrity.get().level
+        self.sdc_quarantined = False
+        self.sdc_events = 0
+        self.last_sdc: dict | None = None
         # measured per-k backend crossover (app/calibration.py); None
         # means uncalibrated — auto uses the static TPU_MIN_SQUARE gate
         self.crossover = None
@@ -340,23 +354,37 @@ class App:
             b"".join(s.data for s in data_square), dtype=np.uint8
         ).reshape(k, k, appconsts.SHARE_SIZE)
 
-    def _degrade_tpu(self, op: str, exc: Exception) -> str:
+    def _degrade_tpu(self, op: str, exc: Exception,
+                     cause: str = "exception") -> str:
         """One TPU ExtendBlock failure: strike, warn with the block
         height + cause, and return the host-side fallback backend.
         TPU_STRIKE_LIMIT consecutive strikes sticky-disable the device
         path (resolve_extend_backend consults _tpu_disabled); every
-        fallback recomputes byte-identically on the host."""
+        fallback recomputes byte-identically on the host.
+
+        cause="corruption" (a failed integrity audit, ADR-015) skips
+        the strike grace entirely: a device that produced one wrong
+        answer is quarantined immediately — transient crashes earn
+        retries, silent wrongness does not."""
         from celestia_tpu import native
 
-        self._tpu_strikes += 1
-        if self._tpu_strikes >= self.TPU_STRIKE_LIMIT:
+        if cause == "corruption":
+            self._tpu_strikes = self.TPU_STRIKE_LIMIT
             self._tpu_disabled = True
-            self._active_backend = None  # re-log the degraded winner
+            self._active_backend = None
+            self.sdc_quarantined = True
+            self.sdc_events += 1
+        else:
+            self._tpu_strikes += 1
+            if self._tpu_strikes >= self.TPU_STRIKE_LIMIT:
+                self._tpu_disabled = True
+                self._active_backend = None  # re-log the degraded winner
         fallback = "native" if native.available() else "numpy"
         log.warn(
             "extend degraded tpu->host",
             height=self.height + 1,
             cause=f"{type(exc).__name__}: {exc}",
+            reason=cause,
             op=op,
             strike=self._tpu_strikes,
             fallback=fallback,
@@ -375,6 +403,55 @@ class App:
             sp.set(degraded=True, strikes=self._tpu_strikes,
                    cause=type(exc).__name__)
         return fallback
+
+    def _quarantine_tpu(self, op: str, exc: Exception) -> str:
+        """Detected silent data corruption (IntegrityError from the
+        ops-layer audit, ADR-015): discard the device result, run the
+        corrupted square through the fraud oracle to assert the BEFP
+        machinery would have caught the block had it been committed,
+        and sticky-disable the TPU immediately. The caller falls
+        through to the host recompute, restoring the byte-identical
+        guarantee before any DAH is committed."""
+        import numpy as np
+
+        befp_provable = False
+        eds_bad = getattr(exc, "eds", None)
+        if eds_bad is not None:
+            try:
+                from celestia_tpu.da import fraud
+
+                befp_provable = (
+                    fraud.find_befp(np.ascontiguousarray(eds_bad)) is not None
+                )
+            except Exception:  # noqa: BLE001 — the oracle is evidence, not a gate
+                befp_provable = False
+        self.last_sdc = {
+            "op": op,
+            "site": getattr(exc, "site", "unknown"),
+            "where": getattr(exc, "where", "unknown"),
+            "mismatches": getattr(exc, "mismatches", None),
+            "height": self.height + 1,
+            "befp_provable": befp_provable,
+        }
+        log.warn(
+            "sdc quarantine: device result discarded",
+            op=op,
+            site=self.last_sdc["site"],
+            mismatches=self.last_sdc["mismatches"],
+            height=self.height + 1,
+            befp_provable=befp_provable,
+        )
+        try:
+            from celestia_tpu.telemetry import metrics
+
+            metrics.incr_counter("sdc_quarantine_total", op=op)
+        except Exception:  # noqa: BLE001 — metrics never break proposals
+            pass
+        sp = tracing.current()
+        if sp is not None:
+            sp.set(sdc=True, sdc_site=self.last_sdc["site"],
+                   befp_provable=befp_provable)
+        return self._degrade_tpu(op, exc, cause="corruption")
 
     def _proposal_dah(
         self, data_square, builder=None
@@ -401,10 +478,19 @@ class App:
                           height=self.height + 1, path="proposal") as bspan, \
                 metrics.measure("extend_block", path="proposal"):
             if backend == "tpu":
+                from celestia_tpu import integrity
                 from celestia_tpu.ops import extend_tpu
 
+                eng = integrity.get()
                 try:
-                    if builder is not None and self.blob_pool is not None:
+                    if (builder is not None and self.blob_pool is not None
+                            and not eng.enabled):
+                        # arena and roots-only paths never materialize
+                        # the EDS, so there is nothing to audit; under
+                        # an active audit policy the proposal routes
+                        # through the EDS-producing entry instead
+                        # (ADR-015 trades the transfer saving for the
+                        # integrity check)
                         dah = self._assembled_proposal_dah(
                             data_square, builder, k
                         )
@@ -424,14 +510,28 @@ class App:
                         if dah is not None:
                             self._tpu_strikes = 0
                             return dah
-                    rows, cols = extend_tpu.roots_device(
-                        self._square_array(data_square, k)
-                    )
+                    if eng.enabled:
+                        _eds_dev, rows, cols = (
+                            extend_tpu.extend_roots_device_resident(
+                                self._square_array(data_square, k)
+                            )
+                        )
+                        import numpy as np
+
+                        rows = np.asarray(rows)
+                        cols = np.asarray(cols)
+                    else:
+                        rows, cols = extend_tpu.roots_device(
+                            self._square_array(data_square, k)
+                        )
                     self._tpu_strikes = 0
                     return da.DataAvailabilityHeader(
                         [r.tobytes() for r in rows],
                         [c.tobytes() for c in cols],
                     )
+                except integrity.IntegrityError as exc:
+                    backend = self._quarantine_tpu("proposal_dah", exc)
+                    bspan.set(backend=backend)
                 except Exception as exc:  # noqa: BLE001 — degrade to host
                     backend = self._degrade_tpu("proposal_dah", exc)
                     bspan.set(backend=backend)
@@ -562,6 +662,7 @@ class App:
             if backend in ("tpu", "native"):
                 arr = self._square_array(data_square, k)
                 if backend == "tpu":
+                    from celestia_tpu import integrity
                     from celestia_tpu.ops import extend_tpu
 
                     try:
@@ -577,6 +678,9 @@ class App:
                         )
                         self._tpu_strikes = 0
                         return da.ExtendedDataSquare.from_device(eds_dev, k), dah
+                    except integrity.IntegrityError as exc:
+                        backend = self._quarantine_tpu("extend_and_hash", exc)
+                        bspan.set(backend=backend)
                     except Exception as exc:  # noqa: BLE001 — degrade to host
                         backend = self._degrade_tpu("extend_and_hash", exc)
                         bspan.set(backend=backend)

@@ -119,6 +119,13 @@ def cmd_start(args):
     from celestia_tpu.ops import enable_compile_cache
 
     enable_compile_cache()
+    # SDC audit policy (ADR-015): installs the process-global integrity
+    # engine BEFORE the node boots, so replay/startup extends are
+    # audited too. Default off — the disabled path costs one boolean.
+    if getattr(args, "audit_level", None):
+        from celestia_tpu import integrity
+
+        integrity.configure(args.audit_level)
     # App.__init__ validates the backend string, so a config/env typo
     # fails loudly here instead of silently degrading to numpy
     node = _build_node(home, extend_backend=cfg.app.extend_backend)
@@ -179,7 +186,8 @@ def cmd_start(args):
     print(f"node started: chain {node.app.chain_id} height {node.latest_height()} "
           f"rpc http://127.0.0.1:{server.port} {grpc_note}"
           f"min-gas-price {cfg.app.min_gas_price} "
-          f"extend-backend {cfg.app.extend_backend} (live: {live})")
+          f"extend-backend {cfg.app.extend_backend} (live: {live}) "
+          f"audit-level {getattr(node.app, 'audit_level', 'off')}")
     # an initial snapshot so a hard crash before the first interval never
     # leaves blocks-without-meta (which _build_node refuses to re-init)
     node.save_snapshot()
@@ -463,6 +471,37 @@ def cmd_slo(args):
     sys.exit(0 if (verdict["ready"] and slo_ok) else 1)
 
 
+def cmd_ops(args):
+    """`celestia-tpu ops audit <height>`: fetch a committed block's
+    extended square from a running node and re-verify EVERY row and
+    column against the GF(256) erasure code on the host — the offline
+    full-strength SDC audit (ADR-015). Exit 0 clean, 1 when any parity
+    cell mismatches the code, 2 when the block is unavailable."""
+    import numpy as np
+
+    from celestia_tpu import integrity
+
+    try:
+        doc = _rpc(args, "GET", f"/eds/{args.height}")
+    except Exception as e:  # noqa: BLE001 — unreachable/missing: exit 2
+        print(json.dumps({"error": f"cannot fetch eds: {e}"}),
+              file=sys.stderr)
+        sys.exit(2)
+    w = int(doc["width"])
+    eds = np.stack([
+        np.frombuffer(bytes.fromhex(r), dtype=np.uint8).reshape(w, -1)
+        for r in doc["rows"]
+    ])
+    mism = int(integrity.host_eds_mismatch(eds, w // 2))
+    print(json.dumps({
+        "height": args.height,
+        "width": w,
+        "mismatching_parity_cells": mism,
+        "ok": mism == 0,
+    }))
+    sys.exit(0 if mism == 0 else 1)
+
+
 def cmd_light(args):
     """Fraud-aware light client (specs/fraud_proofs.md consumer role):
     follow headers from a primary full node, screen each against
@@ -575,6 +614,13 @@ def main(argv=None):
                               "this node every SECONDS (verified "
                               "/sample + /proof/share probes feeding "
                               "the availability SLO; default: off)")
+    p_start.add_argument("--audit-level", default=None,
+                         choices=["off", "sampled", "full"],
+                         help="integrity audit of every device extend/"
+                              "repair before the DAH commits (ADR-015): "
+                              "off = zero overhead, sampled = q random "
+                              "rows+cols device-side, full = sampled + "
+                              "host recompute comparison")
 
     p_export = sub.add_parser("export")
     p_export.add_argument("--for-zero-height", action="store_true")
@@ -605,6 +651,14 @@ def main(argv=None):
     p_slo = sub.add_parser(
         "slo", help="SLO/readiness checks against a running node")
     p_slo.add_argument("slo_cmd", choices=["check"])
+
+    p_ops = sub.add_parser(
+        "ops", help="operator drills against a running node")
+    ops_sub = p_ops.add_subparsers(dest="ops_cmd", required=True)
+    p_audit = ops_sub.add_parser(
+        "audit", help="host-recompute the erasure code over one "
+        "committed block's extended square (exit 1 on any mismatch)")
+    p_audit.add_argument("height", type=int)
 
     p_dl = sub.add_parser("download-genesis")
     p_dl.add_argument("--node", required=True,
@@ -656,6 +710,7 @@ def main(argv=None):
         "tx": cmd_tx,
         "query": cmd_query,
         "slo": cmd_slo,
+        "ops": cmd_ops,
         "download-genesis": cmd_download_genesis,
         "addrbook": cmd_addrbook,
         "rollback": cmd_rollback,

@@ -9,8 +9,12 @@ touching production code paths:
     codec.call             CodecClient gRPC calls        (service/codec_service.py)
     codec.backend          CodecServer handler entry     (service/codec_service.py)
     device.extend          TPU extend host entries       (ops/extend_tpu.py)
+    device.extend.output   extend RESULT tensor in flight (ops/extend_tpu.py)
     device.repair          TPU repair host entries       (ops/repair_tpu.py)
+    device.repair.output   repair RESULT tensor in flight (ops/repair_tpu.py)
+    transfer.chunk         one chunk of a chunked H2D/D2H (ops/transfers.py)
     watchtower.befp        light-client watchtower query (node/client.py)
+    probe.request          synthetic DAS prober fetches  (node/prober.py)
 
 Fault kinds:
 
@@ -19,6 +23,13 @@ Fault kinds:
     reset        raise ConnectionResetFault (also a ConnectionResetError)
     corrupt      flip one payload byte (the site applies the returned
                  corruptor to its raw response bytes)
+    bitflip      flip ONE BIT at a seeded byte position — the silent-
+                 data-corruption model (HBM upset, miscompiled slice,
+                 damaged DMA chunk). The site applies the returned
+                 flipper to its result tensor/bytes; unlike ``corrupt``
+                 (a wire-damage model that garbles a whole byte of a
+                 framed payload), ``bitflip`` is the minimal corruption
+                 an integrity audit must still catch.
     unavailable  raise DeviceUnavailable (device gone / backend down)
 
 Scoping and determinism: ``with faults.inject(rule(...), seed=N):``
@@ -63,7 +74,7 @@ class DeviceUnavailable(FaultError):
     """Injected device/backend unavailability (TPU gone, sidecar down)."""
 
 
-KINDS = ("delay", "error", "reset", "corrupt", "unavailable")
+KINDS = ("delay", "error", "reset", "corrupt", "bitflip", "unavailable")
 
 
 @dataclasses.dataclass
@@ -109,6 +120,34 @@ def _corruptor(pos_draw: int):
     return corrupt
 
 
+def _bitflipper(pos_draw: int, bit_draw: int):
+    """One-bit flipper over bytes OR uint8 tensors (the SDC model).
+
+    Accepts bytes/bytearray or anything ``np.asarray`` understands
+    (numpy or device arrays — device buffers are pulled to host, which
+    is fine: bitflip only ever runs under an armed injector)."""
+    mask = 1 << (bit_draw % 8)
+
+    def flip(payload):
+        if payload is None:
+            return payload
+        if isinstance(payload, (bytes, bytearray)):
+            if not payload:
+                return bytes(payload)
+            out = bytearray(payload)
+            out[pos_draw % len(out)] ^= mask
+            return bytes(out)
+        import numpy as np  # lazy: keep the module stdlib-importable
+
+        arr = np.array(np.asarray(payload), copy=True)
+        flat = arr.reshape(-1).view(np.uint8)
+        if flat.size:
+            flat[pos_draw % flat.size] ^= np.uint8(mask)
+        return arr
+
+    return flip
+
+
 class FaultInjector:
     """Seeded decision engine over a set of FaultRules.
 
@@ -151,6 +190,10 @@ class FaultInjector:
                 self.schedule.append((seq, site, r.kind))
                 if r.kind == "corrupt":
                     corrupt = _corruptor(self.rng.randrange(1 << 16))
+                elif r.kind == "bitflip":
+                    corrupt = _bitflipper(
+                        self.rng.randrange(1 << 24), self.rng.randrange(8)
+                    )
                 else:
                     actions.append(r)
         for r in actions:
@@ -191,8 +234,9 @@ def inject(*rules: FaultRule, seed: int = 0, injector: FaultInjector | None = No
 
 def fire(site: str, **ctx):
     """Site hook: no-op (None) unless an injector is armed. Returns a
-    payload corruptor when a ``corrupt`` rule strikes; raises for
-    error/reset/unavailable strikes; sleeps for delay strikes."""
+    payload corruptor/flipper when a ``corrupt``/``bitflip`` rule
+    strikes; raises for error/reset/unavailable strikes; sleeps for
+    delay strikes."""
     inj = active()
     if inj is None:
         return None

@@ -51,7 +51,7 @@ class Prober:
     def __init__(self, base_url: str, interval: float = 5.0,
                  samples_per_cycle: int = 4, timeout: float = 5.0,
                  share_proofs: bool = True, rng: random.Random | None = None,
-                 registry=None):
+                 registry=None, host_crosscheck: bool = False):
         if registry is None:
             from celestia_tpu.telemetry import metrics as registry
         self.base_url = base_url.rstrip("/")
@@ -59,6 +59,9 @@ class Prober:
         self.samples_per_cycle = samples_per_cycle
         self.timeout = timeout
         self.share_proofs = share_proofs
+        # opt-in SDC cross-check (ADR-015): one sampled row per cycle
+        # is re-verified against the erasure code on the host
+        self.host_crosscheck = host_crosscheck
         # seedable for deterministic tests; SystemRandom in production
         # so a probing pattern cannot be predicted/special-cased
         self.rng = rng if rng is not None else random.SystemRandom()
@@ -114,9 +117,17 @@ class Prober:
             if self._probe_share_proof(height, self.rng.randrange(k * k),
                                        dah):
                 summary["share_proof_ok"] += 1
+        crosscheck_ok = True
+        if self.host_crosscheck:
+            summary["crosschecks"] = 1
+            crosscheck_ok = self._probe_host_crosscheck(
+                height, self.rng.randrange(w), k, w
+            )
+            summary["crosscheck_ok"] = int(crosscheck_ok)
         summary["ok"] = (
             summary["sample_ok"] == summary["samples"]
             and summary["share_proof_ok"] == summary["share_proofs"]
+            and crosscheck_ok
         )
         summary["height"] = height
         self._finish(summary)
@@ -197,6 +208,45 @@ class Prober:
         self.metrics.incr_counter("probe_share_proof_total")
         if ok:
             self.metrics.incr_counter("probe_share_proof_ok_total")
+        return ok
+
+    def _probe_host_crosscheck(self, height: int, i: int, k: int,
+                               w: int) -> bool:
+        """Opt-in SDC cross-check (host_crosscheck=True, ADR-015):
+        fetch every cell of ONE sampled row and re-verify the erasure
+        relation host-side. NMT proofs only bind shares to the
+        COMMITTED roots — if the square was committed mis-encoded
+        (silent corruption upstream of the DAH), every per-cell proof
+        still verifies; the code relation is the one invariant that
+        cannot. A failure here is recorded as a detected SDC."""
+        import numpy as np
+
+        from celestia_tpu.da import fraud
+
+        ok = False
+        try:
+            cells = []
+            for j in range(w):
+                res = self._get(f"/sample/{height}/{i}/{j}")
+                cells.append(
+                    np.frombuffer(bytes.fromhex(res["share"]), dtype=np.uint8)
+                )
+            ok = not fraud._axis_is_bad(np.stack(cells), k)
+        except Exception as e:  # noqa: BLE001 — unverifiable = not ok
+            log.debug("probe crosscheck failed", height=height, row=i,
+                      error=str(e))
+        self.metrics.incr_counter("probe_crosscheck_total")
+        if ok:
+            self.metrics.incr_counter("probe_crosscheck_ok_total")
+        else:
+            try:
+                from celestia_tpu import integrity
+
+                integrity.record_sdc("probe.crosscheck")
+            except Exception:  # noqa: BLE001 — accounting never kills probes
+                pass
+            log.warn("probe crosscheck: row violates the erasure code",
+                     height=height, row=i)
         return ok
 
     def _finish(self, summary: dict) -> None:

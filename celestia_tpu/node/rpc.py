@@ -122,6 +122,18 @@ def _handler_for(node: Node):
                             ),
                             "tpu_strikes": node.app._tpu_strikes,
                             "tpu_disabled": node.app._tpu_disabled,
+                            # SDC defense (ADR-015): quarantine state +
+                            # the live audit policy, operator-visible
+                            "audit_level": getattr(
+                                node.app, "audit_level", "off"
+                            ),
+                            "sdc_quarantined": bool(getattr(
+                                node.app, "sdc_quarantined", False
+                            )),
+                            "sdc_events": int(getattr(
+                                node.app, "sdc_events", 0
+                            )),
+                            "last_sdc": getattr(node.app, "last_sdc", None),
                         }
                     )
                 elif parts == ["healthz"]:
@@ -731,10 +743,32 @@ def _handler_for(node: Node):
                 self._route_post()
 
         def _route_post(self):
-            length = int(self.headers.get("Content-Length", 0))
-            body = json.loads(self.rfile.read(length) or b"{}")
+            from celestia_tpu import faults
+
             parts = [p for p in self.path.split("/") if p]
             try:
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length)
+                # request-side fault application (specs/faults.md): a
+                # corrupt/bitflip rule armed at ``rpc.post`` mangles the
+                # body AS RECEIVED — the server-side twin of the
+                # client-side fire in node/client.py, so body-corruption
+                # drills hold for any client speaking to the node
+                flip = faults.fire("rpc.post", path=self.path, side="server")
+                if flip is not None:
+                    raw = flip(raw)
+                # a mangled body is a CLIENT-VISIBLE 400, never a 500
+                # traceback: the bytes were wrong, not the server
+                try:
+                    body = json.loads(raw or b"{}")
+                except ValueError as e:
+                    self._reply({"error": f"malformed JSON body: {e}",
+                                 "status": 400}, 400)
+                    return
+                if not isinstance(body, dict):
+                    self._reply({"error": "request body must be a JSON "
+                                          "object", "status": 400}, 400)
+                    return
                 if parts == ["broadcast_tx"]:
                     raw = bytes.fromhex(body["tx"])
                     res = node.broadcast_tx(raw)
@@ -818,6 +852,12 @@ def _handler_for(node: Node):
                         self._reply(validator.handle_fraud(body))
                 else:
                     self._not_found()
+            except (KeyError, TypeError, ValueError) as e:
+                # wrong-shaped but parseable bodies (missing keys, bad
+                # hex/base64) are the client's fault: consistent 400
+                log.warn("bad request", path=self.path, error=str(e))
+                self._reply({"error": f"bad request: {e}", "status": 400},
+                            400)
             except Exception as e:  # noqa: BLE001
                 log.error("broadcast failed", path=self.path, error=str(e))
                 self._reply({"error": str(e)}, 500)

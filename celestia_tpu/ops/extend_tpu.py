@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from celestia_tpu import faults
+from celestia_tpu import faults, integrity
 from celestia_tpu import namespace as ns
 from celestia_tpu import tracing
 from celestia_tpu.appconsts import (
@@ -214,7 +214,18 @@ def extend_roots_device(shares: np.ndarray):
         with tracing.span("extend.rs_nmt", backend="tpu", k=k,
                           fused="rs+nmt"):
             eds, rows, cols = _jitted_roots_for_k(k)(dev)
-            return np.asarray(eds), np.asarray(rows), np.asarray(cols)
+        # SDC model: the result tensor is damaged in flight (HBM upset,
+        # bad D2H) — the audit below must catch what the flip injects
+        flip = faults.fire("device.extend.output",
+                           entry="extend_roots_device")
+        if flip is not None:
+            eds = jnp.asarray(flip(eds))
+        eng = integrity.get()
+        if eng.enabled:
+            integrity.audit_or_raise(eng, eds, k,
+                                     site="device.extend.output",
+                                     where="device.extend")
+        return np.asarray(eds), np.asarray(rows), np.asarray(cols)
 
 
 def extend_roots_device_resident(shares: np.ndarray):
@@ -235,7 +246,16 @@ def extend_roots_device_resident(shares: np.ndarray):
         with tracing.span("extend.rs_nmt", backend="tpu", k=k,
                           fused="rs+nmt"):
             eds, rows, cols = _jitted_roots_for_k(k)(dev)
-            return eds, np.asarray(rows), np.asarray(cols)
+        flip = faults.fire("device.extend.output",
+                           entry="extend_roots_device_resident")
+        if flip is not None:
+            eds = jnp.asarray(flip(eds))
+        eng = integrity.get()
+        if eng.enabled:
+            integrity.audit_or_raise(eng, eds, k,
+                                     site="device.extend.output",
+                                     where="device.extend")
+        return eds, np.asarray(rows), np.asarray(cols)
 
 
 @functools.lru_cache(maxsize=8)

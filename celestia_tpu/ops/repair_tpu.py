@@ -31,7 +31,7 @@ import functools
 
 import numpy as np
 
-from celestia_tpu import faults, tracing
+from celestia_tpu import faults, integrity, tracing
 from celestia_tpu.ops import gf256
 from celestia_tpu.ops.rs_tpu import expand_bit_matrix, pack_bits, unpack_bits
 
@@ -337,6 +337,8 @@ def repair_resident_verified(
 
         run, _ = stage_resident_repair(eds, present, device)
         fixed = run()
+        fixed = _postprocess_repair(fixed, k,
+                                    entry="repair_resident_verified")
         if row_roots is not None or col_roots is not None:
             with tracing.span("repair.verify", backend="tpu", k=k):
                 rows, cols = extend_tpu.eds_roots_device(fixed)
@@ -371,6 +373,25 @@ def repair_tpu(
         from celestia_tpu.ops import transfers
 
         run, _ = stage_resident_repair(eds, present, device)
+        out = _postprocess_repair(run(), k, entry="repair_tpu")
         # overlapped row-block download (all D2H DMAs in flight at once)
         # instead of one monolithic blocking device_get
-        return transfers.device_get_chunked(run(), site="repair.fetch")
+        return transfers.device_get_chunked(out, site="repair.fetch")
+
+
+def _postprocess_repair(fixed, k: int, *, entry: str):
+    """The device.repair.output fault site + the integrity audit over
+    the repaired square (ADR-015): a seeded bitflip damages the result
+    in flight, and the syndrome audit must raise IntegrityError before
+    any caller trusts the bytes. Audits off = one boolean check."""
+    flip = faults.fire("device.repair.output", entry=entry)
+    if flip is not None:
+        import jax.numpy as jnp
+
+        fixed = jnp.asarray(flip(fixed))
+    eng = integrity.get()
+    if eng.enabled:
+        integrity.audit_or_raise(eng, fixed, k,
+                                 site="device.repair.output",
+                                 where="device.repair")
+    return fixed

@@ -27,6 +27,16 @@ bytes across the interconnect, with three disciplines:
    ≤ 2 rows"). Metrics never break the hot path (same swallow pattern
    as ops/blob_pool.py).
 
+4. **Integrity** (ADR-015) — when the process-global audit engine
+   (celestia_tpu/integrity.py) is enabled, the chunked paths compute a
+   CRC-32C per chunk at the SOURCE and verify it at the SINK (readback
+   for uploads, cached-value comparison for downloads), retrying the
+   damaged chunk exactly once before raising IntegrityError. Every
+   chunk also passes the `transfer.chunk` fault site, so a chaos drill
+   arms `bitflip` there and the checksum must catch the flipped bit.
+   With audits off the only added cost is the site's empty-injector
+   check — no checksums, no readbacks, no clocks.
+
 The analogue of the host/device data-movement discipline TPU inference
 kernels apply (PAPERS.md, "Ragged Paged Attention"): keep bytes where
 the compute is, and move only what the consumer actually reads.
@@ -38,6 +48,8 @@ import functools
 import time
 
 import numpy as np
+
+from celestia_tpu import faults, integrity
 
 # Bulk transfers split into row-block chunks of at least this many bytes
 # (smaller chunks are dispatch-bound: through this environment's ~8 MB/s
@@ -189,16 +201,56 @@ def device_put_chunked(arr: np.ndarray, device=None, *, site: str,
     nbytes = arr.nbytes
     c = chunks if chunks is not None else _auto_chunks(nbytes, n)
     c = max(1, min(int(c), n)) if n else 1
-    if c <= 1:
-        out = jax.device_put(arr, device)
-    else:
-        parts = [
-            jax.device_put(np.ascontiguousarray(arr[lo:hi]), device)
-            for lo, hi in _bounds(n, c)
-        ]
-        out = jnp.concatenate(parts, axis=0)
+    eng = integrity.get()
+    bounds = [(0, n)] if c <= 1 else _bounds(n, c)
+    verify = eng.sample_chunks(len(bounds)) if eng.enabled else ()
+    parts = []
+    for idx, (lo, hi) in enumerate(bounds):
+        block = arr if c <= 1 else np.ascontiguousarray(arr[lo:hi])
+        # checksum the PRISTINE source before the wire — the fault site
+        # models in-flight damage, which the sink check must catch
+        want = integrity.crc32c(block) if idx in verify else None
+        flip = faults.fire("transfer.chunk", transfer=site, direction="h2d",
+                           index=idx)
+        part = jax.device_put(block if flip is None else flip(block),
+                              device)
+        if want is not None:
+            part = _verify_put_chunk(part, block, want, site, idx, device)
+        parts.append(part)
+    out = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
     _record(site, "h2d", nbytes, start)
     return out
+
+
+def _verify_put_chunk(part, pristine, want, site, idx, device):
+    """Verify one uploaded chunk at the sink (device readback CRC vs
+    the source CRC); retry the DMA once from the pristine source before
+    raising. Only reached with audits enabled."""
+    import jax
+
+    got = integrity.crc32c(np.asarray(part))
+    if got == want:
+        return part
+    integrity.record_sdc("transfer.chunk")
+    try:
+        from celestia_tpu.telemetry import metrics
+
+        metrics.incr_counter("transfer_retry_total", site=site,
+                             direction="h2d")
+    except Exception:  # noqa: BLE001
+        pass
+    # the retry re-drives the wire (and re-passes the fault site: a
+    # persistent fault strikes again and the retry fails too)
+    flip = faults.fire("transfer.chunk", transfer=site, direction="h2d",
+                       index=idx, retry=1)
+    part = jax.device_put(pristine if flip is None else flip(pristine),
+                          device)
+    if integrity.crc32c(np.asarray(part)) != want:
+        raise integrity.IntegrityError(
+            f"h2d chunk {idx} corrupt after retry at {site} "
+            f"(crc {got:#010x} != {want:#010x})"
+        )
+    return part
 
 
 def device_get_chunked(dev, *, site: str, chunks: int | None = None) -> np.ndarray:
@@ -217,16 +269,61 @@ def device_get_chunked(dev, *, site: str, chunks: int | None = None) -> np.ndarr
     c = chunks if chunks is not None else _auto_chunks(nbytes, n)
     c = max(1, min(int(c), n)) if n else 1
     if c <= 1:
-        out = np.asarray(dev)
+        dev_parts = [dev]
     else:
-        parts = [
+        dev_parts = [
             jax.lax.slice_in_dim(dev, lo, hi, axis=0)
             for lo, hi in _bounds(n, c)
         ]
-        for p in parts:
+        for p in dev_parts:
             async_copy = getattr(p, "copy_to_host_async", None)
             if async_copy is not None:
                 async_copy()
-        out = np.concatenate([np.asarray(p) for p in parts], axis=0)
+    eng = integrity.get()
+    verify = eng.sample_chunks(len(dev_parts)) if eng.enabled else ()
+    host_parts = []
+    for idx, p in enumerate(dev_parts):
+        block = np.asarray(p)
+        flip = faults.fire("transfer.chunk", transfer=site, direction="d2h",
+                           index=idx)
+        if flip is not None:
+            block = flip(block)
+        if idx in verify:
+            block = _verify_get_chunk(block, p, site, idx)
+        host_parts.append(block)
+    out = host_parts[0] if len(host_parts) == 1 else np.concatenate(
+        host_parts, axis=0
+    )
     _record(site, "d2h", nbytes, start)
     return out
+
+
+def _verify_get_chunk(block, dev_part, site, idx):
+    """Verify one downloaded chunk at the sink: compare its CRC against
+    an independent read of the same device slice; on disagreement retry
+    once and accept the two-of-three consensus. Only reached with
+    audits enabled."""
+    check = np.asarray(dev_part)
+    if integrity.crc32c(block) == integrity.crc32c(check):
+        return block
+    integrity.record_sdc("transfer.chunk")
+    try:
+        from celestia_tpu.telemetry import metrics
+
+        metrics.incr_counter("transfer_retry_total", site=site,
+                             direction="d2h")
+    except Exception:  # noqa: BLE001
+        pass
+    third = np.asarray(dev_part)
+    flip = faults.fire("transfer.chunk", transfer=site, direction="d2h",
+                       index=idx, retry=1)
+    if flip is not None:
+        third = flip(third)
+    c_third = integrity.crc32c(third)
+    if c_third == integrity.crc32c(check):
+        return check
+    if c_third == integrity.crc32c(block):
+        return block
+    raise integrity.IntegrityError(
+        f"d2h chunk {idx} corrupt after retry at {site}"
+    )

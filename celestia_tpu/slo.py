@@ -99,6 +99,12 @@ def default_objectives() -> list[Objective]:
         # intervenes (specs/observability.md degradation strikes)
         Objective(name="tpu_not_sticky_disabled", kind="counter_max",
                   counter="extend_tpu_disabled_total", limit=0.0),
+        # silent data corruption: ANY detected flip — device extend or
+        # repair output, transfer chunk — is a breach (ADR-015). The
+        # node keeps serving host-recomputed results, but a machine
+        # that produced one wrong answer is operator-attention-worthy.
+        Objective(name="sdc_detected", kind="counter_max",
+                  counter="sdc_detected_total", limit=0.0),
     ]
 
 
@@ -294,6 +300,16 @@ def readiness(node) -> tuple[bool, list[dict]]:
     check("not_sticky_degraded", not app._tpu_disabled,
           "" if not app._tpu_disabled else
           f"tpu sticky-disabled after {app._tpu_strikes} strikes")
+
+    # corruption quarantine (ADR-015): the node still serves (host
+    # recompute restored every result), but a load balancer should
+    # prefer replicas whose hardware has not produced a wrong answer
+    quarantined = bool(getattr(app, "sdc_quarantined", False))
+    last = getattr(app, "last_sdc", None) or {}
+    check("not_sdc_quarantined", not quarantined,
+          "" if not quarantined else
+          f"sdc at {last.get('site', 'unknown')} "
+          f"(height {last.get('height', '?')})")
 
     try:
         live = app.resolve_extend_backend(app.gov_square_size_upper_bound())

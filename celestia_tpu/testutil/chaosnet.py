@@ -95,6 +95,12 @@ class _StubApp:
         self.crossover = None
         self.blob_pool = None
         self.arena_stats = {"assembled": 0, "fallback": 0}
+        # SDC defense surface (ADR-015): /status + /readyz quarantine
+        # fields; sdc_smoke flips these to drill the serving-fit checks
+        self.audit_level = "off"
+        self.sdc_quarantined = False
+        self.sdc_events = 0
+        self.last_sdc: dict | None = None
 
     def resolve_extend_backend(self, k: int) -> str:
         if self._tpu_disabled and self.extend_backend == "tpu":

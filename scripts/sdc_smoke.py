@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""Silent-data-corruption smoke gate (ADR-015, `make sdc-smoke`).
+
+Crypto-free end-to-end drill of the SDC defense: arms a seeded bitflip
+at each injection point the integrity engine guards and fails (non-zero
+exit) unless:
+
+  1. a flipped extend result raises IntegrityError (with the corrupted
+     square attached as evidence) and `sdc_detected_total` increments,
+  2. the quarantine fall-through — host recompute of the same block —
+     restores the byte-identical DAH vs the CPU oracle, and the fraud
+     machinery (find_befp) proves the discarded square was bad-encoded,
+  3. a flipped repair result is caught the same way,
+  4. a flipped transfer chunk is healed by the one checksum retry
+     (transient) and raises when the fault is persistent,
+  5. /readyz flips to 503 naming `not_sdc_quarantined` when the app
+     reports quarantine — and back to 200 when it clears — with
+     /status carrying the `audit_level`/`sdc_*` fields,
+  6. audits OFF means off: the same flip passes silently (no raise, no
+     retry, no counter) and `integrity.get()` is the shared NOOP.
+
+CPU-only, seconds warm, no signing stack: the ops layer is drilled
+directly and the HTTP surface through the RpcChaosNode facade behind
+the real node/rpc.py handler.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SEED = 1337
+K = 4
+
+
+def fetch(base: str, path: str) -> tuple[int, dict]:
+    try:
+        with urllib.request.urlopen(base + path, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def gate(ok: bool, what: str) -> None:
+    print(("PASS " if ok else "FAIL ") + what)
+    if not ok:
+        raise SystemExit(f"sdc-smoke: {what}")
+
+
+def _square(k: int, seed: int = 3):
+    import numpy as np
+
+    from celestia_tpu import namespace as ns
+
+    rng = np.random.default_rng(seed)
+    flat = rng.integers(0, 256, size=(k * k, 512), dtype=np.uint8)
+    subs = sorted(
+        rng.integers(0, 200, size=(k * k, 10), dtype=np.uint8).tolist()
+    )
+    for i, sub in enumerate(subs):
+        flat[i, :29] = np.frombuffer(
+            ns.new_v0(bytes(sub)).bytes, dtype=np.uint8
+        )
+    return flat.reshape(k, k, 512)
+
+
+def check_extend_detection() -> None:
+    import numpy as np
+
+    from celestia_tpu import da, faults, integrity
+    from celestia_tpu.da import fraud
+    from celestia_tpu.ops import extend_tpu
+    from celestia_tpu.telemetry import metrics
+
+    shares = _square(K)
+    oracle = da.new_data_availability_header(da.extend_shares(shares))
+
+    integrity.configure("full")
+    before = metrics.get_counter(
+        "sdc_detected_total", site="device.extend.output"
+    )
+    caught = None
+    with faults.inject(
+        faults.rule("device.extend.output", "bitflip"), seed=SEED
+    ):
+        try:
+            extend_tpu.extend_roots_device(shares)
+        except integrity.IntegrityError as e:
+            caught = e
+    gate(caught is not None and caught.mismatches > 0,
+         "extend bitflip raises IntegrityError before any DAH commit")
+    gate(metrics.get_counter(
+        "sdc_detected_total", site="device.extend.output"
+    ) == before + 1, "sdc_detected_total{site=device.extend.output} +1")
+
+    # the quarantine fall-through: discard the device result, recompute
+    # on host, commit the byte-identical DAH the oracle agrees on
+    host_dah = da.new_data_availability_header(da.extend_shares(shares))
+    gate(host_dah.hash() == oracle.hash(),
+         "host recompute restores the byte-identical DAH")
+    gate(fraud.find_befp(np.ascontiguousarray(caught.eds)) is not None,
+         "find_befp proves the discarded square was bad-encoded")
+
+
+def check_repair_detection() -> None:
+    import numpy as np
+
+    from celestia_tpu import da, faults, integrity
+    from celestia_tpu.ops import repair_tpu
+
+    eds = da.extend_shares(_square(K)).data.copy()
+    present = np.ones((2 * K, 2 * K), dtype=bool)
+    present[0, 0] = False
+    damaged = eds.copy()
+    damaged[0, 0] = 0
+
+    integrity.configure("full")
+    caught = False
+    with faults.inject(
+        faults.rule("device.repair.output", "bitflip"), seed=SEED
+    ):
+        try:
+            repair_tpu.repair_tpu(damaged, present)
+        except integrity.IntegrityError:
+            caught = True
+    gate(caught, "repair bitflip raises IntegrityError")
+    out = repair_tpu.repair_tpu(damaged, present)
+    gate(np.array_equal(out, eds), "clean repair passes the full audit")
+
+
+def check_transfer_checksums() -> None:
+    import numpy as np
+
+    from celestia_tpu import faults, integrity
+    from celestia_tpu.ops import transfers
+    from celestia_tpu.telemetry import metrics
+
+    rng = np.random.default_rng(SEED)
+    arr = rng.integers(0, 256, size=(8, 512), dtype=np.uint8)
+
+    integrity.configure("full")
+    before = metrics.get_counter(
+        "transfer_retry_total", site="sdc.smoke", direction="h2d"
+    )
+    with faults.inject(
+        faults.rule("transfer.chunk", "bitflip", times=1), seed=SEED
+    ):
+        dev = transfers.device_put_chunked(arr, site="sdc.smoke", chunks=2)
+    gate(np.array_equal(np.asarray(dev), arr)
+         and metrics.get_counter(
+             "transfer_retry_total", site="sdc.smoke", direction="h2d"
+         ) == before + 1,
+         "transient chunk flip healed by the one checksum retry")
+
+    raised = False
+    with faults.inject(
+        faults.rule("transfer.chunk", "bitflip"), seed=SEED
+    ):
+        try:
+            transfers.device_put_chunked(arr, site="sdc.smoke", chunks=2)
+        except integrity.IntegrityError:
+            raised = True
+    gate(raised, "persistent chunk flip raises after the retry")
+
+
+def check_readyz_quarantine() -> None:
+    from celestia_tpu.node.rpc import RpcServer
+    from celestia_tpu.testutil.chaosnet import RpcChaosNode
+
+    node = RpcChaosNode(heights=0, k=K, chain_id="sdc-smoke")
+    node.grow()
+    server = RpcServer(node, port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        status, ready = fetch(base, "/readyz")
+        gate(status == 200 and ready["ready"] is True,
+             "/readyz 200 before quarantine")
+
+        node.app.sdc_quarantined = True
+        node.app.sdc_events = 1
+        node.app.last_sdc = {"op": "extend_and_hash",
+                             "site": "device.extend.output",
+                             "mismatches": 3, "height": 2,
+                             "befp_provable": True}
+        status, ready = fetch(base, "/readyz")
+        failing = [c["name"] for c in ready["checks"] if not c["ok"]]
+        gate(status == 503 and "not_sdc_quarantined" in failing,
+             f"/readyz 503 when quarantined (failing: {failing})")
+
+        status, st = fetch(base, "/status")
+        gate(status == 200 and st.get("sdc_quarantined") is True
+             and st.get("sdc_events") == 1
+             and st.get("last_sdc", {}).get("site")
+             == "device.extend.output"
+             and "audit_level" in st,
+             "/status carries audit_level + sdc quarantine fields")
+
+        node.app.sdc_quarantined = False
+        status, ready = fetch(base, "/readyz")
+        gate(status == 200, "/readyz 200 after quarantine clears")
+    finally:
+        server.stop()
+
+
+def check_off_means_off() -> None:
+    import numpy as np
+
+    from celestia_tpu import da, faults, integrity
+    from celestia_tpu.ops import extend_tpu
+    from celestia_tpu.telemetry import metrics
+
+    integrity.configure("off")
+    gate(integrity.get() is integrity.NOOP
+         and not integrity.get().enabled,
+         "audits off installs the shared stateless NOOP engine")
+
+    shares = _square(K)
+    oracle = da.extend_shares(shares).data
+    before = metrics.get_counter("sdc_detected_total")
+    with faults.inject(
+        faults.rule("device.extend.output", "bitflip"), seed=SEED
+    ):
+        eds, _rows, _cols = extend_tpu.extend_roots_device(shares)
+    gate(not np.array_equal(eds, oracle)
+         and metrics.get_counter("sdc_detected_total") == before,
+         "audits off: the flip passes silently, no audit cost, no "
+         "counter — the overhead is one boolean check")
+
+
+def main() -> int:
+    from celestia_tpu import integrity
+
+    try:
+        check_extend_detection()
+        check_repair_detection()
+        check_transfer_checksums()
+        check_readyz_quarantine()
+        check_off_means_off()
+    finally:
+        integrity.configure("off")
+    print("sdc-smoke: all gates passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
