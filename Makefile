@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test test-all test-slow chaos bench bench-transfers dryrun native \
-	trace-smoke bench-gate obs-smoke sdc-smoke
+	trace-smoke bench-gate obs-smoke sdc-smoke storm-smoke
 
 # Fast developer loop: the default tier skips the slow multi-process
 # suites (devnet, gRPC, multihost, network, race storms). Two FRESH
@@ -87,6 +87,15 @@ obs-smoke:
 # boolean check. CPU-only, crypto-free, seconds.
 sdc-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/sdc_smoke.py
+
+# Overload-resilience drill (specs/serving.md, ADR-016): saturate the
+# bounded admission queue through the real RPC stack, pin well-formed
+# 503+Retry-After sheds with zero 500s, 504 client deadlines, the
+# /readyz not_overloaded flip, graceful mid-storm drain, and a short
+# end-to-end `bench.py --das-storm-lite` run with every accepted
+# sample proof-verified. CPU-only, crypto-free, seconds.
+storm-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/storm_smoke.py
 
 # The driver's multichip compile/execute check on a virtual CPU mesh.
 dryrun:

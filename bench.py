@@ -1230,6 +1230,212 @@ def main():
         sys.exit(1)
 
 
+def _percentile(sorted_vals: list, q: float):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+def main_das_storm_lite(seconds: float = 3.0, threads: int = 8,
+                        queue_capacity: int = 4, deadline_ms: int = 500,
+                        stall_ms: float = 5.0, k: int = 8):
+    """`python bench.py --das-storm-lite`: a saturating DAS load storm
+    through the REAL serving stack — node/rpc.py handler + device
+    dispatcher + admission queue + the synthetic DAS prober — reporting
+    samples/sec, shed rate, and accepted-request p99 against the SLO
+    objectives (specs/serving.md).
+
+    The node behind the handler is the crypto-free chaosnet facade (the
+    same harness `make obs-smoke` boots), so the storm runs in stripped
+    environments and on CPU-only hosts; per-job device cost is emulated
+    with a deterministic `delay` rule at the documented `dispatch.run`
+    fault site (specs/faults.md) so the storm actually saturates the
+    bounded queue instead of measuring how fast chaosnet can answer.
+    Blocks are produced WHILE the storm runs (resident-cache churn).
+
+    Results are intentionally never merged into bench_cache.json: storm
+    numbers measure degradation behavior under an armed injector, not
+    best-of-session device performance. Exit is nonzero on any HTTP 500,
+    on a malformed shed reply, or on an accepted sample that fails
+    cryptographic verification."""
+    from celestia_tpu import faults
+    from celestia_tpu.da import DataAvailabilityHeader
+    from celestia_tpu.node.prober import Prober
+    from celestia_tpu.node.rpc import RpcServer
+    from celestia_tpu.slo import SloEngine, default_objectives
+    from celestia_tpu.telemetry import metrics
+    from celestia_tpu.testutil.chaosnet import RpcChaosNode
+
+    import json as _json
+    import random as _random
+    import threading as _threading
+    import urllib.error
+    import urllib.request
+
+    node = RpcChaosNode(heights=1, k=k)
+    server = RpcServer(node, port=0, queue_capacity=queue_capacity,
+                       default_deadline_s=deadline_ms / 1000.0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    w = 2 * k
+
+    engine = SloEngine(default_objectives(), registry=metrics)
+    engine.evaluate()  # baseline snapshot for the burn-rate windows
+
+    counts = {"200": 0, "503": 0, "504": 0, "other": 0, "500": 0}
+    accepted_lat_ms: list = []
+    accepted_samples: list = []  # (height, i, j, body)
+    malformed: list = []
+    lock = _threading.Lock()
+    stop = _threading.Event()
+
+    def fetch(path, headers=None):
+        req = urllib.request.Request(base + path, headers=headers or {})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, _json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, _json.loads(e.read())
+
+    def producer():
+        while not stop.wait(0.2):
+            node.grow()
+
+    def client(seed):
+        rng = _random.Random(seed)
+        while not stop.is_set():
+            h = rng.randint(1, node.latest_height())
+            i, j = rng.randrange(w), rng.randrange(w)
+            t0 = time.perf_counter()
+            try:
+                status, body = fetch(f"/sample/{h}/{i}/{j}")
+            except Exception:  # noqa: BLE001 — socket teardown at stop
+                continue
+            lat_ms = (time.perf_counter() - t0) * 1e3
+            with lock:
+                if status == 200:
+                    counts["200"] += 1
+                    accepted_lat_ms.append(lat_ms)
+                    accepted_samples.append((h, i, j, body))
+                elif status in (503, 504):
+                    counts[str(status)] += 1
+                    if status == 503 and (
+                        body.get("error") != "overloaded"
+                        or body.get("reason")
+                        not in ("queue_full", "draining")
+                    ):
+                        malformed.append(body)
+                elif status == 500:
+                    counts["500"] += 1
+                else:
+                    counts["other"] += 1
+
+    prober = Prober(base, samples_per_cycle=4, share_proofs=False,
+                    rng=_random.Random(1), registry=metrics)
+
+    def probe_loop():
+        while not stop.wait(0.25):
+            prober.probe_cycle()
+
+    storm_threads = (
+        [_threading.Thread(target=producer, daemon=True),
+         _threading.Thread(target=probe_loop, daemon=True)]
+        + [_threading.Thread(target=client, args=(s,), daemon=True)
+           for s in range(threads)]
+    )
+    t_start = time.perf_counter()
+    with faults.inject(
+        faults.rule("dispatch.run", "delay", delay_s=stall_ms / 1000.0),
+        seed=1337,
+    ):
+        for t in storm_threads:
+            t.start()
+        time.sleep(seconds)
+        # graceful drain MID-STORM is part of what this mode exercises
+        server.stop()
+        stop.set()
+        for t in storm_threads:
+            t.join(10.0)
+    elapsed = time.perf_counter() - t_start
+
+    # every accepted sample must still proof-verify (degradation must
+    # never corrupt acceptance) — DAHs come from the node's own store
+    # since the server is now down
+    from celestia_tpu.da import erasured_leaf_namespace
+    from celestia_tpu.proof import NmtRangeProof
+
+    verify_failures = 0
+    for h, i, j, body in accepted_samples:
+        try:
+            dah = node.dah(h)
+            share = bytes.fromhex(body["share"])
+            p = body["proof"]
+            proof = NmtRangeProof(
+                start=int(p["start"]), end=int(p["end"]),
+                nodes=[bytes.fromhex(x) for x in p["nodes"]],
+                tree_size=int(p["tree_size"]),
+            )
+            ns = erasured_leaf_namespace(i, j, share, k)
+            proof.verify_inclusion(dah.row_roots[i], [ns], [share])
+        except Exception:  # noqa: BLE001 — counted, reported, fatal
+            verify_failures += 1
+
+    slo = engine.evaluate()
+    slo_by_name = {o["name"]: o["ok"] for o in slo["objectives"]}
+    total = sum(counts.values())
+    shed = counts["503"] + counts["504"]
+    accepted_lat_ms.sort()
+    dispatcher_dead = not server.dispatcher.alive
+    out = {
+        "mode": "das-storm-lite",
+        "seconds": round(elapsed, 2),
+        "threads": threads,
+        "queue_capacity": queue_capacity,
+        "deadline_ms": deadline_ms,
+        "stall_ms": stall_ms,
+        "heights_produced": node.latest_height(),
+        "requests_total": total,
+        "counts": counts,
+        "samples_per_sec": round(counts["200"] / elapsed, 1),
+        "shed_rate": round(shed / total, 3) if total else None,
+        "accepted_p50_ms": (
+            round(_percentile(accepted_lat_ms, 0.50), 2)
+            if accepted_lat_ms else None
+        ),
+        "accepted_p99_ms": (
+            round(_percentile(accepted_lat_ms, 0.99), 2)
+            if accepted_lat_ms else None
+        ),
+        "accepted_verified": len(accepted_samples) - verify_failures,
+        "verify_failures": verify_failures,
+        "malformed_sheds": len(malformed),
+        "probe_availability_ratio": metrics.gauges.get(
+            "probe_availability_ratio"
+        ),
+        "drain_clean": dispatcher_dead,
+        "slo": {
+            "sample_availability_ok": slo_by_name.get(
+                "sample_availability"
+            ),
+            "rpc_admission_ok": slo_by_name.get("rpc_admission"),
+        },
+    }
+    print(_json.dumps(out))
+    failures = []
+    if counts["500"]:
+        failures.append(f"{counts['500']} HTTP 500s")
+    if malformed:
+        failures.append(f"{len(malformed)} malformed shed replies")
+    if verify_failures:
+        failures.append(f"{verify_failures} accepted samples failed "
+                        "verification")
+    if not dispatcher_dead:
+        failures.append("dispatcher thread survived drain")
+    if failures:
+        raise SystemExit("das-storm-lite failed: " + "; ".join(failures))
+
+
 def main_transfers():
     """`make bench-transfers` / `python bench.py --transfers`: the
     sliced-read and k=64 node-path configs with the fault injector ARMED
@@ -1318,7 +1524,23 @@ if __name__ == "__main__":
 
         _rec = _tracing.start_recording()
     try:
-        if "--transfers" in sys.argv:
+        if "--das-storm-lite" in sys.argv:
+            _kw = {}
+            for _flag, _key, _cast in (
+                ("--seconds", "seconds", float),
+                ("--threads", "threads", int),
+                ("--queue-capacity", "queue_capacity", int),
+                ("--deadline-ms", "deadline_ms", int),
+                ("--stall-ms", "stall_ms", float),
+                ("--k", "k", int),
+            ):
+                if _flag in sys.argv:
+                    _i = sys.argv.index(_flag)
+                    if _i + 1 >= len(sys.argv):
+                        raise SystemExit(f"{_flag} requires a value")
+                    _kw[_key] = _cast(sys.argv[_i + 1])
+            main_das_storm_lite(**_kw)
+        elif "--transfers" in sys.argv:
             main_transfers()
         else:
             main()

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import threading
 
 import numpy as np
 
@@ -61,6 +62,10 @@ class ExtendedDataSquare:
         self._data = squares
         self._device = None
         self._slice_cache: dict[tuple[str, int], list[bytes]] = {}
+        # concurrent /sample handlers share one instance: the insert +
+        # FIFO-evict below must not interleave (a bare dict pop races a
+        # concurrent insert mid-iteration)
+        self._slice_lock = threading.Lock()
         self.original_width = original_width
 
     @classmethod
@@ -82,7 +87,8 @@ class ExtendedDataSquare:
         # the device copy no longer matches — drop it, or device_data
         # consumers (repair_eds prefers it) would repair stale bytes
         self._device = None
-        self._slice_cache.clear()
+        with self._slice_lock:
+            self._slice_cache.clear()
 
     @property
     def device_data(self):
@@ -101,19 +107,24 @@ class ExtendedDataSquare:
         the DAS serving unit. Byte-identical to the full-fetch path
         (tests pin this across k and edge indices)."""
         key = (kind, idx)
-        cached = self._slice_cache.get(key)
+        with self._slice_lock:
+            cached = self._slice_cache.get(key)
         if cached is not None:
             return cached
         from celestia_tpu.ops import transfers
 
+        # the transfer itself runs unlocked (it may block on the device
+        # dispatcher); worst case two racers fetch the same immutable
+        # slice once each and the second insert wins
         if kind == "row":
             arr = transfers.eds_row(self._device, idx)
         else:
             arr = transfers.eds_col(self._device, idx)
         cells = [arr[t].tobytes() for t in range(self.width)]
-        if len(self._slice_cache) >= self._SLICE_CACHE_AXES:
-            self._slice_cache.pop(next(iter(self._slice_cache)))
-        self._slice_cache[key] = cells
+        with self._slice_lock:
+            if len(self._slice_cache) >= self._SLICE_CACHE_AXES:
+                self._slice_cache.pop(next(iter(self._slice_cache)))
+            self._slice_cache[key] = cells
         return cells
 
     def row(self, i: int) -> list[bytes]:

@@ -15,6 +15,15 @@ touching production code paths:
     transfer.chunk         one chunk of a chunked H2D/D2H (ops/transfers.py)
     watchtower.befp        light-client watchtower query (node/client.py)
     probe.request          synthetic DAS prober fetches  (node/prober.py)
+    dispatch.enqueue       device-dispatcher admission    (node/dispatch.py)
+    dispatch.run           device-dispatcher job body     (node/dispatch.py)
+
+The dispatch pair drives overload drills deterministically: a ``delay``
+rule at ``dispatch.run`` stalls the single dispatcher thread, which
+backs up the bounded queue (503 queue_full sheds) and expires request
+deadlines (504s); a ``delay`` at ``dispatch.enqueue`` holds request
+threads at the admission door instead. An ``error`` at either site
+surfaces through the route's standard error path.
 
 Fault kinds:
 

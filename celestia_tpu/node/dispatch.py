@@ -1,0 +1,343 @@
+"""Overload-resilient device dispatcher (ADR-016, specs/serving.md).
+
+The serving stack used to let any ThreadingHTTPServer handler thread
+touch the device: one slow transfer stalled unrelated requests, and an
+overload storm queued unboundedly inside the kernel's accept backlog
+until every client timed out — the node "fell over" instead of
+degrading. This module is the robustness half of the ROADMAP item-2
+refactor: request threads only parse/validate, and **all device work
+funnels through one dispatcher thread** that owns the device stream and
+pulls from a **bounded admission queue**. The same single-owner shape
+that keeps tail latency bounded in continuous-batching inference
+schedulers (Orca-style, PAPERS.md) — here tuned for graceful
+degradation:
+
+    shed        when the queue is full, `submit` fails IMMEDIATELY with
+                `Shed(reason="queue_full")` and a retry hint — the RPC
+                layer maps it to `503 + Retry-After`. The node never
+                queues unboundedly.
+    deadline    every admitted job carries an absolute deadline (server
+                default, capped by the client's `X-Deadline-Ms`); the
+                waiter gives up at the deadline (`DeadlineExceeded`,
+                mapped to 504) and the dispatcher skips jobs that
+                expire while queued instead of doing dead work.
+    drain       `begin_drain()` stops admission (`Shed("draining")`),
+                `drain()` finishes queued + in-flight work and then
+                stops the thread — the graceful-shutdown contract.
+
+Two lanes feed the loop: the bounded EXTERNAL queue (admitted RPC
+requests) and an unbounded INTERNAL lane (`run_device`) for device
+sub-operations issued by already-admitted work or by node-internal
+paths (blob staging at CheckTx, sliced reads from non-RPC callers via
+`ops/transfers.register_device_executor`). Internal jobs are served
+first — they are sub-steps of work the node already accepted, so
+shedding them would waste the admission that let their parent in.
+
+Fault sites (specs/faults.md): `dispatch.enqueue` fires in the
+submitting thread before admission (a `delay` rule holds request
+threads at the door), `dispatch.run` fires in the dispatcher thread
+before each job body (a `delay` rule stalls the single consumer, which
+is how chaos tests drive queue saturation and deadline expiry
+deterministically; an `error` rule surfaces as the route's standard
+error path).
+
+Everything here is stdlib-only, keeping node/rpc.py importable in
+stripped environments.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from celestia_tpu import faults, tracing
+from celestia_tpu.log import logger
+from celestia_tpu.telemetry import metrics
+
+log = logger("dispatch")
+
+
+class Shed(Exception):
+    """Admission refused — the caller should back off and retry.
+
+    `reason` is one of "queue_full" | "draining" (the
+    `rpc_shed_total{reason=...}` label set, plus "deadline" counted by
+    DeadlineExceeded paths). The RPC layer maps Shed to
+    `503 + Retry-After: ceil(retry_after_s)`."""
+
+    def __init__(self, reason: str, retry_after_s: float = 1.0):
+        super().__init__(f"overloaded: {reason}")
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceeded(Exception):
+    """The job's deadline expired before dispatch completed (mapped to
+    504). The result, if the job does finish later, is discarded."""
+
+
+class _Job:
+    __slots__ = ("fn", "label", "deadline", "enqueued_at", "done",
+                 "result", "error", "lock", "abandoned", "internal")
+
+    def __init__(self, fn, label: str, deadline: float | None,
+                 internal: bool = False):
+        self.fn = fn
+        self.label = label
+        self.deadline = deadline  # absolute monotonic, None = no deadline
+        self.enqueued_at = time.monotonic()
+        self.done = threading.Event()
+        self.result = None
+        self.error: BaseException | None = None
+        self.lock = threading.Lock()
+        self.abandoned = False  # waiter gave up; skip if not yet started
+        self.internal = internal
+
+
+class DeviceDispatcher:
+    """One thread owning the device stream, fed by a bounded queue."""
+
+    DEFAULT_CAPACITY = 64
+    DEFAULT_DEADLINE_S = 30.0
+    DEFAULT_RETRY_AFTER_S = 1.0
+
+    def __init__(self, capacity: int | None = None,
+                 default_deadline_s: float | None = None,
+                 registry=None, name: str = "device-dispatcher"):
+        self.capacity = int(capacity) if capacity else self.DEFAULT_CAPACITY
+        self.default_deadline_s = (default_deadline_s
+                                   if default_deadline_s
+                                   else self.DEFAULT_DEADLINE_S)
+        self.metrics = registry if registry is not None else metrics
+        self.name = name
+        self._cv = threading.Condition()
+        self._queue: collections.deque[_Job] = collections.deque()
+        self._internal: collections.deque[_Job] = collections.deque()
+        self._draining = False
+        self._running = False   # loop accepting work
+        self._busy = False      # a job body is executing right now
+        self._thread: threading.Thread | None = None
+
+    # -- introspection (readiness + tests) ----------------------------- #
+
+    @property
+    def depth(self) -> int:
+        """Admitted-but-not-yet-run external jobs."""
+        return len(self._queue)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def saturated(self) -> bool:
+        """Queue full RIGHT NOW — the /readyz overload signal (a load
+        balancer should route around a node that would shed)."""
+        return self.depth >= self.capacity
+
+    # -- lifecycle ----------------------------------------------------- #
+
+    def start(self) -> "DeviceDispatcher":
+        with self._cv:
+            if self._running:
+                return self
+            self._running = True
+            self._draining = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=self.name)
+        self._thread.start()
+        return self
+
+    def begin_drain(self) -> None:
+        """Stop admitting external work; queued + in-flight jobs still
+        complete. Sheds from here on carry reason="draining"."""
+        with self._cv:
+            if not self._draining:
+                self._draining = True
+                log.info("dispatcher draining", queued=len(self._queue))
+            self._cv.notify_all()
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Graceful stop: stop admitting, finish queued + in-flight
+        work, then stop the thread. Returns True when the drain was
+        clean (everything completed and the thread exited in time);
+        leftover jobs are flushed with Shed("draining") so no waiter
+        hangs."""
+        self.begin_drain()
+        end = time.monotonic() + timeout
+        with self._cv:
+            while ((self._queue or self._internal or self._busy)
+                   and time.monotonic() < end):
+                self._cv.wait(0.05)
+            clean = not (self._queue or self._internal or self._busy)
+            self._running = False
+            leftovers = list(self._queue) + list(self._internal)
+            self._queue.clear()
+            self._internal.clear()
+            self._cv.notify_all()
+        for job in leftovers:  # unblock any waiter the timeout stranded
+            with job.lock:
+                if not job.done.is_set():
+                    job.error = Shed("draining")
+                    job.done.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(max(0.0, end - time.monotonic()) + 1.0)
+            clean = clean and not thread.is_alive()
+            if not thread.is_alive():
+                self._thread = None
+        self._set_depth_gauge()
+        return clean
+
+    # -- admission ----------------------------------------------------- #
+
+    def submit(self, fn, *, deadline_s: float | None = None,
+               label: str = ""):
+        """Run `fn` on the dispatcher thread and return its result.
+
+        Raises `Shed` when the bounded queue refuses admission (full or
+        draining), `DeadlineExceeded` when the deadline expires before
+        the job completes, and re-raises whatever `fn` itself raised.
+        With no dispatcher thread running (embedding, tests of the raw
+        handler) the call degrades to inline execution."""
+        self.metrics.incr_counter("rpc_dispatch_total")
+        faults.fire("dispatch.enqueue", label=label)
+        if not self.alive:
+            if self._draining:
+                self._shed("draining")
+            self.metrics.incr_counter("rpc_dispatch_admitted_total")
+            return fn()
+        limit = deadline_s if deadline_s is not None else \
+            self.default_deadline_s
+        job = _Job(fn, label, time.monotonic() + limit)
+        with self._cv:
+            if self._draining or not self._running:
+                self._shed("draining")
+            if len(self._queue) >= self.capacity:
+                self._shed("queue_full")
+            self._queue.append(job)
+            self.metrics.incr_counter("rpc_dispatch_admitted_total")
+            self._set_depth_gauge_locked()
+            self._cv.notify_all()
+        return self._await(job)
+
+    def _shed(self, reason: str):
+        self.metrics.incr_counter("rpc_shed_total", reason=reason)
+        raise Shed(reason, self.DEFAULT_RETRY_AFTER_S)
+
+    def _await(self, job: _Job):
+        remaining = job.deadline - time.monotonic()
+        finished = job.done.wait(max(0.0, remaining))
+        if not finished:
+            with job.lock:
+                if not job.done.is_set():
+                    # the dispatcher will skip this job if it has not
+                    # started; if it IS mid-run the result is discarded
+                    job.abandoned = True
+                    self.metrics.incr_counter("rpc_shed_total",
+                                              reason="deadline")
+                    raise DeadlineExceeded(
+                        f"deadline expired before dispatch completed "
+                        f"({job.label or 'job'})"
+                    )
+            # completed in the race window between wait() and lock
+        if job.error is not None:
+            raise job.error
+        return job.result
+
+    # -- the internal lane (device sub-operations) --------------------- #
+
+    def run_device(self, fn):
+        """Execute `fn` on the dispatcher thread WITHOUT admission
+        control — the funnel for device sub-operations of work the node
+        already accepted (sliced serving reads via
+        `transfers.register_device_executor`, blob staging at CheckTx).
+        Runs inline when called from the dispatcher thread itself (no
+        self-deadlock) or when no dispatcher thread is running; falls
+        back to inline if the dispatcher cannot serve it within the
+        default deadline (the read must complete either way)."""
+        thread = self._thread
+        if thread is None or not thread.is_alive() or \
+                threading.current_thread() is thread:
+            return fn()
+        job = _Job(fn, "run_device", None, internal=True)
+        with self._cv:
+            if not self._running:
+                return fn()
+            self._internal.append(job)
+            self._cv.notify_all()
+        if not job.done.wait(self.default_deadline_s):
+            with job.lock:
+                if not job.done.is_set():
+                    job.abandoned = True
+                    return fn()  # dispatcher wedged: serve inline
+        if job.error is not None:
+            raise job.error
+        return job.result
+
+    # -- the loop ------------------------------------------------------ #
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while (self._running
+                       and not self._internal and not self._queue):
+                    self._cv.wait()
+                if not self._running and not self._internal \
+                        and not self._queue:
+                    self._cv.notify_all()
+                    return
+                if self._internal:
+                    job = self._internal.popleft()
+                else:
+                    job = self._queue.popleft()
+                    self._set_depth_gauge_locked()
+                self._busy = True
+            try:
+                self._run_job(job)
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    def _run_job(self, job: _Job) -> None:
+        now = time.monotonic()
+        if not job.internal:
+            self.metrics.observe("rpc_queue_wait", now - job.enqueued_at)
+        with job.lock:
+            if job.abandoned:
+                return  # the waiter already counted and answered
+            if job.deadline is not None and now >= job.deadline:
+                # expired while queued: skip the dead work; the waiter
+                # (who has not timed out yet, or is about to) sees the
+                # typed error. Counted HERE, under the job lock, so the
+                # deadline is recorded exactly once.
+                self.metrics.incr_counter("rpc_shed_total",
+                                          reason="deadline")
+                job.error = DeadlineExceeded(
+                    f"deadline expired in queue ({job.label or 'job'})"
+                )
+                job.done.set()
+                return
+        with tracing.span("dispatch.run", label=job.label,
+                          internal=job.internal):
+            try:
+                faults.fire("dispatch.run", label=job.label)
+                job.result = job.fn()
+            except BaseException as e:  # noqa: BLE001 — waiter re-raises
+                job.error = e
+        with job.lock:
+            job.done.set()
+
+    # -- gauges -------------------------------------------------------- #
+
+    def _set_depth_gauge(self) -> None:
+        with self._cv:
+            self._set_depth_gauge_locked()
+
+    def _set_depth_gauge_locked(self) -> None:
+        self.metrics.set_gauge("rpc_queue_depth", float(len(self._queue)))

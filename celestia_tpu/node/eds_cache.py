@@ -1,0 +1,89 @@
+"""Pin-guarded LRU for retained EDS handles (ADR-016 satellite).
+
+The plain OrderedDict it replaces had a race: an RPC thread could be
+mid-sliced-read on a cached device handle while a concurrent insert
+evicted that entry — with nothing tying the read to the cache's notion
+of liveness, a future cache that frees device pages on eviction
+(ROADMAP item 1's paged cache) would free them under the reader. Here
+readers BORROW entries via `pinned(height)`, and eviction skips pinned
+entries (deferring until the pin count drops to zero), so an eviction
+can never interleave with an in-flight read.
+
+Stdlib-only on purpose: the serving race regression tests run in
+stripped (crypto-free) environments where node/node.py itself cannot
+import.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import threading
+
+
+class ResidentEdsCache:
+    """Pin-guarded LRU of retained EDS handles (the 2-deep serving
+    cache for device-resident squares)."""
+
+    def __init__(self, capacity: int = 2):
+        self.capacity = capacity
+        self._entries: collections.OrderedDict[int, object] = \
+            collections.OrderedDict()
+        self._pins: collections.Counter[int] = collections.Counter()
+        self._lock = threading.Lock()
+
+    def get(self, height: int):
+        """Unpinned lookup — for callers that only hand the value on
+        (block_eds returning the handle). Sliced readers use
+        `pinned` instead."""
+        with self._lock:
+            value = self._entries.get(height)
+            if value is not None:
+                self._entries.move_to_end(height)
+            return value
+
+    @contextlib.contextmanager
+    def pinned(self, height: int):
+        """Borrow the entry for `height` (or None on a miss): while the
+        context is open the entry cannot be evicted."""
+        with self._lock:
+            value = self._entries.get(height)
+            if value is not None:
+                self._entries.move_to_end(height)
+                self._pins[height] += 1
+        try:
+            yield value
+        finally:
+            if value is not None:
+                with self._lock:
+                    self._pins[height] -= 1
+                    if self._pins[height] <= 0:
+                        del self._pins[height]
+                    self._evict_locked()  # deferred eviction lands now
+
+    def put(self, height: int, value) -> None:
+        with self._lock:
+            self._entries[height] = value
+            self._entries.move_to_end(height)
+            self._evict_locked()
+
+    def _evict_locked(self) -> None:
+        while len(self._entries) > self.capacity:
+            victim = next(
+                (h for h in self._entries if self._pins[h] == 0), None
+            )
+            if victim is None:
+                return  # everything pinned: defer until a pin drops
+            del self._entries[victim]
+
+    def pin_count(self, height: int) -> int:
+        with self._lock:
+            return self._pins[height]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, height: int) -> bool:
+        with self._lock:
+            return height in self._entries

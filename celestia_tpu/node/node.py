@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import hashlib
 import json
@@ -14,6 +15,7 @@ from celestia_tpu import tracing
 from celestia_tpu.app import App
 from celestia_tpu.app.app import ProposalBlockData, TxResult
 from celestia_tpu.log import logger
+from celestia_tpu.node.eds_cache import ResidentEdsCache
 
 log = logger("node")
 
@@ -202,11 +204,11 @@ class Node:
         self.fraudulent_data_hashes: set[bytes] = set()
         # reconstruction memo for the share-serving routes: committed
         # blocks are immutable, so /dah answers come from a tiny
-        # per-height cache and /eds from a 2-deep LRU (a full EDS is
-        # ~32 MB at k=128 — memoizing every height would eat the heap)
+        # per-height cache and /eds from a 2-deep pin-guarded LRU (a
+        # full EDS is ~32 MB at k=128 — memoizing every height would
+        # eat the heap; pinning keeps eviction out of in-flight reads)
         self._dah_cache: dict[int, object] = {}
-        self._eds_cache: "collections.OrderedDict[int, object]" = \
-            collections.OrderedDict()
+        self._eds_cache = ResidentEdsCache(capacity=2)
         self.home = pathlib.Path(home) if home else None
         if self.home:
             (self.home / "blocks").mkdir(parents=True, exist_ok=True)
@@ -224,6 +226,9 @@ class Node:
         self.started_at = time.monotonic()
         self.slo = None
         self.prober = None
+        # the device dispatcher (node/dispatch.py), attached by the
+        # RpcServer that serves this node; None when embedded
+        self.dispatcher = None
 
     MAX_FRAUD_PROOFS_PER_HEIGHT = 4
 
@@ -296,8 +301,19 @@ class Node:
                 try:
                     # put_many dispatches every blob's upload before the
                     # arena inserts — the DMAs overlap instead of
-                    # serializing per blob (ops/blob_pool.py)
-                    self.app.blob_pool.put_many([b.data for b in btx.blobs])
+                    # serializing per blob (ops/blob_pool.py). The
+                    # uploads are device work, so when a device
+                    # dispatcher is attached (RpcServer) they run on its
+                    # thread — CheckTx admission itself stays on the
+                    # request thread (specs/serving.md).
+                    blob_bytes = [b.data for b in btx.blobs]
+                    dispatcher = getattr(self, "dispatcher", None)
+                    if dispatcher is not None:
+                        dispatcher.run_device(
+                            lambda: self.app.blob_pool.put_many(blob_bytes)
+                        )
+                    else:
+                        self.app.blob_pool.put_many(blob_bytes)
                 except Exception as e:  # noqa: BLE001 — cache only
                     log.info("blob staging failed", error=str(e))
         return res
@@ -406,10 +422,7 @@ class Node:
                 with tracing.span("node.extend_retention",
                                   height=block.height):
                     eds = self.app.extend_block(proposal.txs)
-                    with self._lock:
-                        self._eds_cache[block.height] = eds
-                        while len(self._eds_cache) > 2:
-                            self._eds_cache.popitem(last=False)
+                    self._eds_cache.put(block.height, eds)
             except Exception as e:  # noqa: BLE001 — retention is a cache
                 log.info("eds retention failed", error=str(e))
 
@@ -479,11 +492,9 @@ class Node:
         published = getattr(self.app, "published_eds", None)
         if published and height in published:
             return published[height]
-        with self._lock:  # LRU mutation races concurrent RPC threads
-            cached = self._eds_cache.get(height)
-            if cached is not None:
-                self._eds_cache.move_to_end(height)
-                return cached
+        cached = self._eds_cache.get(height)  # cache holds its own lock
+        if cached is not None:
+            return cached
         block = self.blocks.get(height)
         if block is None:
             return None
@@ -500,44 +511,61 @@ class Node:
             block.txs, v, appconsts.square_size_upper_bound(v)
         )
         eds = da.extend_shares(to_bytes(sq)).data
-        with self._lock:
-            self._eds_cache[height] = eds
-            while len(self._eds_cache) > 2:
-                self._eds_cache.popitem(last=False)
+        self._eds_cache.put(height, eds)
         return eds
+
+    @contextlib.contextmanager
+    def _borrow_eds(self, height: int):
+        """Pin-guarded access to a block's EDS for sliced serving reads
+        (/sample, /proof/share). While the context is open, the LRU
+        cannot evict the borrowed square — the regression the plain
+        OrderedDict allowed. Published squares (MaliciousApp) keep their
+        precedence and are never evicted; a cache miss falls back to
+        block_eds reconstruction (the returned object is then held by
+        this frame, so it outlives the read regardless of the cache)."""
+        published = getattr(self.app, "published_eds", None)
+        if published and height in published:
+            yield published[height]
+            return
+        with self._eds_cache.pinned(height) as pinned:
+            if pinned is not None:
+                yield pinned
+                return
+        yield self.block_eds(height)
 
     def block_width(self, height: int) -> int | None:
         """Extended-square width of a committed block, source-agnostic
         (numpy array or ExtendedDataSquare handle — no byte fetch)."""
-        eds = self.block_eds(height)
-        if eds is None:
-            return None
-        if hasattr(eds, "original_width"):
-            return eds.width
-        return int(eds.shape[0])
+        with self._borrow_eds(height) as eds:
+            if eds is None:
+                return None
+            if hasattr(eds, "original_width"):
+                return eds.width
+            return int(eds.shape[0])
 
     def block_row(self, height: int, i: int) -> list[bytes] | None:
         """Row i of a block's extended square as share bytes — THE DAS
         serving read (/sample builds the row NMT proof from it). When
         the square is a device-resident handle only this row's w·512
         bytes cross the interconnect (ExtendedDataSquare.row sliced
-        path); host sources slice in memory. Byte-identical either way."""
-        eds = self.block_eds(height)
-        if eds is None:
-            return None
-        if hasattr(eds, "original_width"):
-            return eds.row(i)
-        return [bytes(eds[i, c]) for c in range(eds.shape[0])]
+        path); host sources slice in memory. Byte-identical either way.
+        The borrow pins the cache entry for the read's whole duration."""
+        with self._borrow_eds(height) as eds:
+            if eds is None:
+                return None
+            if hasattr(eds, "original_width"):
+                return eds.row(i)
+            return [bytes(eds[i, c]) for c in range(eds.shape[0])]
 
     def block_share(self, height: int, r: int, c: int) -> bytes | None:
         """One cell of a block's extended square (512 bytes moved for a
         device-resident square, not 32 MB)."""
-        eds = self.block_eds(height)
-        if eds is None:
-            return None
-        if hasattr(eds, "original_width"):
-            return eds.share(r, c)
-        return bytes(eds[r, c])
+        with self._borrow_eds(height) as eds:
+            if eds is None:
+                return None
+            if hasattr(eds, "original_width"):
+                return eds.share(r, c)
+            return bytes(eds[r, c])
 
     def block_dah(self, height: int):
         """The DataAvailabilityHeader a block's data_hash commits to —
@@ -551,14 +579,16 @@ class Node:
         dah = self._dah_cache.get(height)
         if dah is not None:
             return dah
-        eds = self.block_eds(height)
-        if eds is None:
-            return None
         from celestia_tpu import da
 
-        if not hasattr(eds, "original_width"):
-            eds = da.ExtendedDataSquare(eds, eds.shape[0] // 2)
-        dah = da.new_data_availability_header(eds)
+        # root computation bulk-reads a device-resident square once:
+        # borrow keeps the entry pinned across that fetch
+        with self._borrow_eds(height) as eds:
+            if eds is None:
+                return None
+            if not hasattr(eds, "original_width"):
+                eds = da.ExtendedDataSquare(eds, eds.shape[0] // 2)
+            dah = da.new_data_availability_header(eds)
         self._dah_cache[height] = dah
         return dah
 

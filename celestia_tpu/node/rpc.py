@@ -4,18 +4,34 @@ The reference exposes gRPC + grpc-gateway REST + CometBFT RPC
 (app/app.go:693-719). This serves the same capability set over a
 dependency-free JSON/HTTP server (stdlib): tx broadcast, tx/block/status
 queries, account + balance queries, and share/tx inclusion proofs.
+
+Overload resilience (ADR-016, specs/serving.md): request threads only
+parse/validate; the device-touching routes (/dah, /eds, /sample,
+/proof/share, /produce_block) funnel their work through ONE
+device-dispatcher thread behind a bounded admission queue. Queue full →
+immediate `503 + Retry-After` (never unbounded queueing); every
+dispatched request carries a deadline (server default, capped by the
+client's `X-Deadline-Ms` header) → `504` when it expires before
+dispatch completes; `RpcServer.stop()` drains gracefully (stop
+admitting, finish in-flight, then close). Health/readiness/metrics
+routes stay on the request thread — they must keep answering while the
+device queue is saturated, that is their whole job.
 """
 
 from __future__ import annotations
 
+import contextlib
 import http.server
 import json
+import math
 import threading
 import time
 from typing import TYPE_CHECKING
 
 from celestia_tpu import tracing
 from celestia_tpu.log import logger
+from celestia_tpu.node.dispatch import DeadlineExceeded, DeviceDispatcher, Shed
+from celestia_tpu.telemetry import metrics
 
 if TYPE_CHECKING:  # annotation-only: keeps this module stdlib-importable
     from celestia_tpu.node.node import Node
@@ -52,14 +68,54 @@ def _share_proof_json(proof) -> dict:
     }
 
 
-def _handler_for(node: Node):
+class _InflightTracker:
+    """Counts handler threads currently inside a request (the
+    `rpc_inflight_requests` gauge) and lets a graceful stop wait for
+    them to finish before the dispatcher drains."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._count = 0
+
+    def __enter__(self):
+        with self._cv:
+            self._count += 1
+            metrics.set_gauge("rpc_inflight_requests", float(self._count))
+        return self
+
+    def __exit__(self, *exc):
+        with self._cv:
+            self._count -= 1
+            metrics.set_gauge("rpc_inflight_requests", float(self._count))
+            self._cv.notify_all()
+        return False
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def wait_idle(self, timeout: float) -> bool:
+        end = time.monotonic() + timeout
+        with self._cv:
+            while self._count > 0 and time.monotonic() < end:
+                self._cv.wait(0.05)
+            return self._count == 0
+
+
+def _track(tracker: _InflightTracker | None):
+    return tracker if tracker is not None else contextlib.nullcontext()
+
+
+def _handler_for(node: Node, dispatcher: DeviceDispatcher | None = None,
+                 tracker: _InflightTracker | None = None):
     class Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
         def log_message(self, *args):  # quiet
             pass
 
-        def _reply(self, payload: dict, status: int = 200) -> None:
+        def _reply(self, payload: dict, status: int = 200,
+                   headers: dict | None = None) -> None:
             sp = tracing.current()  # the rpc.request span, when tracing
             if sp is not None:
                 sp.set(status=status)
@@ -67,8 +123,47 @@ def _handler_for(node: Node):
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(body)
+
+        def _deadline_s(self) -> float:
+            """Server default deadline, CAPPED by the client's
+            `X-Deadline-Ms` (a client can only tighten, never extend —
+            the server default is the overload backstop)."""
+            limit = (dispatcher.default_deadline_s if dispatcher
+                     else DeviceDispatcher.DEFAULT_DEADLINE_S)
+            raw = self.headers.get("X-Deadline-Ms")
+            if raw:
+                try:
+                    limit = min(limit, max(int(raw), 1) / 1000.0)
+                except ValueError:
+                    pass  # unparseable header: keep the server default
+            return limit
+
+        def _dispatch(self, fn, label: str):
+            """Run device-touching work on the dispatcher thread; the
+            reply itself always happens back on THIS request thread
+            (it owns the socket). Without a dispatcher (raw handler in
+            tests, embedding) the work runs inline."""
+            if dispatcher is None:
+                return fn()
+            return dispatcher.submit(fn, deadline_s=self._deadline_s(),
+                                     label=label)
+
+        def _shed_reply(self, e: Shed) -> None:
+            self._reply(
+                {"error": "overloaded", "reason": e.reason,
+                 "retry_after_s": e.retry_after_s, "status": 503},
+                503,
+                headers={"Retry-After":
+                         str(max(1, math.ceil(e.retry_after_s)))},
+            )
+
+        def _deadline_reply(self, e: DeadlineExceeded) -> None:
+            self._reply({"error": "deadline exceeded", "detail": str(e),
+                         "status": 504}, 504)
 
         def _not_found(self) -> None:
             """The one unknown-route body every miss returns (GET,
@@ -81,8 +176,9 @@ def _handler_for(node: Node):
             )
 
         def do_GET(self):
-            with tracing.span("rpc.request", method="GET",
-                              path=self.path.split("?", 1)[0]):
+            with _track(tracker), \
+                    tracing.span("rpc.request", method="GET",
+                                 path=self.path.split("?", 1)[0]):
                 self._route_get()
 
         def _route_get(self):
@@ -204,34 +300,48 @@ def _handler_for(node: Node):
                 elif len(parts) == 2 and parts[0] == "dah":
                     # the full DataAvailabilityHeader (row+column NMT
                     # roots, O(w)): hash() reproduces the header's
-                    # data_hash — the artifact BEFPs verify against
-                    dah = node.block_dah(int(parts[1]))
-                    if dah is None:
+                    # data_hash — the artifact BEFPs verify against.
+                    # Root computation may bulk-fetch a device-resident
+                    # square, so it rides the dispatcher.
+                    h = int(parts[1])
+
+                    def dah_work():
+                        dah = node.block_dah(h)
+                        return None if dah is None else dah.to_json()
+
+                    doc = self._dispatch(dah_work, "dah")
+                    if doc is None:
                         self._reply({"error": "block not found"}, 404)
                     else:
-                        self._reply(dah.to_json())
+                        self._reply(doc)
                 elif len(parts) == 2 and parts[0] == "eds":
                     # full extended square by row (share-serving for
                     # peers / fraud investigation; light clients never
                     # touch this route)
-                    eds = node.block_eds(int(parts[1]))
-                    if eds is None:
-                        self._reply({"error": "block not found"}, 404)
-                    else:
+                    h = int(parts[1])
+
+                    def eds_work():
+                        eds = node.block_eds(h)
+                        if eds is None:
+                            return None
                         # whole-square route: a device-resident handle
                         # does its one bulk fetch here (this is the one
                         # consumer that genuinely reads every byte)
                         if hasattr(eds, "original_width"):
                             eds = eds.data
-                        self._reply(
-                            {
-                                "width": int(eds.shape[0]),
-                                "rows": [
-                                    bytes(eds[i].reshape(-1)).hex()
-                                    for i in range(eds.shape[0])
-                                ],
-                            }
-                        )
+                        return {
+                            "width": int(eds.shape[0]),
+                            "rows": [
+                                bytes(eds[i].reshape(-1)).hex()
+                                for i in range(eds.shape[0])
+                            ],
+                        }
+
+                    doc = self._dispatch(eds_work, "eds")
+                    if doc is None:
+                        self._reply({"error": "block not found"}, 404)
+                    else:
+                        self._reply(doc)
                 elif len(parts) == 4 and parts[0] == "sample":
                     # /sample/<h>/<row>/<col> — ONE extended-square cell
                     # with its NMT inclusion proof against the row tree:
@@ -240,25 +350,27 @@ def _handler_for(node: Node):
                     # already authenticated). O(w) server work, O(log w)
                     # reply.
                     h, i, j = int(parts[1]), int(parts[2]), int(parts[3])
-                    w = node.block_width(h)
-                    if w is None:
-                        self._reply({"error": "block not found"}, 404)
-                        return
-                    if not (0 <= i < w and 0 <= j < w):
-                        self._reply({"error": "coordinate out of range"}, 400)
-                        return
                     from celestia_tpu.da import erasured_axis_leaves
                     from celestia_tpu.proof import nmt_prove_range
 
-                    k_orig = w // 2
-                    # block_row keeps device-resident squares SLICED:
-                    # one row (w·512 bytes) crosses the interconnect per
-                    # sample, never the full EDS (specs/transfers.md)
-                    row_cells = node.block_row(h, i)
-                    leaves = erasured_axis_leaves(row_cells, i, k_orig)
-                    proof = nmt_prove_range(leaves, j, j + 1)
-                    self._reply(
-                        {
+                    def sample_work():
+                        # width lookup touches the resident square, so
+                        # even the validation half lives on the
+                        # dispatcher; the request thread only parsed.
+                        w = node.block_width(h)
+                        if w is None:
+                            return None
+                        if not (0 <= i < w and 0 <= j < w):
+                            return "range"
+                        k_orig = w // 2
+                        # block_row keeps device-resident squares
+                        # SLICED: one row (w·512 bytes) crosses the
+                        # interconnect per sample, never the full EDS
+                        # (specs/transfers.md)
+                        row_cells = node.block_row(h, i)
+                        leaves = erasured_axis_leaves(row_cells, i, k_orig)
+                        proof = nmt_prove_range(leaves, j, j + 1)
+                        return {
                             "share": row_cells[j].hex(),
                             "proof": {
                                 "start": proof.start,
@@ -267,7 +379,14 @@ def _handler_for(node: Node):
                                 "tree_size": proof.tree_size,
                             },
                         }
-                    )
+
+                    doc = self._dispatch(sample_work, "sample")
+                    if doc is None:
+                        self._reply({"error": "block not found"}, 404)
+                    elif doc == "range":
+                        self._reply({"error": "coordinate out of range"}, 400)
+                    else:
+                        self._reply(doc)
                 elif len(parts) == 3 and parts[0] == "fraud" and parts[1] == "befp":
                     h = int(parts[2])
                     proofs = node.fraud_proofs_at(h)
@@ -382,31 +501,37 @@ def _handler_for(node: Node):
                     from celestia_tpu.proof import new_share_inclusion_proof
                     from celestia_tpu.shares.splitters import Range
 
-                    sq = square_pkg.construct(
-                        block.txs, node.app.app_version,
-                        appconsts.square_size_upper_bound(node.app.app_version),
-                    )
-                    ns_bytes = sq[int(start)].data[:29]
                     import celestia_tpu.namespace as ns_mod
 
-                    # reuse the node's EDS/DAH when they verifiably match
-                    # this block: no re-extension or root recompute, and
-                    # a device-resident handle serves the proof's rows
-                    # via SLICED reads (proof builder re-checks each row
-                    # against the DAH before proving)
-                    proof_src: dict = {}
-                    dah = node.block_dah(int(height))
-                    if dah is not None and dah.hash() == block.data_hash:
-                        proof_src["dah"] = dah
-                        eds_handle = node.block_eds(int(height))
-                        if hasattr(eds_handle, "original_width"):
-                            proof_src["eds"] = eds_handle
-                    proof = new_share_inclusion_proof(
-                        sq, ns_mod.from_bytes(ns_bytes),
-                        Range(int(start), int(end)), **proof_src
-                    )
-                    proof.validate(block.data_hash)
-                    self._reply(_share_proof_json(proof))
+                    def share_proof_work():
+                        sq = square_pkg.construct(
+                            block.txs, node.app.app_version,
+                            appconsts.square_size_upper_bound(
+                                node.app.app_version),
+                        )
+                        ns_bytes = sq[int(start)].data[:29]
+                        # reuse the node's EDS/DAH when they verifiably
+                        # match this block: no re-extension or root
+                        # recompute, and a device-resident handle serves
+                        # the proof's rows via SLICED reads (proof
+                        # builder re-checks each row against the DAH
+                        # before proving)
+                        proof_src: dict = {}
+                        dah = node.block_dah(int(height))
+                        if dah is not None and dah.hash() == block.data_hash:
+                            proof_src["dah"] = dah
+                            eds_handle = node.block_eds(int(height))
+                            if hasattr(eds_handle, "original_width"):
+                                proof_src["eds"] = eds_handle
+                        proof = new_share_inclusion_proof(
+                            sq, ns_mod.from_bytes(ns_bytes),
+                            Range(int(start), int(end)), **proof_src
+                        )
+                        proof.validate(block.data_hash)
+                        return _share_proof_json(proof)
+
+                    self._reply(self._dispatch(share_proof_work,
+                                               "proof.share"))
                 elif len(parts) == 2 and parts[0] == "params":
                     # module param queries (grpc-gateway Params analogue)
                     module = parts[1]
@@ -641,6 +766,10 @@ def _handler_for(node: Node):
                     # includes GET / (empty parts), which used to fall
                     # into the cosmos check and 500 on the index access
                     self._not_found()
+            except Shed as e:
+                self._shed_reply(e)
+            except DeadlineExceeded as e:
+                self._deadline_reply(e)
             except Exception as e:  # noqa: BLE001
                 log.error("query failed", path=self.path, error=str(e))
                 self._reply({"error": str(e)}, 500)
@@ -739,7 +868,9 @@ def _handler_for(node: Node):
                 self._not_found()
 
         def do_POST(self):
-            with tracing.span("rpc.request", method="POST", path=self.path):
+            with _track(tracker), \
+                    tracing.span("rpc.request", method="POST",
+                                 path=self.path):
                 self._route_post()
 
         def _route_post(self):
@@ -812,7 +943,11 @@ def _handler_for(node: Node):
                         }
                     })
                 elif parts == ["produce_block"]:
-                    block = node.produce_block()
+                    # extend/commit is the heaviest device pipeline the
+                    # node runs — it must not race serving reads on the
+                    # stream, so it rides the dispatcher too
+                    block = self._dispatch(node.produce_block,
+                                           "produce_block")
                     self._reply(block.to_json())
                 elif parts == ["consensus", "proposal"]:
                     validator = getattr(node, "validator", None)
@@ -852,6 +987,10 @@ def _handler_for(node: Node):
                         self._reply(validator.handle_fraud(body))
                 else:
                     self._not_found()
+            except Shed as e:
+                self._shed_reply(e)
+            except DeadlineExceeded as e:
+                self._deadline_reply(e)
             except (KeyError, TypeError, ValueError) as e:
                 # wrong-shaped but parseable bodies (missing keys, bad
                 # hex/base64) are the client's fault: consistent 400
@@ -866,17 +1005,59 @@ def _handler_for(node: Node):
 
 
 class RpcServer:
-    def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 26657):
+    """The node's HTTP front door + its device dispatcher.
+
+    The server OWNS a `DeviceDispatcher`: request threads
+    parse/validate, the dispatcher thread executes every device-
+    touching route body. It also registers the dispatcher as the
+    process-wide device executor (`transfers.register_device_executor`)
+    so node-internal sliced reads from non-RPC threads funnel through
+    the same single stream owner."""
+
+    def __init__(self, node: Node, host: str = "127.0.0.1",
+                 port: int = 26657, *,
+                 dispatcher: DeviceDispatcher | None = None,
+                 queue_capacity: int | None = None,
+                 default_deadline_s: float | None = None):
+        self.node = node
+        self.dispatcher = dispatcher or DeviceDispatcher(
+            capacity=queue_capacity, default_deadline_s=default_deadline_s
+        )
+        # readiness (slo.readiness not_overloaded) and node-internal
+        # device funneling discover the dispatcher through the node
+        node.dispatcher = self.dispatcher
+        self._tracker = _InflightTracker()
         self.server = http.server.ThreadingHTTPServer(
-            (host, port), _handler_for(node)
+            (host, port), _handler_for(node, self.dispatcher, self._tracker)
         )
         self.port = self.server.server_address[1]
         self._thread: threading.Thread | None = None
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self.dispatcher.start()
+        try:
+            from celestia_tpu.ops import transfers
+
+            transfers.register_device_executor(self.dispatcher.run_device)
+        except ImportError:
+            pass  # stripped environment: serving still works inline
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
         self._thread.start()
 
-    def stop(self) -> None:
+    def stop(self, drain_timeout: float = 5.0) -> None:
+        """Graceful drain (specs/serving.md): stop accepting new
+        connections, let in-flight requests finish, drain the
+        dispatcher (queued device work completes; stragglers past the
+        timeout shed with reason="draining"), then close the socket."""
         self.server.shutdown()
+        self.dispatcher.begin_drain()
+        self._tracker.wait_idle(drain_timeout)
+        self.dispatcher.drain(timeout=drain_timeout)
+        try:
+            from celestia_tpu.ops import transfers
+
+            transfers.unregister_device_executor(self.dispatcher.run_device)
+        except ImportError:
+            pass
         self.server.server_close()

@@ -45,6 +45,7 @@ the compute is, and move only what the consumer actually reads.
 from __future__ import annotations
 
 import functools
+import threading
 import time
 
 import numpy as np
@@ -124,6 +125,42 @@ def _bounds(n: int, chunks: int) -> list[tuple[int, int]]:
 
 
 # ------------------------------------------------------------------ #
+# the device executor (ADR-016): single-owner funneling for sliced reads
+
+# A serving node registers its DeviceDispatcher's `run_device` here so
+# sliced reads issued OUTSIDE the dispatcher thread (prober host
+# crosschecks, embedded callers, background audits) still execute on
+# the one thread that owns the device stream. The hook only engages
+# when EXACTLY ONE executor is registered: an in-process multi-node
+# topology (two RpcServers in one test process) has no single stream
+# owner, so it falls back to the pre-ADR-016 inline reads — correct,
+# just unfunneled. Bulk chunked transfers are NOT routed through the
+# hook; they belong to the block pipeline, which already serializes on
+# the node lock and runs on (or upstream of) the dispatcher.
+_device_executors: list = []
+_executor_lock = threading.Lock()
+
+
+def register_device_executor(executor) -> None:
+    with _executor_lock:
+        if executor not in _device_executors:
+            _device_executors.append(executor)
+
+
+def unregister_device_executor(executor) -> None:
+    with _executor_lock:
+        try:
+            _device_executors.remove(executor)
+        except ValueError:
+            pass
+
+
+def _device_executor():
+    with _executor_lock:
+        return _device_executors[0] if len(_device_executors) == 1 else None
+
+
+# ------------------------------------------------------------------ #
 # sliced device→host reads
 
 
@@ -153,7 +190,16 @@ def _jitted_slicers():
 
 def eds_row(dev, i: int, *, site: str = "eds.row") -> np.ndarray:
     """Fetch row i of a device-resident (w, w, B) square: (w, B) host
-    bytes, w·B over the wire instead of w²·B."""
+    bytes, w·B over the wire instead of w²·B. Funnels through the
+    registered device executor when one is active (run_device is a
+    no-op when the caller IS the dispatcher thread)."""
+    executor = _device_executor()
+    if executor is not None:
+        return executor(lambda: _eds_row_direct(dev, i, site))
+    return _eds_row_direct(dev, i, site)
+
+
+def _eds_row_direct(dev, i: int, site: str) -> np.ndarray:
     start = time.perf_counter()
     row_fn, _, _ = _jitted_slicers()
     out = np.asarray(row_fn(dev, i))
@@ -163,6 +209,13 @@ def eds_row(dev, i: int, *, site: str = "eds.row") -> np.ndarray:
 
 def eds_col(dev, j: int, *, site: str = "eds.col") -> np.ndarray:
     """Fetch column j of a device-resident (w, w, B) square: (w, B)."""
+    executor = _device_executor()
+    if executor is not None:
+        return executor(lambda: _eds_col_direct(dev, j, site))
+    return _eds_col_direct(dev, j, site)
+
+
+def _eds_col_direct(dev, j: int, site: str) -> np.ndarray:
     start = time.perf_counter()
     _, col_fn, _ = _jitted_slicers()
     out = np.asarray(col_fn(dev, j))
@@ -172,6 +225,13 @@ def eds_col(dev, j: int, *, site: str = "eds.col") -> np.ndarray:
 
 def eds_share(dev, r: int, c: int, *, site: str = "eds.share") -> np.ndarray:
     """Fetch one (B,) cell of a device-resident square."""
+    executor = _device_executor()
+    if executor is not None:
+        return executor(lambda: _eds_share_direct(dev, r, c, site))
+    return _eds_share_direct(dev, r, c, site)
+
+
+def _eds_share_direct(dev, r: int, c: int, site: str) -> np.ndarray:
     start = time.perf_counter()
     _, _, cell_fn = _jitted_slicers()
     out = np.asarray(cell_fn(dev, r, c))

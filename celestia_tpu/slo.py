@@ -105,6 +105,15 @@ def default_objectives() -> list[Objective]:
         # that produced one wrong answer is operator-attention-worthy.
         Objective(name="sdc_detected", kind="counter_max",
                   counter="sdc_detected_total", limit=0.0),
+        # admission ratio (shed-ratio ceiling, ADR-016): shedding is
+        # the CORRECT overload response, but sustained shedding of
+        # >10% of dispatch attempts means the node is underprovisioned
+        # for its traffic — burn-rate alerting on the admitted/total
+        # ratio pages before clients give up. Both counters are
+        # written only by the device dispatcher (node/dispatch.py).
+        Objective(name="rpc_admission", kind="ratio",
+                  good="rpc_dispatch_admitted_total",
+                  total="rpc_dispatch_total", target=0.9),
     ]
 
 
@@ -339,6 +348,20 @@ def readiness(node) -> tuple[bool, list[dict]]:
         exhausted = fallback > 0 and fallback > 4 * max(1, assembled)
         check("arena_not_exhausted", not exhausted,
               f"assembled={assembled} fallback={fallback}")
+
+    # overload (ADR-016): a node whose admission queue is full RIGHT
+    # NOW would shed the next request — tell the load balancer to
+    # route around it until the queue recedes. A draining dispatcher
+    # (graceful shutdown in progress) is likewise unfit by design.
+    dispatcher = getattr(node, "dispatcher", None)
+    if dispatcher is None:
+        check("not_overloaded", True, "no dispatcher attached")
+    else:
+        saturated = dispatcher.saturated()
+        draining = dispatcher.draining
+        check("not_overloaded", not (saturated or draining),
+              f"queue={dispatcher.depth}/{dispatcher.capacity}"
+              + (" draining" if draining else ""))
 
     # a DA node with no data cannot answer a single /sample — not ready
     # until the first block lands (this is the 503→200 startup flip the

@@ -164,7 +164,8 @@ class TestObjectiveDeclaration:
     def test_default_set_names(self):
         names = {o.name for o in default_objectives()}
         assert names == {"sample_availability", "extend_block_p99",
-                         "tpu_not_sticky_disabled", "sdc_detected"}
+                         "tpu_not_sticky_disabled", "sdc_detected",
+                         "rpc_admission"}
 
 
 # ---------------------------------------------------------------------- #
