@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test test-all test-slow chaos bench bench-transfers dryrun native \
-	trace-smoke bench-gate obs-smoke sdc-smoke storm-smoke
+	trace-smoke bench-gate obs-smoke sdc-smoke storm-smoke storm-bench
 
 # Fast developer loop: the default tier skips the slow multi-process
 # suites (devnet, gRPC, multihost, network, race storms). Two FRESH
@@ -96,6 +96,19 @@ sdc-smoke:
 # sample proof-verified. CPU-only, crypto-free, seconds.
 storm-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/storm_smoke.py
+
+# Continuous-batching throughput gate (specs/serving.md, ADR-017): the
+# full das-storm — 32 concurrent light clients through the real RPC
+# stack, unbatched phase then batched phase on identical config with
+# the paged device EDS cache armed under a churn-forcing budget. Every
+# accepted sample NMT-verified; fails if the batched phase is not >=2x
+# unbatched samples/sec. --ledger feeds storm_ledger.json so `make
+# bench-gate` judges the storm_ms_per_accepted_sample trajectory.
+# CPU-only, ~15 s.
+storm-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --das-storm \
+		--seconds 4 --threads 32 --k 8 --paged-budget 98304 \
+		--require-speedup 2.0 --ledger storm_ledger.json
 
 # The driver's multichip compile/execute check on a virtual CPU mesh.
 dryrun:

@@ -1436,6 +1436,310 @@ def main_das_storm_lite(seconds: float = 3.0, threads: int = 8,
         raise SystemExit("das-storm-lite failed: " + "; ".join(failures))
 
 
+def _das_storm_phase(label: str, *, seconds: float, threads: int, k: int,
+                     heights: int, queue_capacity: int, deadline_ms: int,
+                     batch_window_ms: float, max_batch: int,
+                     paged_budget: int | None, stall_ms: float):
+    """One measured storm phase behind a FRESH node + server: `threads`
+    closed-loop light clients hammer `/sample` through the real RPC
+    stack while a producer grows the chain and the synthetic prober
+    runs its cycles. Returns the phase report dict; every accepted
+    sample is NMT-verified post-hoc against the node's own DAH.
+
+    `stall_ms` emulates the fixed per-DEVICE-DISPATCH launch cost
+    (kernel launch + tunnel round-trip) that the chaosnet facade
+    doesn't pay, via the same documented delay-rule technique
+    storm-lite uses: one `delay` at `dispatch.run`, which fires once
+    per device dispatch — per job unbatched, per micro-batch batched —
+    so both phases pay the same fixed overhead per dispatch and the
+    measured win is exactly what batching amortizes."""
+    from celestia_tpu import faults
+    from celestia_tpu.node.prober import Prober
+    from celestia_tpu.node.rpc import RpcServer
+    from celestia_tpu.telemetry import metrics
+    from celestia_tpu.testutil.chaosnet import RpcChaosNode
+
+    import json as _json
+    import random as _random
+    import threading as _threading
+    import urllib.error
+    import urllib.request
+
+    node = RpcChaosNode(heights=heights, k=k, seed=7,
+                        paged_budget_bytes=paged_budget)
+    server = RpcServer(node, port=0, queue_capacity=queue_capacity,
+                       default_deadline_s=deadline_ms / 1000.0,
+                       batch_window_s=batch_window_ms / 1000.0,
+                       max_batch=max_batch)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    w = 2 * k
+
+    # metric deltas, so back-to-back phases in one process stay honest
+    batches0 = metrics.get_counter("dispatch_batch_total")
+    bjobs0 = metrics.get_counter("dispatch_batched_jobs_total")
+
+    counts = {"200": 0, "503": 0, "504": 0, "500": 0, "other": 0}
+    accepted_lat_ms: list = []
+    accepted_samples: list = []
+    lock = _threading.Lock()
+    stop = _threading.Event()
+
+    def fetch(path):
+        req = urllib.request.Request(base + path)
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, _json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, _json.loads(e.read())
+
+    def producer():
+        while not stop.wait(0.5):
+            node.grow()
+
+    def client(seed):
+        rng = _random.Random(seed)
+        while not stop.is_set():
+            # cluster on the chain head (the DAS access pattern: light
+            # clients sample the newest block) — that density is what
+            # same-height micro-batching feeds on; 10% stragglers keep
+            # the paged cache churning across heights without diluting
+            # the batch key space into singleton groups
+            h = (node.latest_height() if rng.random() < 0.9
+                 else rng.randint(1, node.latest_height()))
+            i, j = rng.randrange(w), rng.randrange(w)
+            t0 = time.perf_counter()
+            try:
+                status, body = fetch(f"/sample/{h}/{i}/{j}")
+            except Exception:  # noqa: BLE001 — socket teardown at stop
+                continue
+            lat_ms = (time.perf_counter() - t0) * 1e3
+            with lock:
+                if status == 200:
+                    counts["200"] += 1
+                    accepted_lat_ms.append(lat_ms)
+                    accepted_samples.append((h, i, j, body))
+                elif status in (503, 504):
+                    counts[str(status)] += 1
+                elif status == 500:
+                    counts["500"] += 1
+                else:
+                    counts["other"] += 1
+
+    prober = Prober(base, samples_per_cycle=4, share_proofs=False,
+                    rng=_random.Random(1), registry=metrics)
+
+    def probe_loop():
+        while not stop.wait(0.25):
+            prober.probe_cycle()
+
+    storm_threads = (
+        [_threading.Thread(target=producer, daemon=True),
+         _threading.Thread(target=probe_loop, daemon=True)]
+        + [_threading.Thread(target=client, args=(s,), daemon=True)
+           for s in range(threads)]
+    )
+    t_start = time.perf_counter()
+    with faults.inject(
+        faults.rule("dispatch.run", "delay", delay_s=stall_ms / 1000.0),
+        seed=1337,
+    ):
+        for t in storm_threads:
+            t.start()
+        time.sleep(seconds)
+        server.stop()  # graceful mid-storm drain, same as storm-lite
+        stop.set()
+        for t in storm_threads:
+            t.join(10.0)
+    elapsed = time.perf_counter() - t_start
+
+    from celestia_tpu.da import erasured_leaf_namespace
+    from celestia_tpu.proof import NmtRangeProof
+
+    verify_failures = 0
+    for h, i, j, body in accepted_samples:
+        try:
+            dah = node.dah(h)
+            share = bytes.fromhex(body["share"])
+            p = body["proof"]
+            proof = NmtRangeProof(
+                start=int(p["start"]), end=int(p["end"]),
+                nodes=[bytes.fromhex(x) for x in p["nodes"]],
+                tree_size=int(p["tree_size"]),
+            )
+            ns = erasured_leaf_namespace(i, j, share, k)
+            proof.verify_inclusion(dah.row_roots[i], [ns], [share])
+        except Exception:  # noqa: BLE001 — counted, reported, fatal
+            verify_failures += 1
+
+    batches = metrics.get_counter("dispatch_batch_total") - batches0
+    bjobs = metrics.get_counter("dispatch_batched_jobs_total") - bjobs0
+    cache = getattr(node, "_eds_cache", None)
+    cache_stats = cache.stats() if hasattr(cache, "stats") else None
+    page_rates = None
+    if cache_stats:
+        looked = cache_stats["page_hits"] + cache_stats["page_misses"]
+        page_rates = {
+            "hit_rate": (round(cache_stats["page_hits"] / looked, 3)
+                         if looked else None),
+            "hits": cache_stats["page_hits"],
+            "misses": cache_stats["page_misses"],
+            "demotes": cache_stats["page_demotes"],
+            "faultins": cache_stats["page_faultins"],
+            "corrupt": cache_stats["page_corrupt"],
+            "pages_resident": cache_stats["pages_resident"],
+            "device_bytes": cache_stats["device_bytes"],
+        }
+    accepted_lat_ms.sort()
+    total = sum(counts.values())
+    return {
+        "label": label,
+        "seconds": round(elapsed, 2),
+        "max_batch": max_batch,
+        "heights_produced": node.latest_height(),
+        "requests_total": total,
+        "counts": counts,
+        "samples_per_sec": round(counts["200"] / elapsed, 1),
+        "accepted_p50_ms": (round(_percentile(accepted_lat_ms, 0.50), 2)
+                            if accepted_lat_ms else None),
+        "accepted_p99_ms": (round(_percentile(accepted_lat_ms, 0.99), 2)
+                            if accepted_lat_ms else None),
+        "accepted_verified": len(accepted_samples) - verify_failures,
+        "verify_failures": verify_failures,
+        "batches": int(batches),
+        "batched_jobs": int(bjobs),
+        "mean_batch_occupancy": (round(bjobs / batches, 2)
+                                 if batches else None),
+        "paged_cache": page_rates,
+        "drain_clean": not server.dispatcher.alive,
+    }
+
+
+def main_das_storm(seconds: float = 4.0, threads: int = 32, k: int = 8,
+                   heights: int = 2, queue_capacity: int = 128,
+                   deadline_ms: int = 2000, batch_window_ms: float = 2.0,
+                   max_batch: int = 32, paged_budget: int | None = None,
+                   stall_ms: float = 5.0, ledger: str | None = None,
+                   require_speedup: float | None = None):
+    """`python bench.py --das-storm` / `make storm-bench`: the full-fat
+    successor to --das-storm-lite (ADR-017). Two back-to-back storm
+    phases on IDENTICAL config — continuous batching disabled
+    (max_batch=1, the pre-ADR-017 serving path) then enabled — each
+    driving `threads` concurrent light clients through the real RPC
+    stack + prober, reporting samples/sec, batch-occupancy, paged-cache
+    hit/demote rates (when --paged-budget arms the paged device cache),
+    and accepted p50/p99 vs the SLO objectives.
+
+    The fault injector arms ONE rule: a `stall_ms` delay at
+    `dispatch.run`, which fires once per DEVICE DISPATCH (per job
+    unbatched, per micro-batch batched) — emulating the fixed launch
+    overhead the crypto-free chaosnet facade doesn't pay, the cost
+    continuous batching exists to amortize. Both phases pay the same
+    per-dispatch price; the speedup is dedup + hash-once NMT proving +
+    that fixed cost spread over the group. Exit is nonzero on any
+    accepted sample that fails NMT verification, on an unclean drain,
+    or — with --require-speedup X — when batched samples/sec fails to
+    reach X times the unbatched phase.
+
+    --ledger PATH appends the batched phase to the storm ledger (JSON,
+    capped history) that `tools/perf_ledger.py` folds into `make
+    bench-gate` as the lower-is-better `storm_ms_per_accepted_sample`
+    series."""
+    from celestia_tpu.slo import SloEngine, default_objectives
+    from celestia_tpu.telemetry import metrics
+
+    import json as _json
+    import os as _os
+
+    engine = SloEngine(default_objectives(), registry=metrics)
+    engine.evaluate()  # baseline snapshot for the burn-rate windows
+
+    common = dict(seconds=seconds, threads=threads, k=k, heights=heights,
+                  queue_capacity=queue_capacity, deadline_ms=deadline_ms,
+                  batch_window_ms=batch_window_ms,
+                  paged_budget=paged_budget, stall_ms=stall_ms)
+    unbatched = _das_storm_phase("unbatched", max_batch=1, **common)
+    batched = _das_storm_phase("batched", max_batch=max_batch, **common)
+
+    slo = engine.evaluate()
+    slo_by_name = {o["name"]: o["ok"] for o in slo["objectives"]}
+    occ_hist = metrics.get_timing("dispatch_batch_occupancy")
+    speedup = (
+        round(batched["samples_per_sec"] / unbatched["samples_per_sec"], 2)
+        if unbatched["samples_per_sec"] else None
+    )
+    out = {
+        "mode": "das-storm",
+        "threads": threads,
+        "k": k,
+        "batch_window_ms": batch_window_ms,
+        "max_batch": max_batch,
+        "paged_budget": paged_budget,
+        "stall_ms": stall_ms,
+        "unbatched": unbatched,
+        "batched": batched,
+        "speedup": speedup,
+        "batch_occupancy_p50": (round(occ_hist.quantile(0.50), 1)
+                                if occ_hist else None),
+        "batch_occupancy_p90": (round(occ_hist.quantile(0.90), 1)
+                                if occ_hist else None),
+        "slo": {
+            "sample_availability_ok": slo_by_name.get(
+                "sample_availability"
+            ),
+            "rpc_admission_ok": slo_by_name.get("rpc_admission"),
+        },
+    }
+    print(_json.dumps(out))
+
+    if ledger:
+        doc = {"runs": []}
+        if _os.path.exists(ledger):
+            try:
+                with open(ledger) as f:
+                    loaded = _json.load(f)
+                if isinstance(loaded, dict) and isinstance(
+                        loaded.get("runs"), list):
+                    doc = loaded
+            except (OSError, ValueError):
+                pass  # unreadable ledger: start fresh rather than crash
+        sps = batched["samples_per_sec"]
+        doc["runs"].append({
+            "ts": time.time(),
+            "threads": threads, "k": k, "seconds": seconds,
+            "max_batch": max_batch, "paged_budget": paged_budget,
+            "stall_ms": stall_ms,
+            "samples_per_sec": sps,
+            "ms_per_accepted_sample": (round(1000.0 / sps, 4)
+                                       if sps else None),
+            "speedup_vs_unbatched": speedup,
+        })
+        doc["runs"] = doc["runs"][-40:]  # capped history
+        with open(ledger, "w") as f:
+            _json.dump(doc, f, indent=1)
+        print(f"storm ledger updated: {ledger} "
+              f"({len(doc['runs'])} runs)", file=sys.stderr)
+
+    failures = []
+    for phase in (unbatched, batched):
+        if phase["counts"]["500"]:
+            failures.append(
+                f"{phase['counts']['500']} HTTP 500s ({phase['label']})")
+        if phase["verify_failures"]:
+            failures.append(
+                f"{phase['verify_failures']} accepted samples failed "
+                f"verification ({phase['label']})")
+        if not phase["drain_clean"]:
+            failures.append(
+                f"dispatcher survived drain ({phase['label']})")
+    if require_speedup is not None and (
+            speedup is None or speedup < require_speedup):
+        failures.append(
+            f"batched speedup {speedup} < required {require_speedup}")
+    if failures:
+        raise SystemExit("das-storm failed: " + "; ".join(failures))
+
+
 def main_transfers():
     """`make bench-transfers` / `python bench.py --transfers`: the
     sliced-read and k=64 node-path configs with the fault injector ARMED
@@ -1524,7 +1828,29 @@ if __name__ == "__main__":
 
         _rec = _tracing.start_recording()
     try:
-        if "--das-storm-lite" in sys.argv:
+        if "--das-storm" in sys.argv and "--das-storm-lite" not in sys.argv:
+            _kw = {}
+            for _flag, _key, _cast in (
+                ("--seconds", "seconds", float),
+                ("--threads", "threads", int),
+                ("--k", "k", int),
+                ("--heights", "heights", int),
+                ("--queue-capacity", "queue_capacity", int),
+                ("--deadline-ms", "deadline_ms", int),
+                ("--batch-window-ms", "batch_window_ms", float),
+                ("--max-batch", "max_batch", int),
+                ("--paged-budget", "paged_budget", int),
+                ("--stall-ms", "stall_ms", float),
+                ("--ledger", "ledger", str),
+                ("--require-speedup", "require_speedup", float),
+            ):
+                if _flag in sys.argv:
+                    _i = sys.argv.index(_flag)
+                    if _i + 1 >= len(sys.argv):
+                        raise SystemExit(f"{_flag} requires a value")
+                    _kw[_key] = _cast(sys.argv[_i + 1])
+            main_das_storm(**_kw)
+        elif "--das-storm-lite" in sys.argv:
             _kw = {}
             for _flag, _key, _cast in (
                 ("--seconds", "seconds", float),

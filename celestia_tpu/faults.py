@@ -17,13 +17,19 @@ touching production code paths:
     probe.request          synthetic DAS prober fetches  (node/prober.py)
     dispatch.enqueue       device-dispatcher admission    (node/dispatch.py)
     dispatch.run           device-dispatcher job body     (node/dispatch.py)
+    dispatch.batch         one gathered micro-batch       (node/dispatch.py)
+    cache.demote           paged-cache page D2H demote    (node/eds_cache.py)
+    cache.faultin          paged-cache page H2D fault-in  (node/eds_cache.py)
 
-The dispatch pair drives overload drills deterministically: a ``delay``
+The dispatch trio drives overload drills deterministically: a ``delay``
 rule at ``dispatch.run`` stalls the single dispatcher thread, which
 backs up the bounded queue (503 queue_full sheds) and expires request
 deadlines (504s); a ``delay`` at ``dispatch.enqueue`` holds request
 threads at the admission door instead. An ``error`` at either site
-surfaces through the route's standard error path.
+surfaces through the route's standard error path; at ``dispatch.batch``
+it fails every waiter of the gathered group. The ``cache.*`` pair is
+the paged cache's SDC model: a ``bitflip`` at ``cache.faultin`` is
+caught by the page CRC before any reader sees the bytes.
 
 Fault kinds:
 

@@ -33,13 +33,27 @@ paths (blob staging at CheckTx, sliced reads from non-RPC callers via
 first — they are sub-steps of work the node already accepted, so
 shedding them would waste the admission that let their parent in.
 
+Continuous batching (ADR-017): external jobs submitted with a
+`batch_key` + `batch_exec` are micro-batched. When the loop pops a
+batchable job it gathers every queued job with the SAME key (and keeps
+gathering up to `batch_window_s` while the group is below `max_batch`),
+then executes ONE `batch_exec([payload, ...])` call for the whole group
+and completes each waiter with its own result — the Orca-style
+iteration-level scheduling the single-owner design was built for.
+Per-job admission, deadlines, and abandoned-waiter skips are unchanged:
+expired jobs are dropped from the group before execution and counted
+exactly once. Jobs without a batch key behave exactly as before.
+
 Fault sites (specs/faults.md): `dispatch.enqueue` fires in the
 submitting thread before admission (a `delay` rule holds request
 threads at the door), `dispatch.run` fires in the dispatcher thread
-before each job body (a `delay` rule stalls the single consumer, which
-is how chaos tests drive queue saturation and deadline expiry
+once per DEVICE DISPATCH — before each job body, or once for a whole
+micro-batch (a `delay` rule stalls the single consumer, which is how
+chaos tests drive queue saturation and deadline expiry
 deterministically; an `error` rule surfaces as the route's standard
-error path).
+error path), `dispatch.batch` fires once per micro-batch after
+`dispatch.run`, before `batch_exec` (an `error` rule fails every
+waiter in the group).
 
 Everything here is stdlib-only, keeping node/rpc.py importable in
 stripped environments.
@@ -79,10 +93,12 @@ class DeadlineExceeded(Exception):
 
 class _Job:
     __slots__ = ("fn", "label", "deadline", "enqueued_at", "done",
-                 "result", "error", "lock", "abandoned", "internal")
+                 "result", "error", "lock", "abandoned", "internal",
+                 "batch_key", "batch_exec", "payload")
 
     def __init__(self, fn, label: str, deadline: float | None,
-                 internal: bool = False):
+                 internal: bool = False, batch_key=None, batch_exec=None,
+                 payload=None):
         self.fn = fn
         self.label = label
         self.deadline = deadline  # absolute monotonic, None = no deadline
@@ -93,6 +109,9 @@ class _Job:
         self.lock = threading.Lock()
         self.abandoned = False  # waiter gave up; skip if not yet started
         self.internal = internal
+        self.batch_key = batch_key    # hashable group key, None = unbatched
+        self.batch_exec = batch_exec  # list[payload] -> list[result]
+        self.payload = payload
 
 
 class DeviceDispatcher:
@@ -101,14 +120,27 @@ class DeviceDispatcher:
     DEFAULT_CAPACITY = 64
     DEFAULT_DEADLINE_S = 30.0
     DEFAULT_RETRY_AFTER_S = 1.0
+    # continuous batching: how long the loop lingers for same-key
+    # companions once it holds a batchable job (latency it is willing to
+    # spend buying occupancy), and the group-size ceiling. max_batch=1
+    # disables gathering entirely.
+    DEFAULT_BATCH_WINDOW_S = 0.002
+    DEFAULT_MAX_BATCH = 32
 
     def __init__(self, capacity: int | None = None,
                  default_deadline_s: float | None = None,
-                 registry=None, name: str = "device-dispatcher"):
+                 registry=None, name: str = "device-dispatcher",
+                 batch_window_s: float | None = None,
+                 max_batch: int | None = None):
         self.capacity = int(capacity) if capacity else self.DEFAULT_CAPACITY
         self.default_deadline_s = (default_deadline_s
                                    if default_deadline_s
                                    else self.DEFAULT_DEADLINE_S)
+        self.batch_window_s = (float(batch_window_s)
+                               if batch_window_s is not None
+                               else self.DEFAULT_BATCH_WINDOW_S)
+        self.max_batch = (max(1, int(max_batch)) if max_batch is not None
+                          else self.DEFAULT_MAX_BATCH)
         self.metrics = registry if registry is not None else metrics
         self.name = name
         self._cv = threading.Condition()
@@ -195,25 +227,42 @@ class DeviceDispatcher:
 
     # -- admission ----------------------------------------------------- #
 
-    def submit(self, fn, *, deadline_s: float | None = None,
-               label: str = ""):
+    def submit(self, fn=None, *, deadline_s: float | None = None,
+               label: str = "", batch_key=None, batch_exec=None,
+               payload=None):
         """Run `fn` on the dispatcher thread and return its result.
 
         Raises `Shed` when the bounded queue refuses admission (full or
         draining), `DeadlineExceeded` when the deadline expires before
         the job completes, and re-raises whatever `fn` itself raised.
         With no dispatcher thread running (embedding, tests of the raw
-        handler) the call degrades to inline execution."""
+        handler) the call degrades to inline execution.
+
+        Batched form: pass `batch_key` (hashable group key — same key =
+        safe to coalesce), `batch_exec` (callable taking the group's
+        payload list, returning one result per payload, in order) and
+        this job's `payload` instead of `fn`. The loop coalesces
+        same-key neighbors into one `batch_exec` call; this waiter gets
+        its own result/error with identical admission semantics."""
+        if batch_key is not None:
+            if batch_exec is None:
+                raise TypeError("batch_key requires batch_exec")
+        elif fn is None:
+            raise TypeError("submit needs fn or batch_key+batch_exec")
         self.metrics.incr_counter("rpc_dispatch_total")
         faults.fire("dispatch.enqueue", label=label)
         if not self.alive:
             if self._draining:
                 self._shed("draining")
             self.metrics.incr_counter("rpc_dispatch_admitted_total")
+            if batch_key is not None:
+                return batch_exec([payload])[0]
             return fn()
         limit = deadline_s if deadline_s is not None else \
             self.default_deadline_s
-        job = _Job(fn, label, time.monotonic() + limit)
+        job = _Job(fn, label, time.monotonic() + limit,
+                   batch_key=batch_key, batch_exec=batch_exec,
+                   payload=payload)
         with self._cv:
             if self._draining or not self._running:
                 self._shed("draining")
@@ -291,18 +340,139 @@ class DeviceDispatcher:
                         and not self._queue:
                     self._cv.notify_all()
                     return
+                group = None
                 if self._internal:
                     job = self._internal.popleft()
                 else:
                     job = self._queue.popleft()
+                    if job.batch_key is not None and self.max_batch > 1:
+                        # _busy covers the gather: drain() keeps waiting
+                        # for the group even though the queue looks empty
+                        self._busy = True
+                        group = self._gather_batch_locked(job)
                     self._set_depth_gauge_locked()
                 self._busy = True
             try:
-                self._run_job(job)
+                if group is not None:
+                    self._run_batch(group)
+                else:
+                    self._run_job(job)
             finally:
                 with self._cv:
                     self._busy = False
                     self._cv.notify_all()
+
+    def _gather_batch_locked(self, first: _Job) -> list[_Job]:
+        """Collect queued same-key jobs behind `first`, lingering up to
+        `batch_window_s` while the group is under `max_batch`. Called
+        (and returns) with `_cv` held; the waits release it, so new
+        submits land during the window. Internal-lane arrivals cut the
+        window short — the priority lane must not sit behind a linger —
+        and so does drain()."""
+        group = [first]
+        self._take_mates_locked(group)
+        if self.batch_window_s > 0:
+            end = time.monotonic() + self.batch_window_s
+            while (len(group) < self.max_batch
+                   and self._running and not self._internal):
+                remaining = end - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+                self._take_mates_locked(group)
+        return group
+
+    def _take_mates_locked(self, group: list[_Job]) -> None:
+        key = group[0].batch_key
+        room = self.max_batch - len(group)
+        if room <= 0 or not self._queue:
+            return
+        keep: collections.deque[_Job] = collections.deque()
+        for job in self._queue:
+            if room > 0 and job.batch_key == key:
+                group.append(job)
+                room -= 1
+            else:
+                keep.append(job)
+        self._queue = keep
+        self._set_depth_gauge_locked()
+
+    def _run_batch(self, jobs: list[_Job]) -> None:
+        """Execute one gathered micro-batch: drop expired/abandoned
+        members (per-job, counted exactly once, same as _run_job), run
+        ONE batch_exec over the survivors' payloads, and complete each
+        waiter with its own result — or the shared error."""
+        now = time.monotonic()
+        live: list[_Job] = []
+        for job in jobs:
+            self.metrics.observe("rpc_queue_wait", now - job.enqueued_at)
+            with job.lock:
+                if job.abandoned:
+                    continue
+                if job.deadline is not None and now >= job.deadline:
+                    self.metrics.incr_counter("rpc_shed_total",
+                                              reason="deadline")
+                    job.error = DeadlineExceeded(
+                        f"deadline expired in queue ({job.label or 'job'})"
+                    )
+                    job.done.set()
+                    continue
+            live.append(job)
+        if not live:
+            return
+        lead = live[0]
+        self.metrics.incr_counter("dispatch_batch_total")
+        self.metrics.incr_counter("dispatch_batched_jobs_total",
+                                  float(len(live)))
+        self.metrics.observe("dispatch_batch_occupancy", float(len(live)))
+        with tracing.span("dispatch.batch", label=lead.label,
+                          key=str(lead.batch_key), jobs=len(live)):
+            try:
+                # dispatch.run fires once per DEVICE DISPATCH — job or
+                # micro-batch — so the documented drills (delay there
+                # stalls the single consumer; storm-lite, the deadline
+                # tests) keep working unchanged under batching.
+                # dispatch.batch is the group-specific site on top.
+                faults.fire("dispatch.run", label=lead.label)
+                faults.fire("dispatch.batch", label=lead.label,
+                            jobs=len(live))
+                results = lead.batch_exec([j.payload for j in live])
+                if results is None or len(results) != len(live):
+                    raise RuntimeError(
+                        f"batch_exec returned "
+                        f"{0 if results is None else len(results)} results "
+                        f"for {len(live)} payloads"
+                    )
+            except BaseException as e:  # noqa: BLE001 — waiters re-raise
+                self._attribute_error(e, lead.label, "dispatch.batch")
+                for job in live:
+                    job.error = e
+            else:
+                for job, result in zip(live, results):
+                    job.result = result
+        for job in live:
+            with job.lock:
+                job.done.set()
+
+    def _attribute_error(self, e: BaseException, label: str,
+                         site: str) -> None:
+        """Stamp a device-lane failure with its originating label: bump
+        `dispatch_device_error_total{label}` and suffix the message so a
+        bare `RuntimeError: boom` from a thunk says which route raised
+        it. The exception TYPE is untouched — the RPC layer's typed
+        mapping (Shed→503, DeadlineExceeded→504, ValueError→400) and
+        control-flow sheds are exempt entirely."""
+        if isinstance(e, (Shed, DeadlineExceeded)):
+            return
+        self.metrics.incr_counter("dispatch_device_error_total",
+                                  label=label or "unlabeled")
+        tag = f"[{site} label={label or 'unlabeled'}]"
+        try:
+            if e.args and isinstance(e.args[0], str) \
+                    and tag not in e.args[0]:
+                e.args = (f"{e.args[0]} {tag}",) + e.args[1:]
+        except Exception:  # noqa: BLE001 — attribution must not mask e
+            pass
 
     def _run_job(self, job: _Job) -> None:
         now = time.monotonic()
@@ -327,8 +497,14 @@ class DeviceDispatcher:
                           internal=job.internal):
             try:
                 faults.fire("dispatch.run", label=job.label)
-                job.result = job.fn()
+                if job.fn is not None:
+                    job.result = job.fn()
+                else:
+                    # batchable job running unbatched (max_batch=1):
+                    # a singleton group through the same exec callable
+                    job.result = job.batch_exec([job.payload])[0]
             except BaseException as e:  # noqa: BLE001 — waiter re-raises
+                self._attribute_error(e, job.label, "dispatch.run")
                 job.error = e
         with job.lock:
             job.done.set()

@@ -1,23 +1,35 @@
-"""Pin-guarded LRU for retained EDS handles (ADR-016 satellite).
+"""Pin-guarded EDS caches: whole-square LRU + the paged device cache.
 
-The plain OrderedDict it replaces had a race: an RPC thread could be
-mid-sliced-read on a cached device handle while a concurrent insert
-evicted that entry — with nothing tying the read to the cache's notion
-of liveness, a future cache that frees device pages on eviction
-(ROADMAP item 1's paged cache) would free them under the reader. Here
-readers BORROW entries via `pinned(height)`, and eviction skips pinned
-entries (deferring until the pin count drops to zero), so an eviction
-can never interleave with an in-flight read.
+`ResidentEdsCache` is the ADR-016 pin-guarded whole-square LRU: readers
+BORROW entries via `pinned(height)`, and eviction skips pinned entries
+(deferring until the pin count drops to zero), so an eviction can never
+interleave with an in-flight read. It remains for embedders and as the
+regression surface for the pin/eviction contract.
 
-Stdlib-only on purpose: the serving race regression tests run in
-stripped (crypto-free) environments where node/node.py itself cannot
-import.
+`PagedEdsCache` is the ADR-017 successor the node serves from: an
+extended square is stored as row-group PAGES (default 8 rows each, the
+paged-KV-cache shape from *Ragged Paged Attention*, PAPERS.md) under a
+device-byte budget. Hot pages stay device-resident; cold pages DEMOTE
+to host copies (CRC32C stamped at the device source) and FAULT back in
+on access (checksum re-verified before the upload) instead of the whole
+square being evicted. Pinning moves from per-square to per-page: a
+sliced reader pins exactly the page it reads, demotion skips pinned or
+in-transition pages, and a page's device buffer is never replaced in
+place — so eviction can never tear a page under a reader. Fault sites
+`cache.demote` / `cache.faultin` model in-flight damage on each leg
+(specs/faults.md); the stored checksum must catch it.
+
+The module stays importable stdlib-only (class definitions only —
+numpy/jax/transfers are imported lazily inside the paged methods), so
+the serving race regression tests still run in stripped (crypto-free)
+environments where node/node.py itself cannot import.
 """
 
 from __future__ import annotations
 
 import collections
 import contextlib
+import itertools
 import threading
 
 
@@ -51,6 +63,8 @@ class ResidentEdsCache:
             if value is not None:
                 self._entries.move_to_end(height)
                 self._pins[height] += 1
+        if value is not None:
+            self._publish()
         try:
             yield value
         finally:
@@ -60,12 +74,28 @@ class ResidentEdsCache:
                     if self._pins[height] <= 0:
                         del self._pins[height]
                     self._evict_locked()  # deferred eviction lands now
+                self._publish()
 
     def put(self, height: int, value) -> None:
         with self._lock:
             self._entries[height] = value
             self._entries.move_to_end(height)
             self._evict_locked()
+        self._publish()
+
+    def _publish(self) -> None:
+        """Runtime-visible occupancy/pins (same gauge names the paged
+        cache publishes — only one serving cache exists per process)."""
+        try:
+            from celestia_tpu.telemetry import metrics
+
+            with self._lock:
+                metrics.set_gauge("eds_cache_pages_resident",
+                                  float(len(self._entries)))
+                metrics.set_gauge("eds_cache_pin_count",
+                                  float(sum(self._pins.values())))
+        except Exception:  # noqa: BLE001 — telemetry must never break reads
+            pass
 
     def _evict_locked(self) -> None:
         while len(self._entries) > self.capacity:
@@ -80,6 +110,16 @@ class ResidentEdsCache:
         with self._lock:
             return self._pins[height]
 
+    def stats(self) -> dict:
+        """The `/status` "eds_cache" payload (whole-square flavor)."""
+        with self._lock:
+            return {
+                "kind": "resident",
+                "heights": len(self._entries),
+                "capacity": self.capacity,
+                "pin_count": sum(self._pins.values()),
+            }
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)
@@ -87,3 +127,542 @@ class ResidentEdsCache:
     def __contains__(self, height: int) -> bool:
         with self._lock:
             return height in self._entries
+
+
+# ---------------------------------------------------------------------- #
+# the paged device cache (ADR-017)
+
+
+class _Page:
+    """One row-group of a cached square. State transitions (fault-in,
+    demote) happen ONLY under the owning cache's condition with
+    `busy=True` fencing the off-lock transfer, so a reader either sees
+    the old complete buffer or the new complete buffer — never a tear."""
+
+    __slots__ = ("height", "index", "row_lo", "row_hi", "dev", "host",
+                 "crc", "pins", "busy", "nbytes", "last_touch")
+
+    def __init__(self, height: int, index: int, row_lo: int, row_hi: int,
+                 nbytes: int):
+        self.height = height
+        self.index = index
+        self.row_lo = row_lo
+        self.row_hi = row_hi
+        self.dev = None    # device buffer when resident
+        self.host = None   # host copy when demoted
+        self.crc = None    # CRC32C of the host copy, stamped at demote
+        self.pins = 0      # sliced readers currently on this page
+        self.busy = False  # demote/fault-in transfer in flight
+        self.nbytes = int(nbytes)
+        self.last_touch = 0
+
+
+class PagedEds:
+    """A cached square exposed page-by-page, duck-typing the
+    `ExtendedDataSquare` read surface (`original_width`/`width`/`row`/
+    `col`/`share`/`data`/`row_roots`/`col_roots`) plus the batched
+    `rows_batch` the continuous-batching sample path consumes. Every
+    access pins exactly the page(s) it reads via the owning
+    PagedEdsCache, which handles residency."""
+
+    _ROW_MEMO_CAP = 8  # same burst memo the EDS slice cache provides
+
+    def __init__(self, cache: "PagedEdsCache", height: int,
+                 pages: list[_Page], original_width: int):
+        self._cache = cache
+        self.height = height
+        self.pages = pages
+        self.original_width = original_width
+        self._row_memo: dict[int, list[bytes]] = {}
+        self._memo_lock = threading.Lock()
+        self._host_full = None  # memoized whole-square materialization
+
+    @property
+    def width(self) -> int:
+        return 2 * self.original_width
+
+    @property
+    def device_data(self):
+        """No single whole-square device buffer exists — consumers that
+        want device bytes go through the paged accessors."""
+        return None
+
+    # -- cell/axis reads ------------------------------------------------ #
+
+    def _page_for(self, i: int) -> _Page:
+        return self.pages[i // self._cache.rows_per_page]
+
+    def _memo_get(self, i: int):
+        with self._memo_lock:
+            return self._row_memo.get(i)
+
+    def _memo_put(self, i: int, cells: list[bytes]) -> None:
+        with self._memo_lock:
+            if len(self._row_memo) >= self._ROW_MEMO_CAP:
+                self._row_memo.pop(next(iter(self._row_memo)))
+            self._row_memo[i] = cells
+
+    def row(self, i: int) -> list[bytes]:
+        if not (0 <= i < self.width):
+            raise IndexError(f"row {i} out of range for width {self.width}")
+        hit = self._memo_get(i)
+        if hit is not None:
+            return hit
+        if self._host_full is not None:
+            return [self._host_full[i, j].tobytes()
+                    for j in range(self.width)]
+        from celestia_tpu.ops import transfers
+
+        page = self._page_for(i)
+        dev = self._cache._pin_resident(page)
+        try:
+            arr = transfers.eds_row(dev, i - page.row_lo)
+        finally:
+            self._cache._unpin(page)
+        cells = [arr[t].tobytes() for t in range(self.width)]
+        self._memo_put(i, cells)
+        return cells
+
+    def rows_batch(self, indices: list[int]) -> list[list[bytes]]:
+        """Fetch several rows, grouped per page into ONE vmapped sliced
+        read each (`transfers.eds_rows_batch`) — the batched half of the
+        continuous-batching sample path. Byte-identical to per-row
+        `row()` calls; returns rows in `indices` order."""
+        out: dict[int, list[bytes]] = {}
+        misses: list[int] = []
+        for i in sorted(set(indices)):
+            if not (0 <= i < self.width):
+                raise IndexError(
+                    f"row {i} out of range for width {self.width}")
+            hit = self._memo_get(i)
+            if hit is not None:
+                out[i] = hit
+            else:
+                misses.append(i)
+        if misses and self._host_full is not None:
+            for i in misses:
+                out[i] = [self._host_full[i, j].tobytes()
+                          for j in range(self.width)]
+            misses = []
+        if misses:
+            from celestia_tpu.ops import transfers
+
+            by_page: dict[int, list[int]] = {}
+            for i in misses:
+                by_page.setdefault(i // self._cache.rows_per_page,
+                                   []).append(i)
+            for page_idx, rows in by_page.items():
+                page = self.pages[page_idx]
+                dev = self._cache._pin_resident(page)
+                try:
+                    if len(rows) == 1:
+                        arrs = [transfers.eds_row(dev,
+                                                  rows[0] - page.row_lo)]
+                    else:
+                        batch = transfers.eds_rows_batch(
+                            dev, [i - page.row_lo for i in rows])
+                        arrs = [batch[t] for t in range(len(rows))]
+                finally:
+                    self._cache._unpin(page)
+                for i, arr in zip(rows, arrs):
+                    cells = [arr[t].tobytes() for t in range(self.width)]
+                    out[i] = cells
+                    self._memo_put(i, cells)
+        return [out[i] for i in indices]
+
+    def share(self, r: int, c: int) -> bytes:
+        if not (0 <= r < self.width and 0 <= c < self.width):
+            raise IndexError(f"share ({r}, {c}) out of range")
+        hit = self._memo_get(r)
+        if hit is not None:
+            return hit[c]
+        if self._host_full is not None:
+            return self._host_full[r, c].tobytes()
+        from celestia_tpu.ops import transfers
+
+        page = self._page_for(r)
+        dev = self._cache._pin_resident(page)
+        try:
+            return transfers.eds_share(dev, r - page.row_lo, c).tobytes()
+        finally:
+            self._cache._unpin(page)
+
+    def col(self, j: int) -> list[bytes]:
+        """A column crosses every page: per page, one vmapped cell batch
+        (page_rows·B bytes) — the total moved equals the whole-square
+        sliced column."""
+        if not (0 <= j < self.width):
+            raise IndexError(f"col {j} out of range for width {self.width}")
+        if self._host_full is not None:
+            return [self._host_full[i, j].tobytes()
+                    for i in range(self.width)]
+        from celestia_tpu.ops import transfers
+
+        cells: list[bytes] = []
+        for page in self.pages:
+            dev = self._cache._pin_resident(page)
+            try:
+                arr = transfers.eds_cells_batch(
+                    dev,
+                    [(lr, j) for lr in range(page.row_hi - page.row_lo)],
+                    site="eds.col",
+                )
+            finally:
+                self._cache._unpin(page)
+            cells.extend(arr[t].tobytes() for t in range(arr.shape[0]))
+        return cells
+
+    # -- whole-square consumers ----------------------------------------- #
+
+    @property
+    def data(self):
+        """Assemble the full host square once (the one consumer class
+        that genuinely reads every byte: /eds, DAH roots); memoized, so
+        later axis reads come from host like a fetched EDS."""
+        if self._host_full is None:
+            import numpy as np
+
+            parts = []
+            for page in self.pages:
+                dev = self._cache._pin_resident(page)
+                try:
+                    parts.append(np.asarray(dev))
+                finally:
+                    self._cache._unpin(page)
+            self._host_full = np.concatenate(parts, axis=0)
+        return self._host_full
+
+    def _materialized(self):
+        from celestia_tpu import da
+
+        return da.ExtendedDataSquare(self.data, self.original_width)
+
+    def row_roots(self) -> list[bytes]:
+        return self._materialized().row_roots()
+
+    def col_roots(self) -> list[bytes]:
+        return self._materialized().col_roots()
+
+    def flattened_shares(self) -> list[bytes]:
+        return self._materialized().flattened_shares()
+
+
+class PagedEdsCache:
+    """Paged device cache for retained extended squares (ADR-017).
+
+    Entries map height → PagedEds (device squares, paged) or an opaque
+    value (host squares/arrays — stored whole, no paging). Heights are
+    LRU-bounded by `max_heights` with the same pin-guarded borrow
+    contract as ResidentEdsCache; device residency is PAGE-granular
+    under `device_byte_budget`: when the budget is exceeded, the
+    globally coldest unpinned page demotes to a host copy, and demoted
+    pages fault back in on access. The budget is soft by one in-flight
+    page: fault-ins upload before demoting, and a page whose readers
+    pin it is never demoted, so a burst that pins everything overshoots
+    instead of deadlocking."""
+
+    DEFAULT_ROWS_PER_PAGE = 8
+    DEFAULT_DEVICE_BYTE_BUDGET = 128 << 20
+    DEFAULT_MAX_HEIGHTS = 4
+
+    def __init__(self, rows_per_page: int | None = None,
+                 device_byte_budget: int | None = None,
+                 max_heights: int | None = None):
+        self.rows_per_page = int(rows_per_page or
+                                 self.DEFAULT_ROWS_PER_PAGE)
+        self.device_byte_budget = int(
+            device_byte_budget if device_byte_budget is not None
+            else self.DEFAULT_DEVICE_BYTE_BUDGET)
+        self.max_heights = int(max_heights or self.DEFAULT_MAX_HEIGHTS)
+        self._entries: collections.OrderedDict[int, object] = \
+            collections.OrderedDict()
+        self._height_pins: collections.Counter[int] = collections.Counter()
+        self._pages: list[_Page] = []  # every tracked page, all heights
+        self._cond = threading.Condition()
+        self._tick = itertools.count(1)
+        self.stats_counters = collections.Counter()  # hits/misses/...
+
+    # -- the ResidentEdsCache-compatible height surface ----------------- #
+
+    def get(self, height: int):
+        with self._cond:
+            value = self._entries.get(height)
+            if value is not None:
+                self._entries.move_to_end(height)
+            return value
+
+    @contextlib.contextmanager
+    def pinned(self, height: int):
+        """Borrow the entry for `height` (or None on a miss): while the
+        context is open the HEIGHT cannot be evicted (page residency may
+        still shuffle underneath — that is the point — but per-page pins
+        keep every in-flight read safe)."""
+        with self._cond:
+            value = self._entries.get(height)
+            if value is not None:
+                self._entries.move_to_end(height)
+                self._height_pins[height] += 1
+        try:
+            yield value
+        finally:
+            if value is not None:
+                with self._cond:
+                    self._height_pins[height] -= 1
+                    if self._height_pins[height] <= 0:
+                        del self._height_pins[height]
+                    self._evict_heights_locked()
+
+    def put(self, height: int, value) -> None:
+        """Insert a retained square. Device-resident
+        `ExtendedDataSquare` handles are split into row-group pages
+        (their device buffer is NOT kept whole — the pages are the
+        resident form); anything else is stored opaque."""
+        paged = self._page_value(height, value)
+        with self._cond:
+            old = self._entries.get(height)
+            if old is not None:
+                self._drop_pages_locked(height)
+            self._entries[height] = paged
+            self._entries.move_to_end(height)
+            if isinstance(paged, PagedEds):
+                self._pages.extend(paged.pages)
+            self._evict_heights_locked()
+            self._publish_locked()
+        self._demote_to_budget()
+
+    def _page_value(self, height: int, value):
+        dev = getattr(value, "device_data", None)
+        if dev is None:
+            return value
+        import numpy as np
+
+        width = int(dev.shape[0])
+        cell_nbytes = int(np.prod(dev.shape[1:])) * \
+            np.dtype(dev.dtype).itemsize
+        rpp = self.rows_per_page
+        pages: list[_Page] = []
+        for index, lo in enumerate(range(0, width, rpp)):
+            hi = min(lo + rpp, width)
+            page = _Page(height, index, lo, hi, (hi - lo) * cell_nbytes)
+            # the slice is a fresh device buffer; once the caller drops
+            # the whole-square handle, only the pages stay resident
+            page.dev = dev[lo:hi]
+            page.last_touch = next(self._tick)
+            pages.append(page)
+        return PagedEds(self, height, pages,
+                        getattr(value, "original_width", width // 2))
+
+    def _drop_pages_locked(self, height: int) -> None:
+        self._pages = [p for p in self._pages if p.height != height]
+
+    def _evict_heights_locked(self) -> None:
+        while len(self._entries) > self.max_heights:
+            victim = next(
+                (h for h in self._entries if self._height_pins[h] == 0),
+                None,
+            )
+            if victim is None:
+                return  # everything borrowed: defer until a pin drops
+            del self._entries[victim]
+            self._drop_pages_locked(victim)
+        self._publish_locked()
+
+    def invalidate(self, height: int) -> None:
+        """Drop a height outright (a reader detected page corruption —
+        the cache is a cache; the node reconstructs)."""
+        with self._cond:
+            if height in self._entries:
+                del self._entries[height]
+                self._drop_pages_locked(height)
+                self._publish_locked()
+
+    def pin_count(self, height: int) -> int:
+        with self._cond:
+            pages = sum(p.pins for p in self._pages if p.height == height)
+            return self._height_pins[height] + pages
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._entries)
+
+    def __contains__(self, height: int) -> bool:
+        with self._cond:
+            return height in self._entries
+
+    # -- page residency ------------------------------------------------- #
+
+    def _pin_resident(self, page: _Page):
+        """Pin `page` and return its device buffer, faulting the page in
+        from its host copy first when demoted. The returned buffer is
+        immutable and the pin blocks demotion, so the caller may slice
+        it off-lock until `_unpin`."""
+        with self._cond:
+            while page.busy:
+                self._cond.wait()
+            page.last_touch = next(self._tick)
+            if page.dev is not None:
+                page.pins += 1
+                self.stats_counters["page_hits"] += 1
+                self._count("eds_cache_page_hits_total")
+                return page.dev
+            # demoted: this reader performs the fault-in; `busy` makes
+            # every other reader of the page wait for it
+            page.busy = True
+            self.stats_counters["page_misses"] += 1
+            self._count("eds_cache_page_miss_total")
+        try:
+            dev = self._fault_in(page)
+        except BaseException:
+            with self._cond:
+                page.busy = False
+                self._cond.notify_all()
+            raise
+        with self._cond:
+            page.dev = dev
+            page.busy = False
+            page.pins += 1
+            page.last_touch = next(self._tick)
+            self.stats_counters["page_faultins"] += 1
+            self._count("eds_cache_page_faultin_total")
+            self._publish_locked()
+            self._cond.notify_all()
+        self._demote_to_budget()
+        return dev
+
+    def _unpin(self, page: _Page) -> None:
+        with self._cond:
+            page.pins -= 1
+            self._publish_locked()
+            self._cond.notify_all()
+        self._demote_to_budget()
+
+    def _fault_in(self, page: _Page):
+        """host→device upload of a demoted page, integrity-checked: the
+        host copy must still match the CRC32C stamped at demote time
+        (bit rot or an armed `cache.faultin` bitflip both surface as
+        IntegrityError, counted + recorded as an SDC event)."""
+        from celestia_tpu import faults, integrity
+        from celestia_tpu.ops import transfers
+
+        host = page.host
+        flip = faults.fire("cache.faultin", height=page.height,
+                           page=page.index)
+        if flip is not None:
+            host = flip(host)
+        if integrity.crc32c(host) != page.crc:
+            integrity.record_sdc("cache.faultin")
+            self.stats_counters["page_corrupt"] += 1
+            self._count("eds_cache_page_corrupt_total")
+            err = integrity.IntegrityError(
+                f"page checksum mismatch on fault-in "
+                f"(height={page.height} page={page.index})"
+            )
+            err.site = "cache.faultin"
+            raise err
+        dev = transfers.device_put_chunked(host, site="cache.faultin")
+        # block until the upload lands so `busy` fences the whole
+        # transition (a lazy buffer could still be materializing when a
+        # reader slices it — correctness holds either way, but the
+        # budget accounting should see real bytes)
+        dev.block_until_ready()
+        return dev
+
+    def _demote_to_budget(self) -> None:
+        """Demote globally-coldest unpinned pages until device bytes fit
+        the budget. Each demotion D2H-fetches OUTSIDE the lock with
+        `busy` fencing the page, stamps the host copy's CRC32C at the
+        device source, then atomically swaps dev→host — a reader mid-
+        slice holds a pin, so its buffer is never the victim."""
+        while True:
+            with self._cond:
+                if self._device_bytes_locked() <= self.device_byte_budget:
+                    return
+                victim = None
+                for p in self._pages:
+                    if p.dev is None or p.pins > 0 or p.busy:
+                        continue
+                    if victim is None or p.last_touch < victim.last_touch:
+                        victim = p
+                if victim is None:
+                    return  # everything pinned/busy: soft overshoot
+                victim.busy = True
+                dev = victim.dev
+            try:
+                host, crc = self._demote(victim, dev)
+            except BaseException:
+                with self._cond:
+                    victim.busy = False
+                    self._cond.notify_all()
+                raise
+            with self._cond:
+                victim.host = host
+                victim.crc = crc
+                victim.dev = None
+                victim.busy = False
+                self.stats_counters["page_demotes"] += 1
+                self._count("eds_cache_page_demote_total")
+                self._publish_locked()
+                self._cond.notify_all()
+
+    def _demote(self, page: _Page, dev):
+        from celestia_tpu import faults, integrity
+        from celestia_tpu.ops import transfers
+
+        host = transfers.device_get_chunked(dev, site="cache.demote")
+        # checksum the PRISTINE device source — the fault site models
+        # damage on the way down, which the fault-in check must catch
+        crc = integrity.crc32c(host)
+        flip = faults.fire("cache.demote", height=page.height,
+                           page=page.index)
+        if flip is not None:
+            host = flip(host)
+        return host, crc
+
+    # -- accounting / observability ------------------------------------- #
+
+    def _device_bytes_locked(self) -> int:
+        return sum(p.nbytes for p in self._pages if p.dev is not None)
+
+    def _count(self, name: str) -> None:
+        try:
+            from celestia_tpu.telemetry import metrics
+
+            metrics.incr_counter(name)
+        except Exception:  # noqa: BLE001 — metrics never break the cache
+            pass
+
+    def _publish_locked(self) -> None:
+        try:
+            from celestia_tpu.telemetry import metrics
+
+            resident = sum(1 for p in self._pages if p.dev is not None)
+            pins = sum(p.pins for p in self._pages) + \
+                sum(self._height_pins.values())
+            metrics.set_gauge("eds_cache_pages_resident", float(resident))
+            metrics.set_gauge("eds_cache_pin_count", float(pins))
+            metrics.set_gauge("eds_cache_device_bytes",
+                              float(self._device_bytes_locked()))
+        except Exception:  # noqa: BLE001
+            pass
+
+    def stats(self) -> dict:
+        """The /status surface: residency, budget, and flow counters."""
+        with self._cond:
+            resident = sum(1 for p in self._pages if p.dev is not None)
+            return {
+                "kind": "paged",
+                "heights": len(self._entries),
+                "pages": len(self._pages),
+                "pages_resident": resident,
+                "pages_demoted": len(self._pages) - resident,
+                "device_bytes": self._device_bytes_locked(),
+                "device_byte_budget": self.device_byte_budget,
+                "rows_per_page": self.rows_per_page,
+                "pin_count": sum(p.pins for p in self._pages) +
+                sum(self._height_pins.values()),
+                "page_hits": self.stats_counters["page_hits"],
+                "page_misses": self.stats_counters["page_misses"],
+                "page_demotes": self.stats_counters["page_demotes"],
+                "page_faultins": self.stats_counters["page_faultins"],
+                "page_corrupt": self.stats_counters["page_corrupt"],
+            }

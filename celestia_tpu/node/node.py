@@ -15,7 +15,7 @@ from celestia_tpu import tracing
 from celestia_tpu.app import App
 from celestia_tpu.app.app import ProposalBlockData, TxResult
 from celestia_tpu.log import logger
-from celestia_tpu.node.eds_cache import ResidentEdsCache
+from celestia_tpu.node.eds_cache import PagedEdsCache
 
 log = logger("node")
 
@@ -204,11 +204,13 @@ class Node:
         self.fraudulent_data_hashes: set[bytes] = set()
         # reconstruction memo for the share-serving routes: committed
         # blocks are immutable, so /dah answers come from a tiny
-        # per-height cache and /eds from a 2-deep pin-guarded LRU (a
-        # full EDS is ~32 MB at k=128 — memoizing every height would
-        # eat the heap; pinning keeps eviction out of in-flight reads)
+        # per-height cache and /eds from the PAGED device cache
+        # (ADR-017): retained squares are split into row-group pages
+        # under a device-byte budget — hot pages stay resident, cold
+        # pages demote to checksummed host copies and fault back in on
+        # access, and per-page pins keep eviction out of in-flight reads
         self._dah_cache: dict[int, object] = {}
-        self._eds_cache = ResidentEdsCache(capacity=2)
+        self._eds_cache = PagedEdsCache()
         self.home = pathlib.Path(home) if home else None
         if self.home:
             (self.home / "blocks").mkdir(parents=True, exist_ok=True)
@@ -566,6 +568,62 @@ class Node:
             if hasattr(eds, "original_width"):
                 return eds.share(r, c)
             return bytes(eds[r, c])
+
+    def sample_batch(self, height: int, coords) -> list:
+        """Answer a micro-batch of DAS samples against ONE height — the
+        `batch_exec` target of the continuous-batching dispatcher lane
+        (ADR-017). Distinct rows are fetched as one vmapped sliced read
+        (`rows_batch`) and each row's NMT leaf layer is hashed once
+        (proof.NmtRowProver), so b samples over r distinct rows cost
+        O(r·w) hashes instead of O(b·w); every returned document is
+        byte-identical to the unbatched `/sample` route (pinned in
+        tests). Returns one entry per coordinate, aligned: a response
+        doc, the "range" sentinel, or None when the block is unknown.
+
+        A paged-cache page whose fault-in checksum fails (IntegrityError)
+        heals once: the height is invalidated — the cache is a cache —
+        and the batch re-answers from reconstruction."""
+        from celestia_tpu import integrity
+
+        try:
+            return self._sample_batch(height, coords)
+        except integrity.IntegrityError:
+            if not hasattr(self._eds_cache, "invalidate"):
+                raise
+            log.info("eds page corrupt; invalidating height",
+                     height=height)
+            self._eds_cache.invalidate(height)
+            return self._sample_batch(height, coords)
+
+    def _sample_batch(self, height: int, coords) -> list:
+        from celestia_tpu.proof import das_sample_docs
+
+        coords = [(int(i), int(j)) for i, j in coords]
+        with self._borrow_eds(height) as eds:
+            if eds is None:
+                return [None] * len(coords)
+            if hasattr(eds, "original_width"):
+                w = eds.width
+            else:
+                w = int(eds.shape[0])
+            out: list = ["range"] * len(coords)
+            valid = [t for t, (i, j) in enumerate(coords)
+                     if 0 <= i < w and 0 <= j < w]
+            if not valid:
+                return out
+            rows_needed = sorted({coords[t][0] for t in valid})
+            if hasattr(eds, "rows_batch"):
+                rows = dict(zip(rows_needed, eds.rows_batch(rows_needed)))
+            elif hasattr(eds, "original_width"):
+                rows = {i: eds.row(i) for i in rows_needed}
+            else:
+                rows = {i: [bytes(eds[i, c]) for c in range(w)]
+                        for i in rows_needed}
+            docs = das_sample_docs(rows, [coords[t] for t in valid],
+                                   w // 2)
+        for t, doc in zip(valid, docs):
+            out[t] = doc
+        return out
 
     def block_dah(self, height: int):
         """The DataAvailabilityHeader a block's data_hash commits to —

@@ -106,6 +106,31 @@ def _track(tracker: _InflightTracker | None):
     return tracker if tracker is not None else contextlib.nullcontext()
 
 
+def _legacy_sample_work(node, h: int, i: int, j: int):
+    """The pre-batching /sample body, kept for duck-typed nodes without
+    `sample_batch`. Same document bytes as the batched path."""
+    from celestia_tpu.da import erasured_axis_leaves
+    from celestia_tpu.proof import nmt_prove_range
+
+    w = node.block_width(h)
+    if w is None:
+        return None
+    if not (0 <= i < w and 0 <= j < w):
+        return "range"
+    row_cells = node.block_row(h, i)
+    leaves = erasured_axis_leaves(row_cells, i, w // 2)
+    proof = nmt_prove_range(leaves, j, j + 1)
+    return {
+        "share": row_cells[j].hex(),
+        "proof": {
+            "start": proof.start,
+            "end": proof.end,
+            "nodes": [n.hex() for n in proof.nodes],
+            "tree_size": proof.tree_size,
+        },
+    }
+
+
 def _handler_for(node: Node, dispatcher: DeviceDispatcher | None = None,
                  tracker: _InflightTracker | None = None):
     class Handler(http.server.BaseHTTPRequestHandler):
@@ -151,6 +176,30 @@ def _handler_for(node: Node, dispatcher: DeviceDispatcher | None = None,
                 return fn()
             return dispatcher.submit(fn, deadline_s=self._deadline_s(),
                                      label=label)
+
+        def _dispatch_sample(self, h: int, i: int, j: int):
+            """The /sample body, continuous-batched (ADR-017): the
+            request submits its coordinate with a per-height batch key,
+            and the dispatcher coalesces concurrent same-height samples
+            into ONE `node.sample_batch` call — one vmapped row read +
+            one hash pass per distinct row instead of per request. Each
+            waiter still carries its own deadline and gets its own
+            document, byte-identical to the unbatched path. Nodes
+            without `sample_batch` (duck-typed embedders) keep the
+            legacy one-shot route body."""
+            sample_batch = getattr(node, "sample_batch", None)
+            if sample_batch is None:
+                return self._dispatch(
+                    lambda: _legacy_sample_work(node, h, i, j), "sample")
+            if dispatcher is None:
+                return sample_batch(h, [(i, j)])[0]
+            return dispatcher.submit(
+                deadline_s=self._deadline_s(),
+                label="sample",
+                batch_key=("sample", h),
+                batch_exec=lambda payloads: sample_batch(h, payloads),
+                payload=(i, j),
+            )
 
         def _shed_reply(self, e: Shed) -> None:
             self._reply(
@@ -205,8 +254,15 @@ def _handler_for(node: Node, dispatcher: DeviceDispatcher | None = None,
                         }
                     )
                 elif parts == ["status"]:
+                    eds_cache = getattr(node, "_eds_cache", None)
                     self._reply(
                         {
+                            # paged EDS cache residency/flow (ADR-017):
+                            # mirrors the eds_cache_* gauges/counters
+                            "eds_cache": (
+                                eds_cache.stats()
+                                if hasattr(eds_cache, "stats") else None
+                            ),
                             "chain_id": node.app.chain_id,
                             "height": node.latest_height(),
                             "app_version": node.app.app_version,
@@ -350,37 +406,7 @@ def _handler_for(node: Node, dispatcher: DeviceDispatcher | None = None,
                     # already authenticated). O(w) server work, O(log w)
                     # reply.
                     h, i, j = int(parts[1]), int(parts[2]), int(parts[3])
-                    from celestia_tpu.da import erasured_axis_leaves
-                    from celestia_tpu.proof import nmt_prove_range
-
-                    def sample_work():
-                        # width lookup touches the resident square, so
-                        # even the validation half lives on the
-                        # dispatcher; the request thread only parsed.
-                        w = node.block_width(h)
-                        if w is None:
-                            return None
-                        if not (0 <= i < w and 0 <= j < w):
-                            return "range"
-                        k_orig = w // 2
-                        # block_row keeps device-resident squares
-                        # SLICED: one row (w·512 bytes) crosses the
-                        # interconnect per sample, never the full EDS
-                        # (specs/transfers.md)
-                        row_cells = node.block_row(h, i)
-                        leaves = erasured_axis_leaves(row_cells, i, k_orig)
-                        proof = nmt_prove_range(leaves, j, j + 1)
-                        return {
-                            "share": row_cells[j].hex(),
-                            "proof": {
-                                "start": proof.start,
-                                "end": proof.end,
-                                "nodes": [n.hex() for n in proof.nodes],
-                                "tree_size": proof.tree_size,
-                            },
-                        }
-
-                    doc = self._dispatch(sample_work, "sample")
+                    doc = self._dispatch_sample(h, i, j)
                     if doc is None:
                         self._reply({"error": "block not found"}, 404)
                     elif doc == "range":
@@ -1018,16 +1044,29 @@ class RpcServer:
                  port: int = 26657, *,
                  dispatcher: DeviceDispatcher | None = None,
                  queue_capacity: int | None = None,
-                 default_deadline_s: float | None = None):
+                 default_deadline_s: float | None = None,
+                 batch_window_s: float | None = None,
+                 max_batch: int | None = None):
         self.node = node
         self.dispatcher = dispatcher or DeviceDispatcher(
-            capacity=queue_capacity, default_deadline_s=default_deadline_s
+            capacity=queue_capacity, default_deadline_s=default_deadline_s,
+            batch_window_s=batch_window_s, max_batch=max_batch,
         )
         # readiness (slo.readiness not_overloaded) and node-internal
         # device funneling discover the dispatcher through the node
         node.dispatcher = self.dispatcher
         self._tracker = _InflightTracker()
-        self.server = http.server.ThreadingHTTPServer(
+
+        class _Server(http.server.ThreadingHTTPServer):
+            # Admission control is the dispatcher's bounded queue
+            # (ADR-016) — the kernel listen backlog must not be an
+            # accidental second limiter. socketserver's default of 5
+            # overflows under a storm of no-keep-alive light clients
+            # and surfaces as ~1 s SYN-retransmit latency tails that
+            # have nothing to do with serving capacity.
+            request_queue_size = 128
+
+        self.server = _Server(
             (host, port), _handler_for(node, self.dispatcher, self._tracker)
         )
         self.port = self.server.server_address[1]

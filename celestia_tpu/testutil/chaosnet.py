@@ -121,9 +121,32 @@ class RpcChaosNode(ChaosNode):
     tests and `make obs-smoke` boot in crypto-free environments."""
 
     def __init__(self, heights: int = 2, k: int = 2, seed: int = 7,
-                 chain_id: str = "chaos-net"):
+                 chain_id: str = "chaos-net",
+                 paged_budget_bytes: int | None = None,
+                 rows_per_page: int = 8):
+        # paged mode first: grow() in super().__init__ feeds the cache
+        self._eds_cache = None
+        if paged_budget_bytes is not None:
+            try:
+                import jax  # noqa: F401 — paged mode needs a device
+
+                from celestia_tpu.node.eds_cache import PagedEdsCache
+
+                self._eds_cache = PagedEdsCache(
+                    rows_per_page=rows_per_page,
+                    device_byte_budget=paged_budget_bytes,
+                    max_heights=1 << 30,  # heights bound by the harness
+                )
+            except ImportError:
+                pass  # stripped environment: host squares, no paging
         super().__init__(heights=heights, k=k, seed=seed,
                          chain_id=chain_id)
+        if self._eds_cache is not None:
+            import jax
+
+            for h, (eds, _dah) in self.blocks.items():
+                self._eds_cache.put(h, da.ExtendedDataSquare.from_device(
+                    jax.device_put(eds.data), eds.original_width))
         self.k = k
         self.seed = seed
         self.app = _StubApp(chain_id)
@@ -134,28 +157,74 @@ class RpcChaosNode(ChaosNode):
 
     def grow(self) -> int:
         """Append the next height (the produce_block analogue): what
-        flips /readyz's has_blocks check across 'startup'."""
+        flips /readyz's has_blocks check across 'startup'. In paged mode
+        the square is device-put and inserted into the PagedEdsCache, so
+        serving reads exercise real page residency/demote/fault-in."""
         h = self.latest_height() + 1
         eds = da.extend_shares(chain_shares(self.k, h, self.seed))
         self.blocks[h] = (eds, da.new_data_availability_header(eds))
+        if getattr(self, "_eds_cache", None) is not None:
+            import jax
+
+            dev_eds = da.ExtendedDataSquare.from_device(
+                jax.device_put(eds.data), eds.original_width
+            )
+            self._eds_cache.put(h, dev_eds)
         return h
 
     # -- the Node query surface node/rpc.py's served routes touch ------ #
+
+    def _eds_for(self, height: int):
+        """The serving read source: the paged-cache entry when paged
+        mode is on (falling back to the host square on a miss), else
+        the host ExtendedDataSquare."""
+        if self._eds_cache is not None:
+            paged = self._eds_cache.get(height)
+            if paged is not None:
+                return paged
+        entry = self.blocks.get(height)
+        return entry[0] if entry else None
 
     def block_dah(self, height: int):
         return self.dah(height)
 
     def block_eds(self, height: int):
-        entry = self.blocks.get(height)
-        return entry[0] if entry else None
+        return self._eds_for(height)
 
     def block_width(self, height: int) -> int | None:
-        entry = self.blocks.get(height)
-        return entry[0].width if entry else None
+        eds = self._eds_for(height)
+        return eds.width if eds is not None else None
 
     def block_row(self, height: int, i: int):
-        entry = self.blocks.get(height)
-        return entry[0].row(i) if entry else None
+        eds = self._eds_for(height)
+        return eds.row(i) if eds is not None else None
+
+    def sample_batch(self, height: int, coords) -> list:
+        """The continuous-batching sample body (mirrors
+        Node.sample_batch: one row fetch + one leaf-hash pass per
+        distinct row, documents byte-identical to the unbatched
+        route)."""
+        from celestia_tpu.proof import das_sample_docs
+
+        coords = [(int(i), int(j)) for i, j in coords]
+        eds = self._eds_for(height)
+        if eds is None:
+            return [None] * len(coords)
+        w = eds.width
+        out: list = ["range"] * len(coords)
+        valid = [t for t, (i, j) in enumerate(coords)
+                 if 0 <= i < w and 0 <= j < w]
+        if not valid:
+            return out
+        rows_needed = sorted({coords[t][0] for t in valid})
+        # rows go through self.block_row, NOT the eds directly: chaos
+        # subclasses override block_row to serve tampered rows, and the
+        # batched path must lie exactly like the unbatched one did
+        rows = {i: self.block_row(height, i) for i in rows_needed}
+        docs = das_sample_docs(rows, [coords[t] for t in valid], w // 2)
+        for t, doc in zip(valid, docs):
+            out[t] = doc
+        return out
 
     def get_block(self, height: int):
         return None  # no block bodies: body routes answer 404

@@ -59,6 +59,10 @@ TRACKED: dict[str, list[tuple[str | None, str]]] = {
                                        "tpu_wall_with_transfers_ms")],
     "node_path_k128_eds_fetch_ms": [("8_node_path_k128",
                                      "tpu_wall_with_eds_fetch_ms")],
+    # serving: per-accepted-sample wall of the batched das-storm phase
+    # (`make storm-bench`). Not extracted from BENCH rounds — the
+    # loader folds it in from storm_ledger.json, hence no paths here.
+    "storm_ms_per_accepted_sample": [],
 }
 
 DEFAULT_THRESHOLD = 1.5  # newest/baseline ratio that counts as regression
@@ -186,6 +190,23 @@ def load_ledger(root: str) -> dict[str, list[tuple[str, float]]]:
                 v = _extract(metric, parsed)
                 if v is not None:
                     ledger[metric].append(("bench_cache.json", v))
+    # storm ledger (`bench.py --das-storm --ledger`): its own capped
+    # run history, already oldest→newest — each run is one point of the
+    # storm_ms_per_accepted_sample series
+    storm_path = os.path.join(root, "storm_ledger.json")
+    if os.path.exists(storm_path):
+        try:
+            with open(storm_path) as f:
+                storm = json.load(f)
+        except (OSError, ValueError):
+            storm = None
+        if isinstance(storm, dict):
+            for idx, run in enumerate(storm.get("runs") or []):
+                v = (run.get("ms_per_accepted_sample")
+                     if isinstance(run, dict) else None)
+                if isinstance(v, (int, float)):
+                    ledger["storm_ms_per_accepted_sample"].append(
+                        (f"storm_ledger.json#{idx}", float(v)))
     return ledger
 
 
