@@ -4,7 +4,9 @@
 PY ?= python
 
 .PHONY: test test-all test-slow chaos bench bench-transfers dryrun native \
-	trace-smoke bench-gate obs-smoke sdc-smoke storm-smoke storm-bench
+	trace-smoke bench-gate obs-smoke sdc-smoke storm-smoke storm-bench \
+	scenario-smoke scenario-pfb-storm scenario-rolling-outage \
+	scenario-sdc-under-storm scenario-rejoin-under-load scenarios
 
 # Fast developer loop: the default tier skips the slow multi-process
 # suites (devnet, gRPC, multihost, network, race storms). Two FRESH
@@ -109,6 +111,42 @@ storm-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py --das-storm \
 		--seconds 4 --threads 32 --k 8 --paged-budget 98304 \
 		--require-speedup 2.0 --ledger storm_ledger.json
+
+# Scenario-engine smoke gate (specs/scenarios.md, ADR-018): run the
+# condensed `smoke` scenario twice on one seed, pin an identical fault
+# timeline across runs, the two required SLO breaches (the drill's
+# flip and strike MUST surface on the board), all invariant probes,
+# the report schema, and the ledger fold. CPU-only, crypto-free,
+# well under 120 s.
+scenario-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/scenario_smoke.py
+
+# The shipped production-emulation suites (specs/scenarios.md): each
+# runs a declarative load+fault timeline through the real RPC stack
+# and is judged by the node's own SLO engine plus teardown invariant
+# probes — non-zero exit when the breaching-objective set departs the
+# scenario's contract or any invariant fails. --ledger feeds
+# scenario_ledger.json so `make bench-gate` judges the
+# scenario_slo_pass trajectory. CPU-only, crypto-free.
+scenario-pfb-storm:
+	JAX_PLATFORMS=cpu $(PY) -m celestia_tpu.scenarios pfb-storm \
+		--ledger scenario_ledger.json
+
+scenario-rolling-outage:
+	JAX_PLATFORMS=cpu $(PY) -m celestia_tpu.scenarios rolling-outage \
+		--ledger scenario_ledger.json
+
+scenario-sdc-under-storm:
+	JAX_PLATFORMS=cpu $(PY) -m celestia_tpu.scenarios sdc-under-storm \
+		--ledger scenario_ledger.json
+
+scenario-rejoin-under-load:
+	JAX_PLATFORMS=cpu $(PY) -m celestia_tpu.scenarios rejoin-under-load \
+		--ledger scenario_ledger.json
+
+# All four suites back to back.
+scenarios: scenario-pfb-storm scenario-rolling-outage \
+	scenario-sdc-under-storm scenario-rejoin-under-load
 
 # The driver's multichip compile/execute check on a virtual CPU mesh.
 dryrun:

@@ -101,7 +101,18 @@ class FaultRule:
     the site's context values (e.g. a port number, to fault only one of
     several servers). ``after`` skips the first N matching hits;
     ``times`` stops firing after N strikes; ``probability`` gates each
-    strike on a draw from the injector's seeded rng."""
+    strike on a draw from the injector's seeded rng.
+
+    ``phase`` scopes the rule to the injector's current phase label
+    (glob-matched, set via ``FaultInjector.set_phase``): outside the
+    phase the rule is fully dormant — it neither fires nor counts hits
+    toward ``after``/``times``, so a campaign can arm a site for phase
+    2 only and the rule re-arms untouched if the phase label returns.
+    ``window`` bounds the rule to ``(start_s, end_s)`` relative to the
+    injector's arm time (time-windowed arming for wall-clock drills);
+    outside the window it is dormant the same way. Both default to
+    None = always armed, so pre-existing rules behave byte-identically
+    (the chaos suite pins this)."""
 
     site: str
     kind: str
@@ -110,6 +121,8 @@ class FaultRule:
     after: int = 0
     delay_s: float = 0.01
     where: str | None = None
+    phase: str | None = None
+    window: tuple[float, float] | None = None
     # bookkeeping (mutated by the injector)
     seen: int = 0
     fired: int = 0
@@ -175,8 +188,27 @@ class FaultInjector:
         self.seed = seed
         self.rng = random.Random(seed)
         self.schedule: list[tuple[int, str, str]] = []
+        # site-local strike record: (phase, site, kind, rule-local hit
+        # ordinal). Unlike ``schedule``'s global ``seq`` (which shifts
+        # with thread interleaving), the hit ordinal is counted per
+        # rule, so count-gated campaigns (probability=1.0 + after/times)
+        # replay this timeline exactly under concurrent load — the
+        # reproducibility artifact the scenario engine reports.
+        self.site_timeline: list[tuple[str | None, str, str, int]] = []
+        self._phase: str | None = None
+        self._armed_at = time.monotonic()
         self._seq = 0
         self._lock = threading.RLock()
+
+    def set_phase(self, phase: str | None) -> None:
+        """Label the current campaign phase; rules carrying a ``phase``
+        pattern are armed only while the label glob-matches."""
+        with self._lock:
+            self._phase = phase
+
+    @property
+    def phase(self) -> str | None:
+        return self._phase
 
     def on_fire(self, site: str, **ctx):
         """Consult the rules for one boundary crossing. Returns a
@@ -187,9 +219,19 @@ class FaultInjector:
         with self._lock:
             self._seq += 1
             seq = self._seq
+            elapsed = time.monotonic() - self._armed_at
             for r in self.rules:
                 if not fnmatch.fnmatch(site, r.site):
                     continue
+                if r.phase is not None and (
+                    self._phase is None
+                    or not fnmatch.fnmatch(self._phase, r.phase)
+                ):
+                    continue  # dormant: out-of-phase hits don't count
+                if r.window is not None and not (
+                    r.window[0] <= elapsed < r.window[1]
+                ):
+                    continue  # dormant: out-of-window hits don't count
                 if r.where is not None and not any(
                     r.where in str(v) for v in ctx.values()
                 ):
@@ -203,6 +245,7 @@ class FaultInjector:
                     continue
                 r.fired += 1
                 self.schedule.append((seq, site, r.kind))
+                self.site_timeline.append((self._phase, site, r.kind, r.seen))
                 if r.kind == "corrupt":
                     corrupt = _corruptor(self.rng.randrange(1 << 16))
                 elif r.kind == "bitflip":

@@ -1,0 +1,82 @@
+"""CLI: ``python -m celestia_tpu.scenarios <name> [options]``."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import library
+from .engine import run_scenario
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m celestia_tpu.scenarios",
+        description="run a declarative robustness scenario and judge it "
+                    "by the node's own SLO engine")
+    p.add_argument("name", nargs="?", help="scenario name (see --list)")
+    p.add_argument("--list", action="store_true",
+                   help="list shipped scenarios and exit")
+    p.add_argument("--seed", type=int, default=1337,
+                   help="seed pinning traffic shapes, sample coordinates "
+                        "and the fault timeline (default 1337)")
+    p.add_argument("--duration-scale", type=float, default=1.0,
+                   help="multiply every phase duration (CI may shrink, "
+                        "soak may stretch)")
+    p.add_argument("--report", metavar="PATH",
+                   help="write the machine-readable scenario report here")
+    p.add_argument("--ledger", metavar="PATH",
+                   help="append a {pass, breaches} run record to this "
+                        "scenario ledger (read by make bench-gate)")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress the report summary on stdout")
+    args = p.parse_args(argv)
+
+    if args.list:
+        for name in sorted(library.SCENARIOS):
+            print(f"{name:20s} {library.SCENARIOS[name]().description}")
+        return 0
+    if not args.name:
+        p.error("scenario name required (or --list)")
+    try:
+        scenario = library.get(args.name)
+    except KeyError as e:
+        p.error(str(e))
+
+    report = run_scenario(scenario, seed=args.seed,
+                          duration_scale=args.duration_scale,
+                          report_path=args.report,
+                          ledger_path=args.ledger)
+    if not args.quiet:
+        _summarize(report)
+    return 0 if report["scenario_slo_pass"] else 1
+
+
+def _summarize(report: dict) -> None:
+    v = report["verdict"]
+    status = "PASS" if report["scenario_slo_pass"] else "FAIL"
+    print(f"scenario {report['scenario']} seed={report['seed']} "
+          f"wall={report['wall_s']}s: {status}")
+    for ph in report["phases"]:
+        print(f"  phase {ph['name']:20s} slo_ok={ph['slo']['ok']} "
+              f"faults={len(ph['faults'])}")
+    for inv in report["invariants"]:
+        mark = "ok " if inv["ok"] else "FAIL"
+        print(f"  invariant {mark} {inv['name']}: {inv['detail']}")
+    if v["breaching_objectives"]:
+        print(f"  breaching objectives: {v['breaching_objectives']}")
+    if v["unexpected_breaches"]:
+        print(f"  UNEXPECTED breaches: {v['unexpected_breaches']}")
+    if v["missing_required_breaches"]:
+        print(f"  MISSING required breaches: "
+              f"{v['missing_required_breaches']}")
+    w = report["world"]
+    print(f"  world: heights={w['heights']} das={w['das']} "
+          f"pfb={w['pfb']} mempool={w['mempool']}")
+    if not report["scenario_slo_pass"]:
+        print(json.dumps(v, indent=2), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

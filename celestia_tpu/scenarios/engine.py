@@ -1,0 +1,194 @@
+"""Scenario engine: run a declarative Scenario, judge it by the SLO board.
+
+The run loop (specs/scenarios.md):
+
+    arm ONE seeded FaultInjector carrying every campaign rule,
+    phase-scoped so each rule is dormant outside its phase;
+    for each phase:
+        set the injector phase label, apply enter actions,
+        bracket the phase with SloEngine.capture(),
+        start the phase's load drivers, drive prober cycles and
+        periodic SLO evaluations until the (scaled) deadline,
+        stop drivers, apply exit actions, clear the phase label,
+        record the phase report (loads, windowed SLO verdict, the
+        slice of the fault timeline the phase produced);
+    quiesce, take the whole-run SLO window, run the invariant
+    probes, assemble the verdict, emit the machine-readable report.
+
+The oracle is the node's own SLO engine plus the invariant probes —
+no bespoke asserts: a scenario passes when the breaching-objective set
+matches its contract (allowed/required) and every invariant holds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from celestia_tpu import faults, slo
+
+from . import verdict as verdict_mod
+from .spec import Scenario
+from .world import ScenarioWorld
+
+#: ledger cap — matches storm_ledger.json's bounded-history approach
+LEDGER_MAX_RUNS = 64
+
+
+def campaign_rules(scenario: Scenario) -> list[faults.FaultRule]:
+    """Every phase's campaigns as phase-scoped injector rules. Count-
+    gated by construction (CampaignRule has no probability field), so
+    the resulting site-local timeline is the reproducibility artifact."""
+    rules = []
+    for ph in scenario.phases:
+        for c in ph.campaigns:
+            rules.append(faults.rule(
+                c.site, c.kind, times=c.times, after=c.after,
+                delay_s=c.delay_s, where=c.where, phase=ph.name,
+            ))
+    return rules
+
+
+def run_scenario(scenario: Scenario, *, seed: int = 1337,
+                 duration_scale: float = 1.0,
+                 report_path: str | None = None,
+                 ledger_path: str | None = None,
+                 registry=None) -> dict:
+    """Execute one scenario end to end; returns the scenario report."""
+    if registry is None:
+        from celestia_tpu.telemetry import metrics as registry
+    world = ScenarioWorld(scenario, seed, registry=registry)
+    injector = faults.FaultInjector(campaign_rules(scenario), seed=seed)
+    engine = slo.SloEngine(registry=registry)
+    phases: list[dict] = []
+    t_start = time.monotonic()
+    with faults.inject(injector=injector):
+        world.start()
+        run_cap0 = engine.capture()
+        for ph in scenario.phases:
+            phases.append(_run_phase(scenario, ph, world, injector,
+                                     engine, seed, duration_scale))
+        world.quiesce()
+        world.freeze()  # heights stable: probes judge a fixed chain
+        world.settle_follower()
+        run_cap1 = engine.capture()
+        whole_run = engine.evaluate_at((run_cap0, run_cap1))
+        final = engine.evaluate()  # breach transitions on full history
+        invariants = verdict_mod.run_invariants(scenario, world, injector,
+                                                registry, run_cap0, run_cap1)
+        world.stop()
+    v = verdict_mod.assemble(scenario, whole_run, phases, final, invariants)
+    report = {
+        "scenario": scenario.name,
+        "description": scenario.description,
+        "seed": seed,
+        "duration_scale": duration_scale,
+        "wall_s": round(time.monotonic() - t_start, 3),
+        "phases": phases,
+        "slo": {"whole_run": whole_run, "final_ok": final["ok"]},
+        "invariants": invariants,
+        "fault_timeline": [list(e) for e in injector.site_timeline],
+        "world": {
+            "heights": world.node.latest_height(),
+            "produced": dict(world.produced),
+            "mempool": dict(world.node.mempool_stats),
+            "das": dict(world.das_stats),
+            "pfb": dict(world.pfb_stats),
+            "sdc_detections": list(world.sdc_detections),
+            "sdc_missed": list(world.sdc_missed),
+            "follower": dict(world.follower_stats),
+            "readyz_transitions": [
+                [round(t - t_start, 3), ready, list(failing)]
+                for t, ready, failing in world.readyz_transitions()
+            ],
+        },
+        "verdict": v,
+        "scenario_slo_pass": v["pass"],
+        "breaches": v["breaches"],
+    }
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=2)
+    if ledger_path:
+        append_ledger(ledger_path, report)
+    return report
+
+
+def _run_phase(scenario: Scenario, ph, world: ScenarioWorld,
+               injector: faults.FaultInjector, engine: slo.SloEngine,
+               seed: int, duration_scale: float) -> dict:
+    injector.set_phase(ph.name)
+    world.apply_actions(ph.enter_actions)
+    overload = any(c.site.startswith("dispatch.") for c in ph.campaigns)
+    if overload:
+        # a dispatcher campaign may legitimately flip /readyz's
+        # not_overloaded check — declare the window so the readiness
+        # invariant can tell expected flips from spurious ones
+        world.note_degradation("overload")
+    cap0 = engine.capture()
+    timeline_mark = len(injector.site_timeline)
+    stop = threading.Event()
+    drivers = world.start_loads(ph.loads, seed, stop)
+    deadline = time.monotonic() + ph.duration_s * duration_scale
+    next_probe = 0.0
+    next_eval = 0.0
+    while time.monotonic() < deadline:
+        now = time.monotonic()
+        if world.prober is not None and now >= next_probe:
+            try:
+                world.prober.probe_cycle()
+            except Exception:  # noqa: BLE001 — probes must not kill a run
+                pass
+            next_probe = now + 0.35
+        if now >= next_eval:
+            engine.evaluate()  # feed the burn-rate snapshot history
+            next_eval = now + 0.5
+        time.sleep(0.03)
+    stop.set()
+    for t in drivers:
+        t.join(timeout=10)
+    world.apply_actions(ph.exit_actions)
+    if overload:
+        world.end_degradation("overload")
+    injector.set_phase(None)
+    cap1 = engine.capture()
+    return {
+        "name": ph.name,
+        "duration_s": ph.duration_s * duration_scale,
+        "loads": [
+            {"kind": ls.kind, "clients": ls.clients, "profile": ls.profile}
+            for ls in ph.loads
+        ],
+        "slo": engine.evaluate_at((cap0, cap1)),
+        "faults": [list(e) for e in
+                   injector.site_timeline[timeline_mark:]],
+    }
+
+
+def append_ledger(path: str, report: dict) -> None:
+    """Fold one run into the scenario ledger (`make bench-gate` reads
+    the ``breaches`` series as ``scenario_slo_pass``: 0 = every SLO and
+    invariant held, >0 = the run breached its contract)."""
+    doc: dict = {"runs": []}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                loaded = json.load(f)
+            if isinstance(loaded, dict) and isinstance(
+                    loaded.get("runs"), list):
+                doc = loaded
+        except (OSError, ValueError):
+            pass
+    doc["runs"].append({
+        "ts": time.time(),
+        "scenario": report["scenario"],
+        "seed": report["seed"],
+        "pass": report["scenario_slo_pass"],
+        "breaches": report["breaches"],
+        "wall_s": report["wall_s"],
+    })
+    doc["runs"] = doc["runs"][-LEDGER_MAX_RUNS:]
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)

@@ -1,0 +1,255 @@
+"""The shipped scenario suites (`make scenario-*`, specs/scenarios.md).
+
+Each is a production-emulation campaign judged by the SLO board:
+
+    pfb-storm         sustained PFB traffic through every txsim
+                      profile while a DAS flash crowd samples, with a
+                      mid-storm dispatcher stall and a corrupted-body
+                      burst — every default objective must HOLD.
+    rolling-outage    two TPU strike/recover waves under load, each
+                      with a dispatcher-delay campaign; the disable
+                      counter MUST breach (the board saw the outage)
+                      while availability rides through on the host
+                      fallback and /readyz flips in order.
+    sdc-under-storm   bitflips at device.extend.output and
+                      transfer.chunk mid-storm with full audits on;
+                      sdc_detected MUST breach, zero flips go
+                      undetected, every quarantine recomputes a
+                      byte-identical host DAH.
+    rejoin-under-load a follower boots mid-storm and state-syncs from
+                      the primary over a faulted transport (errors,
+                      resets, a corrupted payload) while the flash
+                      crowd continues; it must converge byte-identically.
+    smoke             the crypto-free CI gate: every engine mechanism
+                      (profiles, phase-scoped campaigns, SDC drill,
+                      strike/recover, windowed verdict) in a few
+                      seconds.
+
+Campaign determinism: every rule is count-gated (times/after), so the
+reported fault timeline is reproducible from one --seed (the load
+floors — blocks per phase, dispatch hits per phase — comfortably
+exceed every rule's after+times).
+"""
+
+from __future__ import annotations
+
+from .spec import CampaignRule, LoadSpec, Phase, Scenario
+
+
+def _pfb_storm() -> Scenario:
+    return Scenario(
+        name="pfb-storm",
+        description=("mempool-saturating PFB storm across every traffic "
+                     "profile + DAS flash crowd; all SLOs must hold"),
+        k=8,
+        queue_capacity=64,
+        block_interval_s=0.2,
+        mempool_cap=256,
+        phases=(
+            Phase(name="small-saturation", duration_s=4.0, loads=(
+                LoadSpec(kind="pfb", clients=4, profile="small-saturation"),
+                LoadSpec(kind="das", clients=4),
+            )),
+            Phase(name="mixed-flash-crowd", duration_s=4.0, loads=(
+                LoadSpec(kind="pfb", clients=3, profile="mixed-namespaces"),
+                LoadSpec(kind="das", clients=8),
+            ), campaigns=(
+                # mid-storm dispatcher stall: bounded, so shedding (if
+                # any) stays inside the rpc_admission budget
+                CampaignRule(site="dispatch.run", kind="delay",
+                             delay_s=0.02, times=20, after=10),
+            )),
+            Phase(name="huge-rollup", duration_s=4.0, loads=(
+                LoadSpec(kind="pfb", clients=2, profile="huge-rollup",
+                         rate_hz=4.0),
+                LoadSpec(kind="das", clients=4),
+            ), campaigns=(
+                # a burst of corrupted request bodies: the server must
+                # answer 400, never 500, and availability must not move
+                CampaignRule(site="rpc.post", kind="corrupt", times=3),
+            )),
+        ),
+        invariants=("prober_verified", "dah_byte_identical",
+                    "readyz_well_ordered"),
+    )
+
+
+def _rolling_outage() -> Scenario:
+    return Scenario(
+        name="rolling-outage",
+        description=("rolling TPU strike-outs and recoveries under "
+                     "load; the SLO board must SEE the outage while "
+                     "serving rides the host fallback"),
+        k=8,
+        queue_capacity=64,
+        block_interval_s=0.2,
+        phases=(
+            Phase(name="steady", duration_s=3.0, loads=(
+                LoadSpec(kind="das", clients=4),
+                LoadSpec(kind="pfb", clients=2, profile="mixed-namespaces"),
+            )),
+            Phase(name="strike-1", duration_s=3.0,
+                  enter_actions=("tpu_strike",),
+                  exit_actions=("tpu_recover",),
+                  loads=(
+                      LoadSpec(kind="das", clients=6),
+                  ), campaigns=(
+                      CampaignRule(site="dispatch.run", kind="delay",
+                                   delay_s=0.02, times=15),
+                  )),
+            Phase(name="recovered-1", duration_s=2.0, loads=(
+                LoadSpec(kind="das", clients=4),
+            )),
+            Phase(name="strike-2", duration_s=3.0,
+                  enter_actions=("tpu_strike",),
+                  exit_actions=("tpu_recover",),
+                  loads=(
+                      LoadSpec(kind="das", clients=6),
+                  ), campaigns=(
+                      CampaignRule(site="dispatch.enqueue", kind="delay",
+                                   delay_s=0.015, times=10, after=5),
+                  )),
+            Phase(name="recovered-2", duration_s=2.0, loads=(
+                LoadSpec(kind="das", clients=4),
+            )),
+        ),
+        # the strikes MUST surface on the board (disable counter), and
+        # that is the only breach the run may show
+        required_breaches=frozenset({"tpu_not_sticky_disabled"}),
+        invariants=("prober_verified", "dah_byte_identical",
+                    "readyz_well_ordered"),
+    )
+
+
+def _sdc_under_storm() -> Scenario:
+    return Scenario(
+        name="sdc-under-storm",
+        description=("seeded bitflips at device.extend.output and "
+                     "transfer.chunk mid-storm under full audits: "
+                     "zero undetected, every quarantine host-parity"),
+        k=8,
+        queue_capacity=64,
+        block_interval_s=0.25,
+        sdc_producer=True,
+        phases=(
+            Phase(name="warmup", duration_s=2.5, loads=(
+                LoadSpec(kind="das", clients=4),
+            )),
+            Phase(name="flips-mid-storm", duration_s=4.0, loads=(
+                LoadSpec(kind="das", clients=6),
+                LoadSpec(kind="pfb", clients=2, profile="small-saturation"),
+            ), campaigns=(
+                CampaignRule(site="device.extend.output", kind="bitflip",
+                             times=2, after=1),
+                CampaignRule(site="transfer.chunk", kind="bitflip",
+                             times=1, where="scenario.stage"),
+            )),
+            Phase(name="recovered", duration_s=2.5,
+                  enter_actions=("sdc_clear",),
+                  loads=(
+                      LoadSpec(kind="das", clients=4),
+                  )),
+        ),
+        # detection IS the acceptance: the run fails unless the
+        # sdc_detected objective breached during the campaign
+        required_breaches=frozenset({"sdc_detected"}),
+        invariants=("prober_verified", "dah_byte_identical",
+                    "readyz_well_ordered", "zero_undetected_sdc"),
+    )
+
+
+def _rejoin_under_load() -> Scenario:
+    return Scenario(
+        name="rejoin-under-load",
+        description=("a follower boots mid-storm and state-syncs from "
+                     "the primary over a faulted transport while the "
+                     "flash crowd continues"),
+        k=8,
+        queue_capacity=64,
+        block_interval_s=0.25,
+        phases=(
+            Phase(name="steady", duration_s=2.5, loads=(
+                LoadSpec(kind="das", clients=4),
+                LoadSpec(kind="pfb", clients=2, profile="mixed-namespaces"),
+            )),
+            Phase(name="rejoin-under-fire", duration_s=5.0,
+                  enter_actions=("follower_boot",),
+                  loads=(
+                      LoadSpec(kind="das", clients=6),
+                      LoadSpec(kind="follower_sync", clients=1),
+                  ), campaigns=(
+                      # the rejoiner's transport is the faulted one:
+                      # rpc.get fires only in node/client.RpcClient,
+                      # which only the follower's sync loop uses here
+                      CampaignRule(site="rpc.get", kind="error", times=2),
+                      CampaignRule(site="rpc.get", kind="reset", times=1,
+                                   after=6),
+                      CampaignRule(site="rpc.get", kind="corrupt", times=1,
+                                   after=12),
+                  )),
+            Phase(name="converged", duration_s=2.5, loads=(
+                LoadSpec(kind="das", clients=4),
+                LoadSpec(kind="follower_sync", clients=1),
+            )),
+        ),
+        invariants=("prober_verified", "dah_byte_identical",
+                    "readyz_well_ordered", "follower_caught_up"),
+    )
+
+
+def _smoke() -> Scenario:
+    return Scenario(
+        name="smoke",
+        description=("crypto-free CI gate: every engine mechanism in a "
+                     "few seconds — profile load, phase-scoped "
+                     "campaigns, SDC drill, strike/recover, windowed "
+                     "verdict"),
+        k=4,
+        queue_capacity=32,
+        block_interval_s=0.2,
+        sdc_producer=True,
+        phases=(
+            Phase(name="warm", duration_s=1.5, loads=(
+                LoadSpec(kind="das", clients=3),
+                LoadSpec(kind="pfb", clients=2, profile="small-saturation"),
+            )),
+            Phase(name="squall", duration_s=2.5,
+                  enter_actions=("tpu_strike",),
+                  exit_actions=("tpu_recover",),
+                  loads=(
+                      LoadSpec(kind="das", clients=4),
+                      LoadSpec(kind="pfb", clients=2,
+                               profile="mixed-namespaces"),
+                  ), campaigns=(
+                      CampaignRule(site="dispatch.run", kind="delay",
+                                   delay_s=0.01, times=8),
+                      CampaignRule(site="device.extend.output",
+                                   kind="bitflip", times=1, after=1),
+                  )),
+            Phase(name="recover", duration_s=1.5,
+                  enter_actions=("sdc_clear",),
+                  loads=(
+                      LoadSpec(kind="das", clients=3),
+                  )),
+        ),
+        required_breaches=frozenset({"sdc_detected",
+                                     "tpu_not_sticky_disabled"}),
+        invariants=("prober_verified", "dah_byte_identical",
+                    "readyz_well_ordered", "zero_undetected_sdc"),
+    )
+
+
+SCENARIOS = {
+    fn().name: fn
+    for fn in (_pfb_storm, _rolling_outage, _sdc_under_storm,
+               _rejoin_under_load, _smoke)
+}
+
+
+def get(name: str) -> Scenario:
+    try:
+        return SCENARIOS[name]()
+    except KeyError:
+        raise KeyError(
+            f"unknown scenario {name!r}; one of {sorted(SCENARIOS)}"
+        ) from None

@@ -1,0 +1,143 @@
+"""Scenario schema: the declarative surface of the scenario engine.
+
+A Scenario is three things (specs/scenarios.md):
+
+    1. a timeline of LOAD PHASES — each phase runs a set of load
+       drivers (DAS sample clients, PFB broadcast storms shaped by a
+       txsim TrafficProfile, a follower state-sync) for a duration;
+    2. a schedule of FAULT CAMPAIGNS — CampaignRules attached to a
+       phase, armed through the seeded injector with the rule's
+       ``phase`` scoping (celestia_tpu/faults.py): the rule is dormant
+       outside its phase and re-arms nothing on exit;
+    3. an SLO VERDICT contract — which objectives are allowed to
+       breach, which MUST breach (a detection that fails to surface on
+       the SLO board is itself a failure), and which invariant probes
+       run at teardown.
+
+Seed-reproducibility contract: campaign rules are COUNT-GATED —
+``times``/``after`` on the rule's site-local hit ordinal, never
+``probability`` — so the canonical fault timeline (phase, site, kind,
+ordinal) is identical across runs with the same ``--seed`` as long as
+each phase drives at least ``after + times`` hits to each armed site
+(validated load floors; specs/scenarios.md). The seed additionally
+pins the traffic shapes (blob sizes, namespaces, sample coordinates)
+and every corruption payload position.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+#: load driver kinds world.py implements
+LOAD_KINDS = ("das", "pfb", "follower_sync")
+
+#: phase-boundary world actions engine.py may apply
+ACTIONS = ("tpu_strike", "tpu_recover", "sdc_clear", "follower_boot")
+
+#: invariant probes verdict.py implements
+INVARIANTS = ("prober_verified", "dah_byte_identical",
+              "readyz_well_ordered", "zero_undetected_sdc",
+              "follower_caught_up")
+
+#: fault sites whose bitflips are silent-data-corruption injections —
+#: the zero_undetected_sdc probe counts timeline entries at these
+SDC_SITES = ("device.extend.output", "device.repair.output",
+             "transfer.chunk")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadSpec:
+    """One load driver for one phase.
+
+    ``kind='das'``: ``clients`` closed-loop light clients sampling
+    random cells over the served heights, verifying every NMT proof.
+    ``kind='pfb'``: ``clients`` broadcasters POSTing profile-shaped
+    PFB payloads (txsim.PROFILES[profile]).
+    ``kind='follower_sync'``: the booted follower node catches up from
+    the primary over a real RpcClient (rides the ``rpc.get`` site).
+    ``rate_hz`` caps per-client op rate; None = closed loop."""
+
+    kind: str
+    clients: int = 1
+    profile: str | None = None
+    rate_hz: float | None = None
+
+    def __post_init__(self):
+        if self.kind not in LOAD_KINDS:
+            raise ValueError(
+                f"unknown load kind {self.kind!r}; one of {LOAD_KINDS}")
+        if self.kind == "pfb" and self.profile is None:
+            raise ValueError("pfb load requires a traffic profile")
+
+
+@dataclasses.dataclass(frozen=True)
+class CampaignRule:
+    """One count-gated fault armed for the enclosing phase only.
+
+    Deliberately narrower than faults.FaultRule: no ``probability``
+    field exists, so every campaign is deterministic by construction
+    (the seed-reproducibility contract)."""
+
+    site: str
+    kind: str
+    times: int = 1
+    after: int = 0
+    delay_s: float = 0.01
+    where: str | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase:
+    """One timeline segment: loads + campaigns + boundary actions."""
+
+    name: str
+    duration_s: float
+    loads: tuple[LoadSpec, ...] = ()
+    campaigns: tuple[CampaignRule, ...] = ()
+    enter_actions: tuple[str, ...] = ()
+    exit_actions: tuple[str, ...] = ()
+
+    def __post_init__(self):
+        for a in self.enter_actions + self.exit_actions:
+            if a not in ACTIONS:
+                raise ValueError(f"unknown action {a!r}; one of {ACTIONS}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """A full production-emulation run (see module docstring)."""
+
+    name: str
+    description: str
+    phases: tuple[Phase, ...]
+    # world shape
+    k: int = 8
+    initial_heights: int = 1
+    block_interval_s: float = 0.25
+    queue_capacity: int = 64
+    default_deadline_s: float = 8.0
+    sdc_producer: bool = False  # produce via audited device extends
+    mempool_cap: int = 512
+    # verdict contract
+    allowed_breaches: frozenset[str] = frozenset()
+    required_breaches: frozenset[str] = frozenset()
+    invariants: tuple[str, ...] = ("prober_verified", "dah_byte_identical",
+                                   "readyz_well_ordered")
+
+    def __post_init__(self):
+        if not self.phases:
+            raise ValueError("scenario needs at least one phase")
+        names = [p.name for p in self.phases]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate phase names: {names}")
+        for inv in self.invariants:
+            if inv not in INVARIANTS:
+                raise ValueError(
+                    f"unknown invariant {inv!r}; one of {INVARIANTS}")
+        uses_follower = any(
+            ls.kind == "follower_sync" for p in self.phases for ls in p.loads)
+        boots_follower = any(
+            "follower_boot" in p.enter_actions for p in self.phases)
+        if uses_follower and not boots_follower:
+            raise ValueError("follower_sync load without a follower_boot "
+                             "enter action")

@@ -1,0 +1,197 @@
+"""Teardown verdict: SLO contract matching + invariant probes.
+
+The verdict has two layers (specs/scenarios.md):
+
+    SLO contract — the whole-run windowed evaluation's breaching-
+    objective set must be a subset of ``allowed_breaches`` and a
+    superset of ``required_breaches``. Required breaches make
+    DETECTION itself an acceptance criterion: sdc-under-storm fails
+    unless ``sdc_detected`` breached, because corruption that never
+    surfaced on the SLO board is worse than corruption that did.
+
+    Invariant probes — properties that must hold regardless of SLO
+    arithmetic: every prober-accepted sample was NMT-verified, the
+    served DAH is byte-identical to an independent host recompute at
+    every height (across every degradation), /readyz flips are
+    well-ordered against the world's declared degradation windows, no
+    injected SDC went undetected, and a rejoining follower converged
+    on byte-identical state.
+"""
+
+from __future__ import annotations
+
+from .spec import SDC_SITES, Scenario
+
+#: slack around degradation windows when judging readiness flips —
+#: the watcher samples at 150 ms and dispatch queues drain asynchronously
+READYZ_SLACK_S = 1.0
+
+
+def assemble(scenario: Scenario, whole_run: dict, phases: list[dict],
+             final: dict, invariants: list[dict]) -> dict:
+    breaching = {o["name"] for o in whole_run["objectives"] if not o["ok"]}
+    allowed = set(scenario.allowed_breaches) | set(scenario.required_breaches)
+    unexpected = sorted(breaching - allowed)
+    missing = sorted(set(scenario.required_breaches) - breaching)
+    failed_invariants = sorted(i["name"] for i in invariants if not i["ok"])
+    breaches = len(unexpected) + len(missing) + len(failed_invariants)
+    return {
+        "pass": breaches == 0,
+        "breaches": breaches,
+        "breaching_objectives": sorted(breaching),
+        "unexpected_breaches": unexpected,
+        "missing_required_breaches": missing,
+        "failed_invariants": failed_invariants,
+        "phase_slo_ok": [p["slo"]["ok"] for p in phases],
+    }
+
+
+def run_invariants(scenario: Scenario, world, injector, registry,
+                   run_cap0: dict, run_cap1: dict) -> list[dict]:
+    probes = {
+        "prober_verified": _probe_prober_verified,
+        "dah_byte_identical": _probe_dah_byte_identical,
+        "readyz_well_ordered": _probe_readyz_well_ordered,
+        "zero_undetected_sdc": _probe_zero_undetected_sdc,
+        "follower_caught_up": _probe_follower_caught_up,
+    }
+    out = []
+    for name in scenario.invariants:
+        try:
+            ok, detail = probes[name](scenario, world, injector, registry,
+                                      run_cap0, run_cap1)
+        except Exception as e:  # noqa: BLE001 — a crashed probe is a fail
+            ok, detail = False, f"probe crashed: {e}"
+        out.append({"name": name, "ok": bool(ok), "detail": detail})
+    return out
+
+
+def _probe_prober_verified(scenario, world, injector, registry,
+                           cap0, cap1):
+    """The availability signal must be real: the prober ran, counted
+    accepts only after NMT verification (ok <= total by construction),
+    and no load-driver client accepted an unverifiable sample either."""
+    d_total = (cap1["counters"].get("probe_sample_total", 0.0)
+               - cap0["counters"].get("probe_sample_total", 0.0))
+    d_ok = (cap1["counters"].get("probe_sample_ok_total", 0.0)
+            - cap0["counters"].get("probe_sample_ok_total", 0.0))
+    verify_fail = world.das_stats.get("verify_fail", 0)
+    ok = d_total > 0 and 0 <= d_ok <= d_total and verify_fail == 0
+    return ok, (f"probe samples={d_total:.0f} ok={d_ok:.0f} "
+                f"client_verify_failures={verify_fail}")
+
+
+def _probe_dah_byte_identical(scenario, world, injector, registry,
+                              cap0, cap1):
+    """Every committed height's served DAH equals an independent host
+    recompute from the same original shares — across TPU strikes, SDC
+    quarantines, and overload, the answer bytes never moved."""
+    from celestia_tpu import da
+    from celestia_tpu.testutil.chaosnet import chain_shares
+
+    checked = 0
+    for h in sorted(world.node.blocks):
+        served = world.node.block_dah(h)
+        ref = da.new_data_availability_header(
+            da.extend_shares(chain_shares(scenario.k, h, world.seed)))
+        if served.hash() != ref.hash():
+            return False, f"height {h}: served DAH != host recompute"
+        checked += 1
+    return checked > 0, f"{checked} heights byte-identical"
+
+
+def _probe_readyz_well_ordered(scenario, world, injector, registry,
+                               cap0, cap1):
+    """Readiness flips only when a declared degradation explains them,
+    every readiness-affecting degradation actually flipped it, and the
+    world ends ready (scenarios end recovered by contract)."""
+    samples = world.readyz_samples
+    if not samples:
+        return False, "no /readyz samples recorded"
+    if not any(ready for _t, ready, _f in samples):
+        return False, "node never became ready"
+    if samples[-1][1] is not True:
+        return False, f"final /readyz not ready: {samples[-1][2]}"
+    windows = [(d["t0"] - READYZ_SLACK_S,
+                (d["t1"] if d["t1"] is not None else float("inf"))
+                + READYZ_SLACK_S, d["kind"])
+               for d in world.degradations]
+    stray = [
+        (t, failing) for t, ready, failing in samples
+        if not ready and not any(a <= t <= b for a, b, _k in windows)
+    ]
+    if stray:
+        return False, (f"{len(stray)} not-ready samples outside any "
+                       f"degradation window; first failing={stray[0][1]}")
+    # readiness-affecting degradations must be VISIBLE: a strike or a
+    # quarantine that never flipped /readyz means the serving-fit
+    # surface lied to the load balancer
+    for d in world.degradations:
+        if d["kind"] not in ("tpu_strike", "sdc"):
+            continue  # overload windows may never fill the queue
+        t1 = d["t1"] if d["t1"] is not None else float("inf")
+        seen = any(not ready and d["t0"] - READYZ_SLACK_S <= t
+                   <= t1 + READYZ_SLACK_S
+                   for t, ready, _f in samples)
+        if not seen:
+            return False, f"{d['kind']} window produced no not-ready flip"
+    flips = len(world.readyz_transitions())
+    return True, (f"{len(samples)} samples, {flips} transitions, "
+                  f"{len(windows)} degradation windows, 0 stray")
+
+
+def _probe_zero_undetected_sdc(scenario, world, injector, registry,
+                               cap0, cap1):
+    """Every injected bitflip at an SDC site surfaced as a detection
+    (sdc_detected_total moved once per flip), every extend-path
+    detection carries a quarantine + byte-identical host recompute,
+    and the belt-and-braces DAH parity check caught nothing the audits
+    missed."""
+    injected = sum(1 for _ph, site, kind, _ord in injector.site_timeline
+                   if kind == "bitflip" and site in SDC_SITES)
+    detected = (cap1["counters"].get("sdc_detected_total", 0.0)
+                - cap0["counters"].get("sdc_detected_total", 0.0))
+    if world.sdc_missed:
+        return False, (f"{len(world.sdc_missed)} device blocks diverged "
+                       "the DAH without an audit detection")
+    if injected != detected:
+        return False, (f"injected {injected} flips but "
+                       f"sdc_detected_total moved {detected:.0f}")
+    bad = [d for d in world.sdc_detections
+           if not d["quarantined"] or d["host_dah"] != d["reference_dah"]]
+    if bad:
+        return False, (f"{len(bad)} detections without matching "
+                       "quarantine + byte-identical host recompute")
+    if injected == 0:
+        return False, "no SDC was injected — the probe is vacuous"
+    return True, (f"{injected} injected == {detected:.0f} detected; "
+                  f"{len(world.sdc_detections)} quarantines host-parity ok")
+
+
+def _probe_follower_caught_up(scenario, world, injector, registry,
+                              cap0, cap1):
+    """The rejoining follower converged: it reached (near) the primary
+    head under fire and every installed height's DAH is byte-identical
+    to the primary's."""
+    if world.follower is None:
+        return False, "follower was never booted"
+    primary_h = world.node.latest_height()
+    follower_h = world.follower.latest_height()
+    if follower_h < 1:
+        return False, "follower installed no heights"
+    # production was frozen and settle_follower drained the remaining
+    # lag before this probe, so convergence means equality
+    if follower_h != primary_h:
+        return False, (f"follower at {follower_h} never converged on "
+                       f"frozen primary head {primary_h}")
+    for h in sorted(world.follower.blocks):
+        fd = world.follower.block_dah(h)
+        pd = world.node.block_dah(h)
+        if pd is None or fd.hash() != pd.hash():
+            return False, f"height {h}: follower DAH != primary DAH"
+    return True, (f"follower {follower_h}/{primary_h} heights, all "
+                  f"DAHs byte-identical "
+                  f"({world.follower_stats['retries_absorbed']} transport "
+                  f"faults absorbed, "
+                  f"{world.follower_stats['verify_rejected']} corrupted "
+                  f"fetches rejected)")

@@ -202,19 +202,23 @@ class SloEngine:
             "ok": not any(burning),
         }
 
-    def _eval_quantile(self, o: Objective, _now: float) -> dict:
+    def _merged_hist(self, metric: str):
+        """All label sets of one histogram family merged bucketwise —
+        exact, because bounds are registry-wide (ADR-013)."""
         merged = None
-        for _labels, hist in self.registry.histogram_family(o.metric):
+        for _labels, hist in self.registry.histogram_family(metric):
             if merged is None:
                 from celestia_tpu.telemetry import Histogram
 
                 merged = Histogram(hist.bounds)
-            # bounds are registry-wide (ADR-013), so a bucketwise sum
-            # is the exact merged distribution
             for i, c in enumerate(hist.counts):
                 merged.counts[i] += c
             merged.sum += hist.sum
             merged.count += hist.count
+        return merged
+
+    def _eval_quantile(self, o: Objective, _now: float) -> dict:
+        merged = self._merged_hist(o.metric)
         if merged is None or merged.count == 0:
             return {"name": o.name, "kind": "quantile", "q": o.q,
                     "limit_s": o.limit_s, "value_s": None, "count": 0,
@@ -249,6 +253,110 @@ class SloEngine:
             "objectives": results,
             "snapshots": len(self._snaps),
         }
+
+    # -- windowed verdicts (specs/slo.md, scenarios) -------------------- #
+
+    def capture(self) -> dict:
+        """Freeze one end of an ``evaluate_at`` window: every counter
+        the objectives read plus the bucket state of every quantile
+        metric. Pure read — no snapshot deque append, no transitions —
+        so a scenario engine can bracket each load phase without
+        perturbing the burn-rate history ``evaluate()`` maintains."""
+        counters: dict[str, float] = {}
+        hists: dict[str, tuple] = {}
+        for o in self.objectives:
+            if o.kind == "ratio":
+                for k in (o.good, o.total):
+                    counters[k] = self.registry.get_counter(k)
+            elif o.kind == "counter_max":
+                counters[o.counter] = self.registry.get_counter(o.counter)
+            elif o.kind == "quantile":
+                merged = self._merged_hist(o.metric)
+                if merged is not None:
+                    hists[o.metric] = (tuple(merged.counts), merged.sum,
+                                       merged.count, tuple(merged.bounds))
+        return {"t": self._clock(), "counters": counters, "hists": hists}
+
+    def evaluate_at(self, window: tuple[dict, dict]) -> dict:
+        """Judge every objective over one bracketed window — a pair of
+        ``capture()`` results — instead of whole-process history.
+
+        Window semantics per kind: a *ratio* objective is judged on the
+        good/total counter DELTAS (the in-window error rate vs the
+        error budget; no in-window traffic is a pass with ratio None);
+        a *quantile* objective on the bucketwise histogram DIFF (the
+        distribution of only the in-window observations); a
+        *counter_max* objective on the counter INCREASE vs its limit
+        (e.g. sdc_detected limit 0: any in-window detection breaches,
+        regardless of detections before the window). No breach
+        transitions are emitted — this is a verdict snapshot, not the
+        alerting path."""
+        start, end = window
+        results = [
+            {
+                "ratio": self._eval_ratio_window,
+                "quantile": self._eval_quantile_window,
+                "counter_max": self._eval_counter_max_window,
+            }[o.kind](o, start, end)
+            for o in self.objectives
+        ]
+        return {
+            "ok": all(r["ok"] for r in results),
+            "window_s": end["t"] - start["t"],
+            "objectives": results,
+        }
+
+    @staticmethod
+    def _delta(start: dict, end: dict, key: str) -> float:
+        return (end["counters"].get(key, 0.0)
+                - start["counters"].get(key, 0.0))
+
+    def _eval_ratio_window(self, o: Objective, start: dict,
+                           end: dict) -> dict:
+        d_total = self._delta(start, end, o.total)
+        d_good = self._delta(start, end, o.good)
+        budget = 1.0 - o.target
+        if d_total <= 0:
+            return {"name": o.name, "kind": "ratio", "target": o.target,
+                    "good": d_good, "total": d_total, "ratio": None,
+                    "burn": None, "ok": True}
+        err = max(0.0, d_total - d_good) / d_total
+        ratio = d_good / d_total
+        burn = err / budget if budget > 0 else float("inf")
+        return {"name": o.name, "kind": "ratio", "target": o.target,
+                "good": d_good, "total": d_total, "ratio": ratio,
+                "burn": burn, "ok": ratio >= o.target}
+
+    def _eval_quantile_window(self, o: Objective, start: dict,
+                              end: dict) -> dict:
+        from celestia_tpu.telemetry import Histogram
+
+        e = end["hists"].get(o.metric)
+        if e is None:
+            return {"name": o.name, "kind": "quantile", "q": o.q,
+                    "limit_s": o.limit_s, "value_s": None, "count": 0,
+                    "ok": True}
+        s = start["hists"].get(o.metric)
+        diff = Histogram(list(e[3]))
+        s_counts = s[0] if s is not None else (0,) * len(e[0])
+        diff.counts = [ec - sc for ec, sc in zip(e[0], s_counts)]
+        diff.sum = e[1] - (s[1] if s is not None else 0.0)
+        diff.count = e[2] - (s[2] if s is not None else 0)
+        if diff.count <= 0:
+            return {"name": o.name, "kind": "quantile", "q": o.q,
+                    "limit_s": o.limit_s, "value_s": None, "count": 0,
+                    "ok": True}
+        value = diff.quantile(o.q)
+        return {"name": o.name, "kind": "quantile", "q": o.q,
+                "limit_s": o.limit_s, "value_s": value,
+                "count": diff.count, "ok": value <= o.limit_s}
+
+    def _eval_counter_max_window(self, o: Objective, start: dict,
+                                 end: dict) -> dict:
+        delta = self._delta(start, end, o.counter)
+        return {"name": o.name, "kind": "counter_max",
+                "counter": o.counter, "value": delta, "limit": o.limit,
+                "ok": delta <= o.limit}
 
     def _transition(self, name: str, res: dict) -> None:
         was = self._breached.get(name, False)

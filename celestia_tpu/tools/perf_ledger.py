@@ -63,6 +63,11 @@ TRACKED: dict[str, list[tuple[str | None, str]]] = {
     # (`make storm-bench`). Not extracted from BENCH rounds — the
     # loader folds it in from storm_ledger.json, hence no paths here.
     "storm_ms_per_accepted_sample": [],
+    # robustness: contract breaches per scenario run (`make scenario-*`,
+    # specs/scenarios.md) — 0 means every SLO and invariant held. Folded
+    # from scenario_ledger.json; a breaching run judges as a regression
+    # against the all-zero baseline.
+    "scenario_slo_pass": [],
 }
 
 DEFAULT_THRESHOLD = 1.5  # newest/baseline ratio that counts as regression
@@ -207,6 +212,24 @@ def load_ledger(root: str) -> dict[str, list[tuple[str, float]]]:
                 if isinstance(v, (int, float)):
                     ledger["storm_ms_per_accepted_sample"].append(
                         (f"storm_ledger.json#{idx}", float(v)))
+    # scenario ledger (`python -m celestia_tpu.scenarios --ledger`):
+    # each run's breach count is one point of the scenario_slo_pass
+    # series — the healthy trajectory is all zeros, so any breaching
+    # scenario run fails the gate against its median baseline
+    scen_path = os.path.join(root, "scenario_ledger.json")
+    if os.path.exists(scen_path):
+        try:
+            with open(scen_path) as f:
+                scen = json.load(f)
+        except (OSError, ValueError):
+            scen = None
+        if isinstance(scen, dict):
+            for idx, run in enumerate(scen.get("runs") or []):
+                v = run.get("breaches") if isinstance(run, dict) else None
+                if isinstance(v, (int, float)):
+                    name = run.get("scenario", "?")
+                    ledger["scenario_slo_pass"].append(
+                        (f"scenario_ledger.json#{idx}:{name}", float(v)))
     return ledger
 
 

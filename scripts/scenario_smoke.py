@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Scenario-engine smoke gate (ADR-018, `make scenario-smoke`).
+
+Crypto-free end-to-end drill of the scenario engine: runs the shipped
+`smoke` scenario TWICE with the same seed and fails (non-zero exit)
+unless:
+
+  1. both runs PASS their verdict contract — every default SLO holds
+     except the two required breaches (sdc_detected and
+     tpu_not_sticky_disabled: the drill's flip and strike MUST surface
+     on the SLO board), and every invariant probe holds (prober
+     NMT-verified, DAH byte-identical at every height, /readyz flips
+     well-ordered against declared degradation windows, zero
+     undetected SDC),
+  2. the canonical fault timeline — (phase, site, kind, site-local
+     ordinal) — is IDENTICAL across the two runs: the
+     seed-reproducibility contract of specs/scenarios.md,
+  3. a different seed still passes (the verdict is a property of the
+     engine, not of one lucky timeline),
+  4. the report carries the machine-readable surface bench-gate and CI
+     consume (scenario_slo_pass, breaches, phases[].slo, invariants,
+     fault_timeline, world stats),
+  5. the scenario ledger append folds {pass, breaches} records that
+     `make bench-gate` reads as the scenario_slo_pass series.
+
+CPU-only, no signing stack, warm in well under the 120 s budget (the
+first run pays the device-extend JIT compile; the rest ride the cache).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SEED = 1337
+
+
+def gate(ok: bool, what: str) -> None:
+    print(("PASS " if ok else "FAIL ") + what)
+    if not ok:
+        raise SystemExit(f"scenario-smoke: {what}")
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    from celestia_tpu.scenarios import library, run_scenario
+
+    sc = library.get("smoke")
+    with tempfile.TemporaryDirectory() as td:
+        report_path = os.path.join(td, "report.json")
+        ledger_path = os.path.join(td, "ledger.json")
+
+        r1 = run_scenario(sc, seed=SEED, report_path=report_path,
+                          ledger_path=ledger_path)
+        r2 = run_scenario(sc, seed=SEED, ledger_path=ledger_path)
+
+        gate(r1["scenario_slo_pass"] and r1["breaches"] == 0,
+             f"run 1 passes its verdict contract "
+             f"(breaches={r1['breaches']})")
+        gate(r2["scenario_slo_pass"] and r2["breaches"] == 0,
+             f"run 2 passes its verdict contract "
+             f"(breaches={r2['breaches']})")
+
+        v = r1["verdict"]
+        gate(set(v["breaching_objectives"])
+             == {"sdc_detected", "tpu_not_sticky_disabled"},
+             "exactly the two required breaches surfaced on the SLO "
+             f"board (got {v['breaching_objectives']})")
+        gate(all(i["ok"] for i in r1["invariants"])
+             and {i["name"] for i in r1["invariants"]}
+             == {"prober_verified", "dah_byte_identical",
+                 "readyz_well_ordered", "zero_undetected_sdc"},
+             "all four invariant probes ran and held")
+
+        gate(r1["fault_timeline"] == r2["fault_timeline"]
+             and len(r1["fault_timeline"]) > 0,
+             f"fault timeline identical across same-seed runs "
+             f"({len(r1['fault_timeline'])} events)")
+        flips = [e for e in r1["fault_timeline"]
+                 if e[2] == "bitflip" and e[1] == "device.extend.output"]
+        gate(len(flips) == 1 and flips[0][0] == "squall",
+             "the SDC flip landed in its armed phase (squall)")
+
+        r3 = run_scenario(sc, seed=SEED + 1)
+        gate(r3["scenario_slo_pass"],
+             "a different seed still passes (engine property, not a "
+             "lucky timeline)")
+
+        with open(report_path) as f:
+            on_disk = json.load(f)
+        for key in ("scenario", "seed", "scenario_slo_pass", "breaches",
+                    "phases", "slo", "invariants", "fault_timeline",
+                    "world", "verdict"):
+            gate(key in on_disk, f"report carries {key!r}")
+        gate(all("slo" in p and "ok" in p["slo"] and "faults" in p
+                 for p in on_disk["phases"]),
+             "every phase report carries its windowed SLO verdict")
+
+        with open(ledger_path) as f:
+            ledger = json.load(f)
+        runs = ledger.get("runs", [])
+        gate(len(runs) == 2
+             and all(r["pass"] is True and r["breaches"] == 0
+                     and r["scenario"] == "smoke" for r in runs),
+             "scenario ledger folded both runs as {pass, breaches}")
+
+    wall = time.monotonic() - t0
+    gate(wall < 120, f"smoke total {wall:.1f}s under the 120 s budget")
+    print(f"scenario-smoke: all gates passed ({wall:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

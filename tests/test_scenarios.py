@@ -1,0 +1,406 @@
+"""Scenario-engine tests (specs/scenarios.md, ADR-018).
+
+Fast, crypto-free unit coverage of the pieces the engine composes —
+phase/window-scoped fault arming, the windowed SLO verdict, the
+declarative schema's validation, the verdict contract arithmetic, the
+scenario ledger fold — plus a slow-tier end-to-end run of the `smoke`
+scenario pinning the seed-reproducibility contract the Makefile
+targets rely on."""
+
+import json
+import time
+
+import pytest
+
+from celestia_tpu import faults
+from celestia_tpu.scenarios import (CampaignRule, LoadSpec, Phase, SCENARIOS,
+                                    Scenario, append_ledger, campaign_rules,
+                                    library)
+from celestia_tpu.scenarios import verdict as verdict_mod
+from celestia_tpu.slo import Objective, SloEngine
+from celestia_tpu.telemetry import Registry
+
+
+# --------------------------------------------------------------------- #
+# faults: phase + window scoping (satellite of specs/faults.md)
+
+
+class TestPhaseScopedFaults:
+    def test_dormant_outside_phase(self):
+        r = faults.rule("rpc.get", "error", times=1, phase="storm")
+        inj = faults.FaultInjector([r], seed=1)
+        with faults.inject(injector=inj):
+            faults.fire("rpc.get")  # no phase label: dormant
+            inj.set_phase("calm")
+            faults.fire("rpc.get")  # wrong phase: dormant
+        assert r.seen == 0 and r.fired == 0
+        assert inj.schedule == [] and inj.site_timeline == []
+
+    def test_out_of_phase_hits_do_not_consume_after(self):
+        """Dormancy means the rule's hit counter is untouched — phase-2
+        campaigns replay identically however much phase-1 traffic ran."""
+        r = faults.rule("rpc.get", "error", times=1, after=1, phase="p2")
+        inj = faults.FaultInjector([r], seed=1)
+        with faults.inject(injector=inj):
+            for _ in range(10):
+                faults.fire("rpc.get")  # phase None: none of these count
+            inj.set_phase("p2")
+            faults.fire("rpc.get")  # seen=1 == after: skipped
+            with pytest.raises(faults.TransportFault):
+                faults.fire("rpc.get")  # seen=2: fires
+        assert (r.seen, r.fired) == (2, 1)
+        assert inj.site_timeline == [("p2", "rpc.get", "error", 2)]
+
+    def test_phase_glob_and_rearming(self):
+        r = faults.rule("rpc.get", "delay", delay_s=0.0, phase="storm-*")
+        inj = faults.FaultInjector([r], seed=1)
+        with faults.inject(injector=inj):
+            inj.set_phase("storm-1")
+            faults.fire("rpc.get")
+            inj.set_phase("recovery")
+            faults.fire("rpc.get")  # dormant again
+            inj.set_phase("storm-2")
+            faults.fire("rpc.get")  # re-armed by the glob
+        assert r.fired == 2
+        assert [e[0] for e in inj.site_timeline] == ["storm-1", "storm-2"]
+
+    def test_window_scoping(self):
+        armed = faults.rule("x", "delay", delay_s=0.0,
+                            window=(0.0, 30.0))
+        future = faults.rule("x", "delay", delay_s=0.0,
+                             window=(30.0, 60.0))
+        inj = faults.FaultInjector([armed, future], seed=1)
+        with faults.inject(injector=inj):
+            faults.fire("x")
+        assert armed.fired == 1
+        assert future.seen == 0 and future.fired == 0
+
+    def test_defaults_keep_legacy_rules_identical(self):
+        """phase=None, window=None must behave exactly as before the
+        fields existed — the chaos suite's pinned schedules depend on
+        it."""
+        r = faults.rule("rpc.*", "error", times=2)
+        assert r.phase is None and r.window is None
+        inj = faults.FaultInjector([r], seed=7)
+        with faults.inject(injector=inj):
+            for _ in range(3):
+                try:
+                    faults.fire("rpc.get")
+                except faults.TransportFault:
+                    pass
+        assert r.fired == 2
+        assert [(s, k) for _seq, s, k in inj.schedule] == [
+            ("rpc.get", "error"), ("rpc.get", "error")]
+
+    def test_site_timeline_records_rule_local_ordinals(self):
+        r = faults.rule("a.*", "delay", delay_s=0.0, after=1, times=2)
+        inj = faults.FaultInjector([r], seed=1)
+        with faults.inject(injector=inj):
+            for _ in range(4):
+                faults.fire("a.b")
+        assert inj.site_timeline == [
+            (None, "a.b", "delay", 2), (None, "a.b", "delay", 3)]
+
+
+# --------------------------------------------------------------------- #
+# slo: capture + evaluate_at (satellite of specs/slo.md)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class TestWindowedSlo:
+    def _engine(self, objectives):
+        r = Registry()
+        clock = FakeClock()
+        return SloEngine(objectives, registry=r, clock=clock), r, clock
+
+    def test_ratio_window_judges_only_in_window_traffic(self):
+        eng, r, clock = self._engine([Objective(
+            name="avail", kind="ratio", good="ok_total",
+            total="all_total", target=0.9)])
+        # pre-window: catastrophic error rate
+        for _ in range(100):
+            r.incr_counter("all_total")
+        cap0 = eng.capture()
+        clock.t = 10.0
+        for _ in range(100):
+            r.incr_counter("all_total")
+            r.incr_counter("ok_total")
+        cap1 = eng.capture()
+        res = eng.evaluate_at((cap0, cap1))
+        assert res["ok"] and res["window_s"] == 10.0
+        (obj,) = res["objectives"]
+        assert obj["ratio"] == 1.0 and obj["total"] == 100
+
+    def test_ratio_window_breaches_on_in_window_errors(self):
+        eng, r, clock = self._engine([Objective(
+            name="avail", kind="ratio", good="ok_total",
+            total="all_total", target=0.9)])
+        cap0 = eng.capture()
+        for i in range(100):
+            r.incr_counter("all_total")
+            if i % 2 == 0:
+                r.incr_counter("ok_total")
+        res = eng.evaluate_at((cap0, eng.capture()))
+        assert not res["ok"]
+        (obj,) = res["objectives"]
+        assert obj["ratio"] == 0.5 and obj["burn"] == pytest.approx(5.0)
+
+    def test_ratio_window_no_traffic_is_ok(self):
+        eng, _r, _c = self._engine([Objective(
+            name="avail", kind="ratio", good="g", total="t", target=0.99)])
+        res = eng.evaluate_at((eng.capture(), eng.capture()))
+        assert res["ok"]
+        assert res["objectives"][0]["ratio"] is None
+
+    def test_quantile_window_sees_only_new_observations(self):
+        eng, r, _c = self._engine([Objective(
+            name="lat", kind="quantile", metric="op_seconds", q=0.99,
+            limit_s=1.0)])
+        for _ in range(50):
+            r.observe("op_seconds", 30.0)  # pre-window disaster
+        cap0 = eng.capture()
+        for _ in range(50):
+            r.observe("op_seconds", 0.01)
+        res = eng.evaluate_at((cap0, eng.capture()))
+        assert res["ok"]
+        (obj,) = res["objectives"]
+        assert obj["count"] == 50 and obj["value_s"] < 1.0
+        # and the reverse: in-window regressions are caught even with a
+        # clean history
+        cap2 = eng.capture()
+        for _ in range(50):
+            r.observe("op_seconds", 30.0)
+        res2 = eng.evaluate_at((cap2, eng.capture()))
+        assert not res2["ok"]
+
+    def test_quantile_window_empty_is_ok(self):
+        eng, r, _c = self._engine([Objective(
+            name="lat", kind="quantile", metric="op_seconds", q=0.99,
+            limit_s=1.0)])
+        r.observe("op_seconds", 30.0)
+        cap = eng.capture()
+        res = eng.evaluate_at((cap, eng.capture()))
+        assert res["ok"] and res["objectives"][0]["count"] == 0
+
+    def test_counter_max_window_is_delta_based(self):
+        eng, r, _c = self._engine([Objective(
+            name="sdc", kind="counter_max", counter="sdc_total", limit=0)])
+        for _ in range(5):
+            r.incr_counter("sdc_total")  # detections BEFORE the window
+        cap0 = eng.capture()
+        res = eng.evaluate_at((cap0, eng.capture()))
+        assert res["ok"]  # no in-window movement
+        r.incr_counter("sdc_total")
+        res2 = eng.evaluate_at((cap0, eng.capture()))
+        assert not res2["ok"]
+        assert res2["objectives"][0]["value"] == 1
+
+    def test_capture_is_pure_read(self):
+        eng, r, _c = self._engine([Objective(
+            name="avail", kind="ratio", good="g", total="t", target=0.9)])
+        before = len(eng._snaps)
+        eng.capture()
+        assert len(eng._snaps) == before
+        assert r.get_counter("slo_breach_total") == 0
+
+
+# --------------------------------------------------------------------- #
+# spec: schema validation
+
+
+class TestScenarioSpec:
+    def test_campaign_rule_has_no_probability(self):
+        """Determinism by construction: the schema cannot express a
+        probabilistic campaign."""
+        assert "probability" not in {
+            f.name for f in CampaignRule.__dataclass_fields__.values()}
+
+    def test_load_kind_validated(self):
+        with pytest.raises(ValueError, match="unknown load kind"):
+            LoadSpec(kind="ddos")
+
+    def test_pfb_requires_profile(self):
+        with pytest.raises(ValueError, match="profile"):
+            LoadSpec(kind="pfb")
+
+    def test_action_validated(self):
+        with pytest.raises(ValueError, match="unknown action"):
+            Phase(name="p", duration_s=1.0, enter_actions=("reboot",))
+
+    def test_invariant_validated(self):
+        with pytest.raises(ValueError, match="unknown invariant"):
+            Scenario(name="s", description="", invariants=("vibes",),
+                     phases=(Phase(name="p", duration_s=1.0),))
+
+    def test_duplicate_phase_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            Scenario(name="s", description="", phases=(
+                Phase(name="p", duration_s=1.0),
+                Phase(name="p", duration_s=1.0)))
+
+    def test_empty_phases_rejected(self):
+        with pytest.raises(ValueError, match="at least one phase"):
+            Scenario(name="s", description="", phases=())
+
+    def test_follower_sync_requires_boot(self):
+        with pytest.raises(ValueError, match="follower_boot"):
+            Scenario(name="s", description="", phases=(
+                Phase(name="p", duration_s=1.0,
+                      loads=(LoadSpec(kind="follower_sync"),)),))
+
+
+# --------------------------------------------------------------------- #
+# engine pieces: campaign mapping, verdict arithmetic, ledger fold
+
+
+class TestCampaignMapping:
+    def test_rules_are_phase_scoped(self):
+        sc = Scenario(name="s", description="", phases=(
+            Phase(name="a", duration_s=1.0, campaigns=(
+                CampaignRule(site="rpc.get", kind="error", times=2),)),
+            Phase(name="b", duration_s=1.0, campaigns=(
+                CampaignRule(site="dispatch.run", kind="delay",
+                             after=3, where="x"),)),
+        ))
+        rules = campaign_rules(sc)
+        assert [(r.site, r.kind, r.phase, r.times, r.after, r.where)
+                for r in rules] == [
+            ("rpc.get", "error", "a", 2, 0, None),
+            ("dispatch.run", "delay", "b", 1, 3, "x"),
+        ]
+        assert all(r.probability == 1.0 for r in rules)
+
+
+class TestVerdictContract:
+    def _sc(self, **kw):
+        return Scenario(name="s", description="", phases=(
+            Phase(name="p", duration_s=1.0),), **kw)
+
+    def _whole(self, failing=()):
+        objs = [{"name": n, "ok": n not in failing}
+                for n in ("a", "b", "c")]
+        return {"ok": not failing, "objectives": objs, "window_s": 1.0}
+
+    def test_clean_run_passes(self):
+        v = verdict_mod.assemble(self._sc(), self._whole(), [],
+                                 {"ok": True}, [])
+        assert v["pass"] and v["breaches"] == 0
+
+    def test_unexpected_breach_fails(self):
+        v = verdict_mod.assemble(self._sc(), self._whole(failing={"a"}),
+                                 [], {"ok": False}, [])
+        assert not v["pass"] and v["unexpected_breaches"] == ["a"]
+
+    def test_allowed_breach_passes(self):
+        sc = self._sc(allowed_breaches=frozenset({"a"}))
+        v = verdict_mod.assemble(sc, self._whole(failing={"a"}),
+                                 [], {"ok": False}, [])
+        assert v["pass"]
+
+    def test_missing_required_breach_fails(self):
+        """Detection is an acceptance criterion: the drill failing to
+        surface on the SLO board fails the run."""
+        sc = self._sc(required_breaches=frozenset({"a"}))
+        v = verdict_mod.assemble(sc, self._whole(), [], {"ok": True}, [])
+        assert not v["pass"] and v["missing_required_breaches"] == ["a"]
+
+    def test_required_breach_present_passes(self):
+        sc = self._sc(required_breaches=frozenset({"a"}))
+        v = verdict_mod.assemble(sc, self._whole(failing={"a"}),
+                                 [], {"ok": False}, [])
+        assert v["pass"]
+
+    def test_failed_invariant_fails(self):
+        v = verdict_mod.assemble(
+            self._sc(), self._whole(), [], {"ok": True},
+            [{"name": "dah_byte_identical", "ok": False, "detail": "x"}])
+        assert not v["pass"]
+        assert v["failed_invariants"] == ["dah_byte_identical"]
+
+
+class TestScenarioLedger:
+    def _report(self, breaches=0):
+        return {"scenario": "smoke", "seed": 1,
+                "scenario_slo_pass": breaches == 0,
+                "breaches": breaches, "wall_s": 5.0}
+
+    def test_fold_and_cap(self, tmp_path):
+        path = str(tmp_path / "ledger.json")
+        for i in range(70):
+            append_ledger(path, self._report(breaches=i % 2))
+        doc = json.loads(open(path).read())
+        assert len(doc["runs"]) == 64  # capped
+        assert doc["runs"][-1]["breaches"] in (0, 1)
+        assert {"ts", "scenario", "seed", "pass", "breaches",
+                "wall_s"} <= set(doc["runs"][-1])
+
+    def test_corrupt_ledger_is_replaced(self, tmp_path):
+        path = str(tmp_path / "ledger.json")
+        with open(path, "w") as f:
+            f.write("not json{")
+        append_ledger(path, self._report())
+        doc = json.loads(open(path).read())
+        assert len(doc["runs"]) == 1
+
+    def test_perf_ledger_reads_breach_series(self, tmp_path):
+        from celestia_tpu.tools import perf_ledger
+        path = str(tmp_path / "scenario_ledger.json")
+        for b in (0, 0, 0, 2):
+            append_ledger(path, self._report(breaches=b))
+        led = perf_ledger.load_ledger(str(tmp_path))
+        series = led["scenario_slo_pass"]
+        assert [v for _l, v in series] == [0.0, 0.0, 0.0, 2.0]
+        j = perf_ledger.judge(series, perf_ledger.DEFAULT_THRESHOLD,
+                              perf_ledger.DEFAULT_MIN_HISTORY)
+        assert j["regressed"]  # a breaching run trips the bench gate
+
+
+# --------------------------------------------------------------------- #
+# library: the shipped suites
+
+
+class TestLibrary:
+    def test_shipped_names(self):
+        assert set(SCENARIOS) == {"pfb-storm", "rolling-outage",
+                                  "sdc-under-storm", "rejoin-under-load",
+                                  "smoke"}
+
+    @pytest.mark.parametrize("name", sorted(SCENARIOS))
+    def test_constructs_and_name_matches(self, name):
+        sc = library.get(name)
+        assert sc.name == name and len(sc.phases) >= 3
+
+    def test_sdc_scenarios_require_detection(self):
+        for name in ("sdc-under-storm", "smoke"):
+            sc = library.get(name)
+            assert sc.sdc_producer
+            assert "sdc_detected" in sc.required_breaches
+            assert "zero_undetected_sdc" in sc.invariants
+
+    def test_unknown_scenario_names_options(self):
+        with pytest.raises(KeyError, match="pfb-storm"):
+            library.get("nope")
+
+
+# --------------------------------------------------------------------- #
+# end to end (slow tier; `make scenario-smoke` runs the full gate)
+
+
+@pytest.mark.slow
+class TestSmokeScenarioEndToEnd:
+    def test_same_seed_same_timeline_and_pass(self):
+        from celestia_tpu.scenarios import run_scenario
+        sc = library.get("smoke")
+        r1 = run_scenario(sc, seed=424242)
+        r2 = run_scenario(sc, seed=424242)
+        assert r1["scenario_slo_pass"], r1["verdict"]
+        assert r2["scenario_slo_pass"], r2["verdict"]
+        assert r1["fault_timeline"] == r2["fault_timeline"]
+        assert len(r1["fault_timeline"]) > 0

@@ -1,0 +1,113 @@
+"""Traffic-profile tests (specs/scenarios.md load shapes).
+
+Pins the two contracts the scenario engine builds on: profile sampling
+is a pure function of the caller's numpy Generator (one seed → one
+byte-identical traffic trace), and the shipped profiles produce their
+documented shapes (heavy-tail sizes, Zipf-skewed namespaces). The
+module itself must import without the signing stack — the scenario
+world drives profiles crypto-free."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from celestia_tpu.txsim import PROFILES, TrafficProfile, profile
+
+
+class TestSeedDeterminism:
+    @pytest.mark.parametrize("name", sorted(PROFILES))
+    def test_same_seed_same_trace(self, name):
+        p = profile(name)
+        rng_a, rng_b = np.random.default_rng(7), np.random.default_rng(7)
+        t1 = [p.sample_pfb(rng_a) for _ in range(50)]
+        t2 = [p.sample_pfb(rng_b) for _ in range(50)]
+        assert t1 == t2
+
+    def test_different_seeds_differ(self):
+        p = profile("mixed-namespaces")
+        t1 = [p.sample_pfb(np.random.default_rng(1)) for _ in range(20)]
+        t2 = [p.sample_pfb(np.random.default_rng(2)) for _ in range(20)]
+        assert t1 != t2
+
+    def test_sizes_and_namespaces_deterministic_separately(self):
+        p = profile("huge-rollup")
+        assert (p.sample_sizes(np.random.default_rng(3), 100)
+                == p.sample_sizes(np.random.default_rng(3), 100))
+        assert (p.sample_namespaces(np.random.default_rng(3), 100)
+                == p.sample_namespaces(np.random.default_rng(3), 100))
+
+
+class TestProfileShapes:
+    def test_small_saturation_is_count_pressure(self):
+        p = profile("small-saturation")
+        rng = np.random.default_rng(11)
+        sizes = p.sample_sizes(rng, 2000)
+        assert max(sizes) <= 4_096
+        assert np.median(sizes) < 1_000
+        counts = [len(p.sample_pfb(rng)) for _ in range(200)]
+        assert min(counts) >= 2 and max(counts) <= 8
+
+    def test_huge_rollup_is_byte_pressure(self):
+        p = profile("huge-rollup")
+        sizes = p.sample_sizes(np.random.default_rng(11), 2000)
+        assert np.median(sizes) > 50_000
+        # the Pareto tail dominates the top decile
+        assert np.quantile(sizes, 0.95) > 150_000
+        assert max(sizes) <= 1_900_000
+
+    def test_mixed_has_a_heavy_tail(self):
+        p = profile("mixed-namespaces")
+        sizes = np.array(p.sample_sizes(np.random.default_rng(11), 5000))
+        med, p99 = np.median(sizes), np.quantile(sizes, 0.99)
+        # heavy tail: p99 orders of magnitude above the body median
+        assert p99 > 20 * med
+        assert med < 5_000
+
+    def test_namespace_zipf_skew(self):
+        p = profile("mixed-namespaces")
+        draws = p.sample_namespaces(np.random.default_rng(11), 5000)
+        pool = p.namespace_pool()
+        top = sum(1 for d in draws if d == pool[0])
+        bottom = sum(1 for d in draws if d == pool[-1])
+        # rank-1 namespace dominates rank-16 under skew 1.2
+        assert top > 5 * max(bottom, 1)
+        assert set(draws) <= set(pool)
+
+    def test_namespace_pool_is_identity_not_randomness(self):
+        p = profile("small-saturation")
+        assert p.namespace_pool() == p.namespace_pool()
+        assert len(p.namespace_pool()) == p.namespaces
+        assert all(len(ns) == 10 for ns in p.namespace_pool())
+
+    def test_bounds_respected(self):
+        p = TrafficProfile(name="t", size_median=100, tail_prob=1.0,
+                           tail_scale=10_000_000, size_cap=2_048,
+                           size_min=64)
+        sizes = p.sample_sizes(np.random.default_rng(5), 500)
+        assert min(sizes) >= 64 and max(sizes) <= 2_048
+
+    def test_unknown_profile_names_options(self):
+        with pytest.raises(KeyError, match="small-saturation"):
+            profile("nope")
+
+
+class TestCryptoFreeImport:
+    def test_module_imports_without_signing_stack(self):
+        """The scenario world imports txsim in containers without the
+        `cryptography` package — a module-level crypto import would
+        break every crypto-free scenario run."""
+        code = (
+            "import sys\n"
+            "for mod in ('cryptography', 'celestia_tpu.crypto',"
+            " 'celestia_tpu.tx', 'celestia_tpu.user'):\n"
+            "    sys.modules[mod] = None\n"
+            "import celestia_tpu.txsim as t\n"
+            "import numpy as np\n"
+            "print(len(t.profile('mixed-namespaces')"
+            ".sample_pfb(np.random.default_rng(1))))\n"
+        )
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
