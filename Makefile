@@ -6,7 +6,8 @@ PY ?= python
 .PHONY: test test-all test-slow chaos bench bench-transfers dryrun native \
 	trace-smoke bench-gate obs-smoke sdc-smoke storm-smoke storm-bench \
 	scenario-smoke scenario-pfb-storm scenario-rolling-outage \
-	scenario-sdc-under-storm scenario-rejoin-under-load scenarios
+	scenario-sdc-under-storm scenario-rejoin-under-load scenarios \
+	kernel-smoke bench-fused
 
 # Fast developer loop: the default tier skips the slow multi-process
 # suites (devnet, gRPC, multihost, network, race storms). Two FRESH
@@ -111,6 +112,22 @@ storm-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py --das-storm \
 		--seconds 4 --threads 32 --k 8 --paged-budget 98304 \
 		--require-speedup 2.0 --ledger storm_ledger.json
+
+# Fused-kernel smoke gate (ADR-019): fused extend+hash DAH byte-parity
+# vs the host oracle at k ∈ {32, 64} (production dispatch + the
+# kernels' eager reference math), the committed crossover table picking
+# TPU at the governance-default k=64 on measured numbers with safe
+# degradation off dead backends, and vmappable batched-roots chunking
+# at k=128. CPU-only, crypto-free, <120 s (repeat runs much faster via
+# the persistent XLA compile cache).
+kernel-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/kernel_smoke.py
+
+# The ADR-019 step-change configs alone on the real chip: fused
+# roots-only vs the XLA roots path vs native at k ∈ {64, 32}; writes
+# the fused_ms_per_square_k64 series `make bench-gate` judges.
+bench-fused:
+	$(PY) bench.py --fused-kernels
 
 # Scenario-engine smoke gate (specs/scenarios.md, ADR-018): run the
 # condensed `smoke` scenario twice on one seed, pin an identical fault

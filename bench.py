@@ -380,31 +380,47 @@ def bench_batched_throughput(k: int, batch: int = 8):
 
     # roots-only: no B x EDS output buffers — the replay verifier's path
     # (ops/extend_tpu.batched_roots_device): one vmapped dispatch for
-    # small squares, an async-pipelined queue of the cached single-square
-    # program for large ones (the HBM-bounded spelling)
+    # small squares; large squares pipeline vmappable CHUNKS (pairs) of
+    # the cached chunk program, which bounds the HBM working set at
+    # chunk x single while still amortizing dispatch — the fix for the
+    # round-5 "pipelined-singles" degradation at k=128. chunk == 1 only
+    # survives as a last-resort spelling (batch == 1).
     roots_map_fn = extend_tpu._jitted_batched_roots(k)
     single_fn = extend_tpu._jitted_roots_noeds(k)
-    pipelined = extend_tpu._batch_chunk(k, batch) < batch
+    chunk = extend_tpu._batch_chunk(k, batch)
 
     def fetch_roots(r):
         return _np.asarray(r[0])
 
-    if pipelined:
+    if chunk >= batch:
+        spelling = "vmapped"
+        roots_ms = _slope(
+            lambda i: roots_map_fn(devs[i % 4]), fetch_roots, n1=4, n2=24
+        )
+    elif chunk > 1:
+        spelling = f"pipelined-chunks({chunk})"
+        chunk_fn = extend_tpu._jitted_chunk_roots(k, chunk)
+
+        def dispatch(i):
+            return [
+                chunk_fn(devs[i % 4][g : g + chunk])
+                for g in range(0, batch, chunk)
+            ][-1]
+
+        roots_ms = _slope(dispatch, fetch_roots, n1=4, n2=24)
+    else:
+        spelling = "pipelined-singles"
 
         def dispatch(i):
             return [single_fn(devs[i % 4][j]) for j in range(batch)][-1]
 
         roots_ms = _slope(dispatch, fetch_roots, n1=4, n2=24)
-    else:
-        roots_ms = _slope(
-            lambda i: roots_map_fn(devs[i % 4]), fetch_roots, n1=4, n2=24
-        )
     return {
         "batch": batch,
         "roots_only_ms_per_square": (
             round(roots_ms / batch, 3) if roots_ms > 0 else None
         ),
-        "roots_only_spelling": "pipelined-singles" if pipelined else "vmapped",
+        "roots_only_spelling": spelling,
         "tpu_ms_per_batch": round(per_batch_ms, 3),
         "tpu_ms_per_square": round(per_batch_ms / batch, 3),
     }
@@ -498,6 +514,73 @@ def bench_sha256_kernels(n: int = 65536, length: int = 571):
         "xla_ms": round(xla_ms, 3) if xla_ms > 0 else None,
         "pallas_ms": round(pallas_ms, 3) if pallas_ms > 0 else None,
         "parity": bool(ok),
+    }
+
+
+def bench_fused_kernels(k: int):
+    """Config 12 (ADR-019): the fused Pallas extend+hash ROOTS-ONLY
+    pipeline vs the XLA roots path vs the native-CPU baseline at one k.
+    The fused spelling keeps parity planes + leaf messages in VMEM and
+    returns 90-byte NMT axis roots — HBM never sees the unpacked
+    message tensor — so this is the number that decides the k=64
+    crossover. Parity is gated against the host DAH (byte compare of
+    every row/col root)."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        # Mosaic kernels don't lower on the CPU backend; the eager
+        # reference spelling is covered by tests, not benched
+        return {"skipped": "no TPU device (fused pallas pipeline needs Mosaic)"}
+    from celestia_tpu import da, native
+    from celestia_tpu.ops import extend_tpu, rs_pallas
+
+    if not rs_pallas.fused_supported(k, k * 512):
+        return {"skipped": f"fused kernel unsupported at k={k}"}
+
+    sq = build_square(k)
+    devs = [jax.device_put(build_square(k, seed=100 + i)) for i in range(4)]
+    fused_fn = extend_tpu._jitted_roots_noeds(k, True)
+    xla_fn = extend_tpu._jitted_roots_noeds(k, False)
+
+    def fetch(r):
+        return np.asarray(r[0])
+
+    fused_ms = _slope(lambda i: fused_fn(devs[i % 4]), fetch, n1=4, n2=24)
+    xla_ms = _slope(lambda i: xla_fn(devs[i % 4]), fetch, n1=4, n2=24)
+
+    rows_f, cols_f = (np.asarray(a) for a in fused_fn(jax.device_put(sq)))
+    eds_ref = da.extend_shares(sq.reshape(k * k, 512))
+    dah_ref = da.new_data_availability_header(eds_ref)
+    parity = (
+        [bytes(r) for r in rows_f] == dah_ref.row_roots
+        and [bytes(c) for c in cols_f] == dah_ref.column_roots
+    )
+
+    native_ms = None
+    if native.available():
+        native.extend_and_root_native(sq)  # warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            native.extend_and_root_native(sq)
+            best = min(best, time.perf_counter() - t0)
+        native_ms = best * 1e3
+    return {
+        "square_size": k,
+        "fused_ms_per_square": round(fused_ms, 3) if fused_ms > 0 else None,
+        "xla_roots_ms_per_square": round(xla_ms, 3) if xla_ms > 0 else None,
+        "native_ms_per_square": (
+            round(native_ms, 3) if native_ms is not None else None
+        ),
+        "fused_vs_xla_speedup": (
+            round(xla_ms / fused_ms, 2) if fused_ms > 0 and xla_ms > 0 else None
+        ),
+        "fused_vs_native_speedup": (
+            round(native_ms / fused_ms, 2)
+            if fused_ms > 0 and native_ms is not None
+            else None
+        ),
+        "parity": bool(parity),
     }
 
 
@@ -852,10 +935,21 @@ def bench_codec_service(k: int = 32):
     finally:
         client.close()
         server.stop()
+    # best-of-3 timers on two code paths can invert by scheduler noise,
+    # producing a nonsense NEGATIVE "overhead". Report the signed delta
+    # as-is, but clamp the overhead claim at a noise floor: deltas whose
+    # magnitude is under 5% of the in-process time (or 50 µs absolute)
+    # are indistinguishable from zero on this harness.
+    delta_ms = service_ms - inproc_ms
+    noise_floor_ms = max(0.05, inproc_ms * 0.05)
     return {
         "service_ms": round(service_ms, 3),
         "inprocess_ms": round(inproc_ms, 3),
-        "boundary_overhead_ms": round(service_ms - inproc_ms, 3),
+        "boundary_delta_ms": round(delta_ms, 3),
+        "boundary_overhead_ms": (
+            round(delta_ms, 3) if delta_ms > noise_floor_ms else 0.0
+        ),
+        "noise_floor_ms": round(noise_floor_ms, 3),
         "parity": bool(parity),
     }
 
@@ -1174,6 +1268,10 @@ def main():
     _run_config(configs, prov, cache, "10_sha256_kernels", bench_sha256_kernels)
     _run_config(configs, prov, cache, "11_sliced_sample_k128",
                 bench_sliced_sample, 128)
+    _run_config(configs, prov, cache, "12_fused_kernels_k64",
+                bench_fused_kernels, 64)
+    _run_config(configs, prov, cache, "12b_fused_kernels_k32",
+                bench_fused_kernels, 32)
 
     # a FRESHLY measured parity mismatch is a real correctness failure.
     # Mark the tainted config so _save_cache never merges it, SAVE the
@@ -1740,6 +1838,88 @@ def main_das_storm(seconds: float = 4.0, threads: int = 32, k: int = 8,
         raise SystemExit("das-storm failed: " + "; ".join(failures))
 
 
+def main_fused_kernels():
+    """`python bench.py --fused-kernels`: the ADR-019 step-change
+    configs alone — fused Pallas extend+hash roots-only vs the XLA
+    roots path vs native at k ∈ {64, 32} — with the same probe /
+    cache-replay / incremental-save discipline as main(). The
+    `fused_ms_per_square_k64` series this writes into bench_cache.json
+    rides tools/perf_ledger.py → `make bench-gate`, so a future
+    regression of the step-change fails CI. Exits non-zero on a fresh
+    parity failure or when neither a measurement nor a cached session
+    exists."""
+    from celestia_tpu.ops import enable_compile_cache
+
+    enable_compile_cache()
+    cache = _load_cache()
+    name = "12_fused_kernels_k64"
+    metric = "fused_ms_per_square_k64"
+    reachable, why = _probe_with_retries()
+    if not reachable:
+        cached = ((cache or {}).get("configs") or {}).get(name)
+        if cached is not None:
+            out = {
+                "metric": metric,
+                "value": cached.get("fused_ms_per_square"),
+                "unit": "ms",
+                "vs_baseline": cached.get("fused_vs_xla_speedup"),
+                "configs": {
+                    n: c
+                    for n, c in (cache or {}).get("configs", {}).items()
+                    if n.startswith("12")
+                },
+                "provenance": {
+                    "source": "cached-session",
+                    "measured_at": (cache or {}).get(
+                        "measured_at_per_config", {}
+                    ).get(name) or (cache or {}).get("measured_at"),
+                    "replay_reason": f"accelerator unreachable now: {why}",
+                },
+            }
+            print(json.dumps(out))
+            return
+        print(json.dumps({
+            "metric": metric,
+            "value": None,
+            "unit": "ms",
+            "error": f"accelerator unreachable: {why} — no numbers "
+                     "measured and no session cache",
+        }))
+        sys.exit(1)
+
+    configs: dict = {}
+    prov: dict = {}
+    _run_config(configs, prov, cache, name, bench_fused_kernels, 64)
+    _run_config(configs, prov, cache, "12b_fused_kernels_k32",
+                bench_fused_kernels, 32)
+    head = configs.get(name) or {}
+    headline = {
+        "metric": metric,
+        "value": head.get("fused_ms_per_square"),
+        "unit": "ms",
+        "vs_baseline": head.get("fused_vs_xla_speedup"),
+        "native_baseline_ms": head.get("native_ms_per_square"),
+        "xla_roots_ms": head.get("xla_roots_ms_per_square"),
+        "parity": head.get("parity"),
+    }
+    _save_cache(headline, configs, prov, cache,
+                headline_fresh=prov.get(name) == "measured"
+                and head.get("fused_ms_per_square") is not None)
+    out = dict(headline)
+    out["configs"] = configs
+    if any(v != "measured" for v in prov.values()):
+        out["provenance"] = {
+            "source": "mixed",
+            "per_config": {k: v for k, v in prov.items() if v != "measured"},
+        }
+    print(json.dumps(out))
+    failures = [n for n in configs if prov.get(n) == "parity-failed"]
+    if failures:
+        raise SystemExit(f"fused-path DAH mismatch vs host: {failures}")
+    if prov.get(name) == "failed":
+        sys.exit(1)
+
+
 def main_transfers():
     """`make bench-transfers` / `python bench.py --transfers`: the
     sliced-read and k=64 node-path configs with the fault injector ARMED
@@ -1868,6 +2048,8 @@ if __name__ == "__main__":
             main_das_storm_lite(**_kw)
         elif "--transfers" in sys.argv:
             main_transfers()
+        elif "--fused-kernels" in sys.argv:
+            main_fused_kernels()
         else:
             main()
     finally:

@@ -174,9 +174,14 @@ class App:
         self.sdc_quarantined = False
         self.sdc_events = 0
         self.last_sdc: dict | None = None
-        # measured per-k backend crossover (app/calibration.py); None
-        # means uncalibrated — auto uses the static TPU_MIN_SQUARE gate
-        self.crossover = None
+        # measured per-k backend crossover (app/calibration.py); starts
+        # from the repo-committed default table so `auto` routes on
+        # measured numbers out of the box (ADR-019) — a node-home table
+        # or calibrate_crossover() overrides it, and None (no committed
+        # file) falls back to the static TPU_MIN_SQUARE gate
+        from celestia_tpu.app import calibration
+
+        self.crossover = calibration.load_default_table()
         self.blob_pool = None  # device blob arena (enable_blob_pool)
         # assembled-vs-fallback proposal counts when the arena is on
         self.arena_stats = {"assembled": 0, "fallback": 0}

@@ -103,6 +103,30 @@ def crossover_path(home: str | pathlib.Path) -> pathlib.Path:
     return pathlib.Path(home) / "config" / FILENAME
 
 
+_default_table: "CrossoverTable | None" = None
+_default_loaded = False
+
+
+def load_default_table() -> "CrossoverTable | None":
+    """The repo-committed default table (`<repo>/config/crossover.json`),
+    recalibrated whenever a PR lands a measured step-change (ADR-019).
+
+    Every fresh App attaches this so `auto` routes on measured numbers
+    even before a node-home calibration exists; a home table (cli start)
+    or an explicit `calibrate_crossover()` always overrides it. The
+    committed file carries `measured_at: 0`, which the SLO freshness
+    check treats as never-stale — it is a default, not a live
+    measurement of this host's hardware, and the winner re-check in
+    `resolve_extend_backend` keeps it from routing to absent backends.
+    Loaded once per process; None when the file is absent or corrupt."""
+    global _default_table, _default_loaded
+    if not _default_loaded:
+        repo_root = pathlib.Path(__file__).resolve().parents[2]
+        _default_table = CrossoverTable.load(repo_root / "config" / FILENAME)
+        _default_loaded = True
+    return _default_table
+
+
 def _best_of(fn, repeats: int) -> float:
     """Best-of wall ms after one untimed warmup (absorbs jit compiles /
     library init — the steady-state number is what the node lives on)."""

@@ -211,6 +211,14 @@ class Node:
         # access, and per-page pins keep eviction out of in-flight reads
         self._dah_cache: dict[int, object] = {}
         self._eds_cache = PagedEdsCache()
+        # per-height NMT row-prover memo for the batched sample path
+        # (ADR-019): device-resident squares seed every row's subtree
+        # memo from ONE device reduce (`extend_tpu.eds_row_levels_device`
+        # → `NmtRowProver.from_node_levels`, zero host hashing); host
+        # squares fall back to hash-once host provers that still persist
+        # across batches. Entry: (levels | None, {row: prover}).
+        self._prover_cache: dict[int, tuple] = {}
+        self._PROVER_CACHE_HEIGHTS = 4
         self.home = pathlib.Path(home) if home else None
         if self.home:
             (self.home / "blocks").mkdir(parents=True, exist_ok=True)
@@ -593,7 +601,55 @@ class Node:
             log.info("eds page corrupt; invalidating height",
                      height=height)
             self._eds_cache.invalidate(height)
+            # seeded provers derive from the same (possibly corrupt)
+            # square — drop them with it
+            self._prover_cache.pop(height, None)
             return self._sample_batch(height, coords)
+
+    def _row_provers(self, height: int, eds, rows_needed) -> dict:
+        """Per-height prover memo for `das_sample_docs` (ADR-019).
+
+        First touch of a height with a device-resident square runs ONE
+        jitted NMT reduce over all rows (`eds_row_levels_device`) and
+        keeps the node levels; each referenced row then gets its prover
+        via `NmtRowProver.from_node_levels` — no host hashing at all.
+        Host-resident squares (and any device failure, defensively)
+        return a plain dict that `das_sample_docs` fills with host-built
+        provers, which still persist across batches of the same height."""
+        entry = self._prover_cache.get(height)
+        if entry is None:
+            levels = None
+            try:
+                arr = getattr(eds, "device_data", None)
+                if arr is None and not hasattr(eds, "original_width"):
+                    # raw host array: only worth a device round-trip when
+                    # an accelerator actually backs the jit
+                    import jax
+
+                    if jax.default_backend() not in ("cpu",):
+                        arr = eds
+                if arr is not None:
+                    from celestia_tpu.ops import extend_tpu
+
+                    levels = extend_tpu.eds_row_levels_device(arr)
+            except Exception as exc:  # device trouble must not fail DAS
+                log.info("device prover seeding failed; host fallback",
+                         height=height, error=str(exc))
+                levels = None
+            while len(self._prover_cache) >= self._PROVER_CACHE_HEIGHTS:
+                self._prover_cache.pop(next(iter(self._prover_cache)))
+            entry = (levels, {})
+            self._prover_cache[height] = entry
+        levels, provers = entry
+        if levels is not None:
+            from celestia_tpu.proof import NmtRowProver
+
+            for i in rows_needed:
+                if i not in provers:
+                    provers[i] = NmtRowProver.from_node_levels(
+                        [levels[L][i] for L in range(len(levels))]
+                    )
+        return provers
 
     def _sample_batch(self, height: int, coords) -> list:
         from celestia_tpu.proof import das_sample_docs
@@ -620,7 +676,9 @@ class Node:
                 rows = {i: [bytes(eds[i, c]) for c in range(w)]
                         for i in rows_needed}
             docs = das_sample_docs(rows, [coords[t] for t in valid],
-                                   w // 2)
+                                   w // 2,
+                                   provers=self._row_provers(
+                                       height, eds, rows_needed))
         for t, doc in zip(valid, docs):
             out[t] = doc
         return out

@@ -35,6 +35,7 @@ reference DAH.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -61,9 +62,33 @@ from celestia_tpu.ops import rs_tpu
 # ops/rs_pallas (see its docstring): on this pipeline, fusion beats
 # hand-tiling — both kernels stay as explicitly-invoked, bit-exact
 # alternatives for workloads that feed from HBM anyway.
-from celestia_tpu.ops.sha256_jax import sha256_fixed
+from celestia_tpu.ops.sha256_jax import sha256_fixed, words_to_bytes
 
 _PARITY_NS = np.frombuffer(ns.PARITY_SHARES_NAMESPACE.bytes, dtype=np.uint8)
+
+# Fused Pallas extend+hash (ADR-019): on an accelerator backend the
+# roots pipeline runs ops/rs_pallas.encode2d_hash — parity bytes AND
+# NMT leaf digests leave each kernel invocation together, so neither
+# the unpacked bit planes nor the padded leaf-message tensor ever
+# round-trips through HBM. "0"/"off" pins the XLA spelling (A/B
+# benching, bisection); "1"/"on" forces the kernels even on the CPU
+# backend — device-backend experiments only: Mosaic does not lower on
+# XLA:CPU and the unrolled SHA graph takes minutes to compile there.
+# The decision is frozen into each jit cache entry at first trace.
+_FUSED_ENV = "CELESTIA_FUSED_KERNELS"
+
+
+def _fused_active(k: int) -> bool:
+    from celestia_tpu.ops import rs_pallas
+
+    v = os.environ.get(_FUSED_ENV, "").strip().lower()
+    if v in ("0", "off", "false"):
+        return False
+    if not rs_pallas.fused_supported(k, k * SHARE_SIZE):
+        return False
+    if v in ("1", "on", "true"):
+        return True
+    return jax.default_backend() not in ("cpu",)
 _LEAF_PREFIX = np.array([0], dtype=np.uint8)
 _NODE_PREFIX = np.array([1], dtype=np.uint8)
 NMT_NODE_SIZE = 2 * NAMESPACE_SIZE + 32  # 90
@@ -81,29 +106,49 @@ def nmt_leaf_nodes(leaf_ns: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate([leaf_ns, leaf_ns, digest], axis=-1)
 
 
+def _nmt_reduce_once(nodes: jnp.ndarray) -> jnp.ndarray:
+    """One pairwise NMT level: (..., n, 90) -> (..., n/2, 90)."""
+    parity = jnp.asarray(_PARITY_NS)
+    left = nodes[..., 0::2, :]
+    right = nodes[..., 1::2, :]
+    batch = left.shape[:-1]
+    msg = jnp.concatenate([_bcast_const(_NODE_PREFIX, batch), left, right], axis=-1)
+    digest = sha256_fixed(msg)
+    min_ns = left[..., :NAMESPACE_SIZE]
+    right_is_parity = jnp.all(
+        right[..., :NAMESPACE_SIZE] == parity, axis=-1, keepdims=True
+    )
+    max_ns = jnp.where(
+        right_is_parity,
+        left[..., NAMESPACE_SIZE : 2 * NAMESPACE_SIZE],
+        right[..., NAMESPACE_SIZE : 2 * NAMESPACE_SIZE],
+    )
+    return jnp.concatenate([min_ns, max_ns, digest], axis=-1)
+
+
 def nmt_reduce_axis(nodes: jnp.ndarray) -> jnp.ndarray:
     """Pairwise-reduce (..., n, 90) NMT nodes along axis -2 to roots (..., 90).
 
     n must be a power of two (always true for EDS axes).
     """
-    parity = jnp.asarray(_PARITY_NS)
     while nodes.shape[-2] > 1:
-        left = nodes[..., 0::2, :]
-        right = nodes[..., 1::2, :]
-        batch = left.shape[:-1]
-        msg = jnp.concatenate([_bcast_const(_NODE_PREFIX, batch), left, right], axis=-1)
-        digest = sha256_fixed(msg)
-        min_ns = left[..., :NAMESPACE_SIZE]
-        right_is_parity = jnp.all(
-            right[..., :NAMESPACE_SIZE] == parity, axis=-1, keepdims=True
-        )
-        max_ns = jnp.where(
-            right_is_parity,
-            left[..., NAMESPACE_SIZE : 2 * NAMESPACE_SIZE],
-            right[..., NAMESPACE_SIZE : 2 * NAMESPACE_SIZE],
-        )
-        nodes = jnp.concatenate([min_ns, max_ns, digest], axis=-1)
+        nodes = _nmt_reduce_once(nodes)
     return nodes[..., 0, :]
+
+
+def nmt_reduce_levels(nodes: jnp.ndarray) -> list[jnp.ndarray]:
+    """Like nmt_reduce_axis, but KEEP every tree level: returns
+    [leaves (..., n, 90), (..., n/2, 90), ..., root level (..., 1, 90)].
+
+    Every (lo, hi) range the RFC-6962 split structure visits on a
+    power-of-two tree is one of these aligned nodes, so the level stack
+    is exactly the memo proof.NmtRowProver builds on host — device-
+    computed here once, then served as pure byte lookups (ADR-019)."""
+    levels = [nodes]
+    while nodes.shape[-2] > 1:
+        nodes = _nmt_reduce_once(nodes)
+        levels.append(nodes)
+    return levels
 
 
 def merkle_root_pow2(items: jnp.ndarray) -> jnp.ndarray:
@@ -148,9 +193,73 @@ def nmt_roots_of_eds(eds: jnp.ndarray, leaf_ns: jnp.ndarray):
     return roots[0], roots[1]
 
 
-def _roots_of(shares: jnp.ndarray, m2: jnp.ndarray):
-    """Shared core: (k,k,512) -> (eds, row_roots, col_roots)."""
+def _digest_grid_roots(digest_bytes: jnp.ndarray, leaf_ns: jnp.ndarray):
+    """(2k,2k,32) per-cell leaf digests + (2k,2k,29) namespaces ->
+    (row_roots, col_roots). The digest of cell (r, c) is the same leaf
+    digest in its row tree and its column tree (the namespace rule
+    depends only on the cell), so one grid feeds both reductions —
+    stacked into the same level-synchronous pass as nmt_roots_of_eds."""
+    leaf_nodes = jnp.concatenate([leaf_ns, leaf_ns, digest_bytes], axis=-1)
+    stacked = jnp.stack([leaf_nodes, jnp.swapaxes(leaf_nodes, 0, 1)], axis=0)
+    roots = nmt_reduce_axis(stacked)
+    return roots[0], roots[1]
+
+
+def _roots_of_fused(shares: jnp.ndarray, m2: jnp.ndarray,
+                    interpret: bool = False):
+    """The Pallas spelling of _roots_of (ADR-019): the three quadrant
+    encodes run ops/rs_pallas.encode2d_hash, so every parity cell's NMT
+    leaf digest is computed in VMEM next to the pack stage; Q0 cells go
+    through the companion leaf_digests2d kernel. Only the EDS bytes and
+    the (2k)²·32 B digest grid reach HBM — the unpacked bit planes and
+    the 542-byte leaf messages never do. Quadrant chain and digest
+    orientation follow rs_pallas.extend_square: column extension is the
+    kernel's native layout, row extension transposes in and out (and the
+    digest grids transpose with it)."""
+    from celestia_tpu.ops import rs_pallas
+
     k = shares.shape[0]
+    n = k * SHARE_SIZE
+    x0 = shares.reshape(k, n)
+    q0_ns = shares[..., :NAMESPACE_SIZE]
+    d0 = rs_pallas.leaf_digests2d(
+        x0, rs_pallas.pad_namespaces(q0_ns), interpret
+    )  # (k, k, 8): [row, col]
+    q2f, d2 = rs_pallas.encode2d_hash(x0, m2, interpret)  # native: [row, col]
+    q2 = q2f.reshape(k, k, SHARE_SIZE)
+    x0t = jnp.swapaxes(shares, 0, 1).reshape(k, n)
+    q1t, d1t = rs_pallas.encode2d_hash(x0t, m2, interpret)  # [col, row]
+    q1 = jnp.swapaxes(q1t.reshape(k, k, SHARE_SIZE), 0, 1)
+    q2t = jnp.swapaxes(q2, 0, 1).reshape(k, n)
+    q3t, d3t = rs_pallas.encode2d_hash(q2t, m2, interpret)  # [col, row]
+    q3 = jnp.swapaxes(q3t.reshape(k, k, SHARE_SIZE), 0, 1)
+    eds = jnp.concatenate([
+        jnp.concatenate([shares, q1], axis=1),
+        jnp.concatenate([q2, q3], axis=1),
+    ], axis=0)
+    dig = jnp.concatenate([
+        jnp.concatenate([d0, jnp.swapaxes(d1t, 0, 1)], axis=1),
+        jnp.concatenate([d2, jnp.swapaxes(d3t, 0, 1)], axis=1),
+    ], axis=0)  # (2k, 2k, 8) uint32 words
+    digest_bytes = words_to_bytes(dig)  # (2k, 2k, 32)
+    leaf_ns = _leaf_namespaces(q0_ns, k)
+    row_roots, col_roots = _digest_grid_roots(digest_bytes, leaf_ns)
+    return eds, row_roots, col_roots
+
+
+def _roots_of(shares: jnp.ndarray, m2: jnp.ndarray,
+              fused: bool | None = None):
+    """Shared core: (k,k,512) -> (eds, row_roots, col_roots).
+
+    fused=None resolves via _fused_active (Pallas kernels on an
+    accelerator backend, XLA spelling otherwise); True/False pin a
+    spelling for A/B benching. Byte-identical either way (pinned by
+    tests/test_fused_roots.py)."""
+    k = shares.shape[0]
+    if fused is None:
+        fused = _fused_active(k)
+    if fused:
+        return _roots_of_fused(shares, m2)
     eds = rs_tpu.extend_square(shares, m2)
     leaf_ns = _leaf_namespaces(shares[..., :NAMESPACE_SIZE], k)
     row_roots, col_roots = nmt_roots_of_eds(eds, leaf_ns)
@@ -278,6 +387,76 @@ def eds_roots_device(eds):
                       entry="eds_roots_device"):
         rows, cols = _jitted_eds_roots(k)(jnp.asarray(eds))
         return np.asarray(rows), np.asarray(cols)
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_row_levels(k: int):
+    @jax.jit
+    def run(eds):
+        leaf_ns = _leaf_namespaces(eds[:k, :k, :NAMESPACE_SIZE], k)
+        leaf_nodes = nmt_leaf_nodes(leaf_ns, eds)  # (2k, 2k, 90)
+        return nmt_reduce_levels(leaf_nodes)
+
+    return run
+
+
+def eds_row_levels_device(eds) -> list[np.ndarray]:
+    """EVERY row-tree level of an existing (2k,2k,512) EDS, hashed once
+    on device: [leaf nodes (2k, 2k, 90), (2k, k, 90), ..., roots
+    (2k, 1, 90)] as numpy. levels[L][r, j] is row r's subtree node
+    covering leaves [j·2^L, (j+1)·2^L) — exactly the memo
+    proof.NmtRowProver builds by hashing on host, so
+    NmtRowProver.from_node_levels can serve byte-identical range proofs
+    with ZERO host hashing (ADR-019; the 'device-side proof hashing'
+    depth PR 7 left open). ~2·(2k)²·90 B crosses the interconnect —
+    3 MB at k=64 — instead of the host paying O(w²) SHA per height."""
+    k = int(eds.shape[0]) // 2
+    with tracing.span("extend.nmt_levels", backend="tpu", k=k,
+                      entry="eds_row_levels_device"):
+        levels = _jitted_row_levels(k)(jnp.asarray(eds))
+        return [np.asarray(lv) for lv in levels]
+
+
+def fused_roots_reference(shares: np.ndarray, tile: int | None = None):
+    """Eager CPU spelling of the FUSED pipeline for parity tests:
+    (k,k,512) -> numpy (eds, row_roots, col_roots), running
+    rs_pallas's *_reference tile math (the kernels' exact bodies,
+    executed eagerly — see ops/sha256_pallas.sha256_words on why
+    interpret-mode jit is unusable for the unrolled SHA graph on CPU)
+    plus the same digest-grid NMT reduce the device program runs.
+    `tile` (rs_pallas reference tile override) trades eager dispatch
+    count for op width — byte-identical output either way."""
+    from celestia_tpu.ops import rs_pallas
+
+    k = int(shares.shape[0])
+    n = k * SHARE_SIZE
+    m2 = rs_tpu.encode_bit_matrix(k)
+    x0 = np.asarray(shares, dtype=np.uint8).reshape(k, n)
+    q0_ns = np.asarray(shares)[..., :NAMESPACE_SIZE]
+    ns_pad = np.asarray(rs_pallas.pad_namespaces(jnp.asarray(q0_ns)))
+    d0 = rs_pallas.leaf_digests2d_reference(x0, ns_pad, tile)
+    q2f, d2 = rs_pallas.encode2d_hash_reference(x0, m2, tile)
+    q2 = q2f.reshape(k, k, SHARE_SIZE)
+    x0t = np.swapaxes(shares, 0, 1).reshape(k, n)
+    q1t, d1t = rs_pallas.encode2d_hash_reference(x0t, m2, tile)
+    q1 = np.swapaxes(q1t.reshape(k, k, SHARE_SIZE), 0, 1)
+    q2t = np.swapaxes(q2, 0, 1).reshape(k, n)
+    q3t, d3t = rs_pallas.encode2d_hash_reference(q2t, m2, tile)
+    q3 = np.swapaxes(q3t.reshape(k, k, SHARE_SIZE), 0, 1)
+    eds = np.concatenate([
+        np.concatenate([np.asarray(shares), q1], axis=1),
+        np.concatenate([q2, q3], axis=1),
+    ], axis=0)
+    dig = np.concatenate([
+        np.concatenate([d0, np.swapaxes(d1t, 0, 1)], axis=1),
+        np.concatenate([d2, np.swapaxes(d3t, 0, 1)], axis=1),
+    ], axis=0)
+    digest_bytes = np.asarray(words_to_bytes(jnp.asarray(dig)))
+    leaf_ns = np.asarray(_leaf_namespaces(jnp.asarray(q0_ns), k))
+    rows, cols = jax.jit(_digest_grid_roots)(
+        jnp.asarray(digest_bytes), jnp.asarray(leaf_ns)
+    )
+    return eds, np.asarray(rows), np.asarray(cols)
 
 
 # ------------------------------------------------------------------ #
@@ -490,13 +669,14 @@ def extend_and_root_batched(shares: jnp.ndarray, m2: jnp.ndarray):
     return jax.vmap(lambda s: extend_and_root(s, m2))(shares)
 
 
-def _rows_cols_only(shares: jnp.ndarray, m2: jnp.ndarray):
+def _rows_cols_only(shares: jnp.ndarray, m2: jnp.ndarray,
+                    fused: bool | None = None):
     """The ONE roots-only core: (k,k,512) -> (row_roots, col_roots)
     with no EDS in the outputs — the EDS stays an XLA intermediate.
     Every roots-only spelling (single, batched, their jit caches)
     derives from this function so root computation cannot diverge
     between the replay verifier and the proposer path."""
-    _eds, rows, cols = _roots_of(shares, m2)
+    _eds, rows, cols = _roots_of(shares, m2, fused=fused)
     return rows, cols
 
 
@@ -504,14 +684,19 @@ def _batch_chunk(k: int, b: int) -> int:
     """Concurrency width for a batched roots dispatch.
 
     Small squares vmap the whole batch (dispatch amortization wins);
-    large squares bound the HBM working set by mapping sequentially over
-    the batch inside ONE program — a k=128 square's fused extend+hash
-    intermediates already saturate HBM bandwidth, so lanes-across-squares
-    buys nothing and the B× working set evicts everything (bench 7b
-    round 3: vmapped k=128 = 7.99 ms/square vs 5.03 single). Returns the
-    largest divisor of b not exceeding the per-size cap so reshape is
-    exact."""
-    cap = b if k <= 64 else 1
+    large squares bound the HBM working set — a k=128 square's fused
+    extend+hash intermediates already saturate HBM bandwidth, so
+    lanes-across-the-whole-batch buys nothing and the B× working set
+    evicts everything (bench 7b round 3: vmapped k=128 = 7.99 ms/square
+    vs 5.03 single). The large-k cap is 2, not 1: pairing squares keeps
+    the working set bounded at 2× a single square while doubling the
+    lanes through the latency-bound NMT tree-top levels and halving the
+    dispatch count — the vmappable middle ground between the regressing
+    full vmap and the round-5 "pipelined-singles" fallback (bench 7b
+    reports the spelling in use; the perf ledger gates the wall).
+    Returns the largest divisor of b not exceeding the per-size cap so
+    the group reshape is exact."""
+    cap = b if k <= 64 else 2
     chunk = min(cap, b)
     while b % chunk:
         chunk -= 1
@@ -552,10 +737,22 @@ def _jitted_batched_roots(k: int):
     return jax.jit(lambda shares: roots_only_batched(shares, m2))
 
 
-@functools.lru_cache(maxsize=8)
-def _jitted_roots_noeds(k: int):
+@functools.lru_cache(maxsize=16)
+def _jitted_chunk_roots(k: int, chunk: int):
+    """vmapped roots over a FIXED chunk of squares — the unit the
+    large-k pipelined dispatch queues (see batched_roots_device)."""
     m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
-    return jax.jit(lambda shares: _rows_cols_only(shares, m2))
+    return jax.jit(jax.vmap(lambda s: _rows_cols_only(s, m2)))
+
+
+@functools.lru_cache(maxsize=16)
+def _jitted_roots_noeds(k: int, fused: bool | None = None):
+    """fused=None (the default every production caller uses) freezes
+    the _fused_active decision into this cache entry at first trace;
+    True/False build explicitly-pinned spellings for A/B benching
+    (bench.py --fused-kernels)."""
+    m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
+    return jax.jit(lambda shares: _rows_cols_only(shares, m2, fused=fused))
 
 
 def roots_device(shares: np.ndarray):
@@ -579,21 +776,43 @@ def batched_roots_device(shares):
     (row_roots, col_roots), jit-cached per square size.
 
     Small squares ride ONE vmapped dispatch (amortizes dispatch
-    overhead); large squares dispatch the cached single-square program
-    per item — JAX's async dispatch pipelines the queue, so wall time
-    matches the single-dispatch ms/square (bench 7b), while the vmapped
-    k=128 spelling pays HBM-working-set and gather overheads. Accepting
-    a list means the large-k branch never builds the contiguous B×8 MB
-    stacked copy it would immediately re-slice. Both branches are the
-    same `_rows_cols_only` core, so results cannot diverge."""
+    overhead); large squares dispatch vmapped CHUNKS of
+    _batch_chunk(k, b) squares through an async-pipelined queue — the
+    working set stays bounded at chunk× a single square's (the full-vmap
+    k=128 spelling paid HBM-working-set and gather overheads, bench 7b
+    round 3) while the dispatch count drops chunk-fold vs the old
+    per-square queue. Accepting a list means the large-k branch never
+    builds the contiguous B×8 MB stacked copy — only chunk squares are
+    stacked at a time. Every branch is the same `_rows_cols_only` core,
+    so results cannot diverge."""
     b = len(shares)
     k = int(shares[0].shape[0])
     with tracing.span("extend.device", backend="tpu", k=k, batch=b,
                       entry="batched_roots_device"):
-        if _batch_chunk(k, b) >= b:
+        chunk = _batch_chunk(k, b)
+        if chunk >= b:
             stacked = shares if isinstance(shares, np.ndarray) else np.stack(shares)
             rows, cols = _jitted_batched_roots(k)(jnp.asarray(stacked))
             return np.asarray(rows), np.asarray(cols)
+        if chunk > 1:
+            fn = _jitted_chunk_roots(k, chunk)
+            full = b - b % chunk
+            outs = [
+                fn(jnp.asarray(np.stack([
+                    np.asarray(shares[g + j]) for j in range(chunk)
+                ])))
+                for g in range(0, full, chunk)
+            ]  # async queue of vmapped chunks
+            rows = [np.asarray(r) for r, _c in outs]
+            cols = [np.asarray(c) for _r, c in outs]
+            if full < b:
+                # ragged tail rides the single-square program (already
+                # jit-cached) rather than compiling a one-off chunk shape
+                single = _jitted_roots_noeds(k)
+                rest = [single(jnp.asarray(shares[i])) for i in range(full, b)]
+                rows.append(np.stack([np.asarray(r) for r, _c in rest]))
+                cols.append(np.stack([np.asarray(c) for _r, c in rest]))
+            return np.concatenate(rows), np.concatenate(cols)
         fn = _jitted_roots_noeds(k)
         outs = [fn(jnp.asarray(shares[i])) for i in range(b)]  # async queue
         return (

@@ -218,6 +218,30 @@ class NmtRowProver:
         if self.tree_size:
             build(0, self.tree_size)
 
+    @classmethod
+    def from_node_levels(cls, levels: list) -> "NmtRowProver":
+        """Seed the memo from device-computed subtree nodes (ADR-019).
+
+        `levels[L]` holds the 90-byte NMT nodes of every aligned span of
+        width 2**L, leaves first, root level last — exactly the shape
+        `extend_tpu.eds_row_levels_device` returns per row. For a
+        power-of-two tree the RFC 6962 split point is always half, so
+        the aligned spans ARE the memo keys `__init__` would build; the
+        prover constructed here serves byte-identical proofs with zero
+        host hashing."""
+        n = len(levels[0])
+        if n & (n - 1):
+            raise ValueError(f"levels seeding requires pow2 leaves, got {n}")
+        if len(levels[-1]) != 1 or len(levels) != n.bit_length():
+            raise ValueError("levels do not form a complete binary tree")
+        prover = cls([])
+        prover.tree_size = n
+        for level, nodes in enumerate(levels):
+            span = 1 << level
+            for j, node in enumerate(nodes):
+                prover._roots[(j * span, (j + 1) * span)] = bytes(node)
+        return prover
+
     def root(self) -> bytes:
         if not self.tree_size:
             raise ValueError("empty tree has no root here")
@@ -252,6 +276,7 @@ def das_sample_docs(
     rows_cells: dict[int, list[bytes]],
     coords: list[tuple[int, int]],
     k_orig: int,
+    provers: dict[int, NmtRowProver] | None = None,
 ) -> list[dict]:
     """Build the `/sample` response documents for a batch of (row, col)
     coordinates sharing one height: one NmtRowProver per distinct row
@@ -259,8 +284,12 @@ def das_sample_docs(
     shape — and every proof byte — matches the unbatched route exactly.
 
     `rows_cells` maps each referenced row index to its full extended row
-    (2k cells of raw bytes); coords are assumed validated in-range."""
-    provers: dict[int, NmtRowProver] = {}
+    (2k cells of raw bytes); coords are assumed validated in-range.
+    `provers` optionally supplies pre-seeded per-row provers (e.g. from
+    device-computed levels, ADR-019); rows missing from it are built on
+    host as before, and newly built provers are added back for reuse."""
+    if provers is None:
+        provers = {}
     docs: list[dict] = []
     for i, j in coords:
         prover = provers.get(i)

@@ -133,7 +133,9 @@ class TestChunkedBatchedRoots:
     def test_chunk_selection(self):
         assert extend_tpu._batch_chunk(32, 8) == 8  # small: full vmap
         assert extend_tpu._batch_chunk(64, 8) == 8
-        assert extend_tpu._batch_chunk(128, 8) == 1  # large: sequential map
+        # large: vmapped pairs, not singles (BENCH 7b / ADR-019) — HBM
+        # working set bounded at 2x a single square, dispatches halved
+        assert extend_tpu._batch_chunk(128, 8) == 2
         assert extend_tpu._batch_chunk(128, 1) == 1
 
     @pytest.mark.parametrize(

@@ -324,6 +324,9 @@ class TestExtendBackend:
         from celestia_tpu import native
 
         app = App(extend_backend="auto")
+        # fresh Apps carry the repo-committed default table (ADR-019);
+        # detach it here to pin the STATIC-gate fallback rules
+        app.crossover = None
         # accelerator present: device above the crossover, native below
         monkeypatch.setattr(app_mod, "_accel_probe", True)
         monkeypatch.setattr(native, "available", lambda: True)

@@ -334,11 +334,25 @@ class TestAutoResolveFollowsCalibration:
     def test_uncalibrated_keeps_static_gate(self, monkeypatch):
         app = self._app(monkeypatch, accel=True, native_ok=True)
         from celestia_tpu.app import app as app_mod
-        assert app.crossover is None
+        # a fresh App attaches the repo-committed default table
+        # (ADR-019); uncalibrated means detaching it explicitly
+        app.crossover = None
         assert app.resolve_extend_backend(app_mod.TPU_MIN_SQUARE) == "tpu"
         assert (
             app.resolve_extend_backend(app_mod.TPU_MIN_SQUARE // 2) == "native"
         )
+
+    def test_fresh_app_carries_committed_default(self, monkeypatch):
+        # ADR-019: `auto` routes on measured numbers out of the box —
+        # the committed config/crossover.json picks TPU at the
+        # governance-default k=64, and availability re-checking keeps
+        # the same table safe on hosts without the hardware
+        app = self._app(monkeypatch, accel=True, native_ok=True)
+        assert app.crossover is not None
+        assert app.crossover.winner(64) == "tpu"
+        assert app.resolve_extend_backend(64) == "tpu"
+        cpu_app = self._app(monkeypatch, accel=False, native_ok=False)
+        assert cpu_app.resolve_extend_backend(64) == "numpy"
 
 
 class TestArenaSemispace:
