@@ -7,7 +7,20 @@ PY ?= python
 	trace-smoke bench-gate obs-smoke sdc-smoke storm-smoke storm-bench \
 	scenario-smoke scenario-pfb-storm scenario-rolling-outage \
 	scenario-sdc-under-storm scenario-rejoin-under-load scenarios \
-	kernel-smoke bench-fused
+	kernel-smoke bench-fused analyze
+
+# Static analysis gate (specs/analysis.md, ADR-020): AST-level
+# concurrency lint (lock ordering vs the specs/serving.md partial
+# order, locks held across device transfers, torn reads),
+# consensus-determinism lint over the DAH-critical modules, and
+# registry-drift lint (fault sites / metrics / spans / SLO objectives
+# vs their specs). Crypto-free, accelerator-free, stdlib-only —
+# imports nothing from the package under analysis; seconds. Fails
+# only on NEW findings (config/lint_baseline.json + inline
+# `# lint: allow(...)` waivers, every one with a written reason).
+analyze:
+	JAX_PLATFORMS=cpu $(PY) -m celestia_tpu.tools.analysis \
+		--json lint_report.json
 
 # Fast developer loop: the default tier skips the slow multi-process
 # suites (devnet, gRPC, multihost, network, race storms). Two FRESH
@@ -21,7 +34,8 @@ JIT_A = tests/test_extend_tpu.py tests/test_nmt_semantics.py \
 JIT_B = tests/test_device_resident.py tests/test_blob_pool.py \
 	tests/test_parallel.py tests/test_graft_entry.py
 JIT_HEAVY = $(JIT_A) $(JIT_B)
-test:
+# analyze first: the static gate costs ~3 s and fails fast on lint
+test: analyze
 	$(PY) -m pytest $(JIT_HEAVY) -q
 	$(PY) -m pytest tests/ -q $(addprefix --ignore=,$(JIT_HEAVY))
 

@@ -325,6 +325,7 @@ class _ByteBudgetLRU:
         # lock-free read: dict.get is GIL-atomic, and eviction is FIFO
         # (no move_to_end) precisely so hits never mutate shared state —
         # the parse cache sits on the per-tx hot path
+        # lint: allow(C005) reason=dict.get is GIL-atomic and values are immutable parses; a racing eviction yields a miss, never a torn value
         return self._data.get(key)
 
     def put(self, key, val, raw_len: int) -> None:

@@ -173,10 +173,15 @@ class ExtendedDataSquare:
         """One cell. Device-resident squares transfer 512 bytes (or ride
         an already-fetched sliced row/col), never the full square."""
         if self._data is None and self._device is not None:
-            row_hit = self._slice_cache.get(("row", r))
+            # both probes under the lock: a concurrent FIFO eviction in
+            # _sliced_axis/rows_batch mutates the dict mid-read (the
+            # torn-read celestia-lint C005 pins; see ADR-016 regression
+            # note). The 512-byte transfer below stays unlocked.
+            with self._slice_lock:
+                row_hit = self._slice_cache.get(("row", r))
+                col_hit = self._slice_cache.get(("col", c))
             if row_hit is not None:
                 return row_hit[c]
-            col_hit = self._slice_cache.get(("col", c))
             if col_hit is not None:
                 return col_hit[r]
             from celestia_tpu.ops import transfers

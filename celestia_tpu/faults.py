@@ -208,7 +208,8 @@ class FaultInjector:
 
     @property
     def phase(self) -> str | None:
-        return self._phase
+        with self._lock:  # RLock: cheap, and set_phase races the reader
+            return self._phase
 
     def on_fire(self, site: str, **ctx):
         """Consult the rules for one boundary crossing. Returns a

@@ -155,12 +155,18 @@ class DeviceDispatcher:
 
     @property
     def depth(self) -> int:
-        """Admitted-but-not-yet-run external jobs."""
-        return len(self._queue)
+        """Admitted-but-not-yet-run external jobs. Read under `_cv`
+        (it wraps an RLock, so locked internal paths may re-enter):
+        `_take_mates_locked` REBINDS `_queue` to a fresh deque
+        mid-gather, so an unlocked `len` could count a stale snapshot
+        (celestia-lint C005)."""
+        with self._cv:
+            return len(self._queue)
 
     @property
     def draining(self) -> bool:
-        return self._draining
+        with self._cv:
+            return self._draining
 
     @property
     def alive(self) -> bool:
@@ -252,7 +258,7 @@ class DeviceDispatcher:
         self.metrics.incr_counter("rpc_dispatch_total")
         faults.fire("dispatch.enqueue", label=label)
         if not self.alive:
-            if self._draining:
+            if self.draining:
                 self._shed("draining")
             self.metrics.incr_counter("rpc_dispatch_admitted_total")
             if batch_key is not None:

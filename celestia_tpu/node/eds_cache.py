@@ -551,7 +551,11 @@ class PagedEdsCache:
             host = flip(host)
         if integrity.crc32c(host) != page.crc:
             integrity.record_sdc("cache.faultin")
-            self.stats_counters["page_corrupt"] += 1
+            # _fault_in runs outside _cond by design (the transfer must
+            # not serialize readers); the shared counter hop back under
+            # it — a bare += here loses increments (celestia-lint C005)
+            with self._cond:
+                self.stats_counters["page_corrupt"] += 1
             self._count("eds_cache_page_corrupt_total")
             err = integrity.IntegrityError(
                 f"page checksum mismatch on fault-in "

@@ -92,7 +92,8 @@ class _InflightTracker:
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._cv:
+            return self._count
 
     def wait_idle(self, timeout: float) -> bool:
         end = time.monotonic() + timeout

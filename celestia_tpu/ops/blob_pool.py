@@ -154,13 +154,21 @@ class DeviceBlobArena:
         Idempotent; flips to the other half when the active one is full
         (transfer cache semantics — see class docstring)."""
         key = blob_key(data)
+        pad = _pad_len(len(data))
         with self._lock:
             if key in self._offsets:
                 return key
-            pad = _pad_len(len(data))
             if pad > self._half:
                 return key  # oversized: never resident, always fallback
-            dev = self._stage_chunk(data)
+        # stage with the lock RELEASED: device_put_chunked dispatches
+        # per-chunk DMA, and holding _lock across it stalls every
+        # proposal-path offset_of() behind one upload (celestia-lint
+        # C002). Staging is idempotent, so the re-check below simply
+        # drops a duplicate upload if a racer landed the same key.
+        dev = self._stage_chunk(data)
+        with self._lock:
+            if key in self._offsets:
+                return key
             offset = self._alloc_locked(pad)
             self._arena = _jitted_insert(pad)(self._arena, dev, offset)
             self._offsets[key] = (offset, len(data))
@@ -176,22 +184,28 @@ class DeviceBlobArena:
         sequential put() calls. Allocator/flip/dedup semantics are
         identical to put(); returns the content keys in input order."""
         with self._lock:
-            staged: list[tuple[bytes, bytes, object | None]] = []
+            plan: list[tuple[bytes, bytes, bool]] = []
             seen: set[bytes] = set()
             for data in datas:
                 key = blob_key(data)
-                if (
+                stage = not (
                     key in self._offsets
                     or key in seen
                     or _pad_len(len(data)) > self._half
-                ):
-                    staged.append((key, data, None))  # resident/oversized
-                    continue
-                seen.add(key)
-                staged.append((key, data, self._stage_chunk(data)))
+                )  # False: resident/oversized/dup-in-batch
+                if stage:
+                    seen.add(key)
+                plan.append((key, data, stage))
+        # all DMAs dispatched with the lock released (same C002 fix as
+        # put(); staging is idempotent and re-checked before insert)
+        staged = [
+            (key, data, self._stage_chunk(data) if stage else None)
+            for key, data, stage in plan
+        ]
+        with self._lock:
             keys = []
             for key, data, dev in staged:
-                if dev is not None:
+                if dev is not None and key not in self._offsets:
                     pad = _pad_len(len(data))
                     offset = self._alloc_locked(pad)
                     self._arena = _jitted_insert(pad)(self._arena, dev, offset)
@@ -242,6 +256,7 @@ class DeviceBlobArena:
     @property
     def arena(self):
         """The device buffer (pass to the assembly program)."""
+        # lint: allow(C005) reason=single atomic reference read; proposal assembly pairs it with offset_of() under the lock and tolerates one-generation-stale arenas
         return self._arena
 
     def resident_bytes(self) -> int:

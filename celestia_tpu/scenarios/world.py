@@ -347,6 +347,7 @@ class ScenarioWorld:
             # H2D staging rides the checksummed chunked transfer (the
             # transfer.chunk SDC site); a single flip heals via the one
             # checksum retry, a sticky flip raises
+            # lint: allow(C002) reason=_produce_lock exists to serialize whole-block production in the test world, device work included; no serving path ever waits on it
             transfers.device_put_chunked(grid.reshape(-1),
                                          site="scenario.stage", chunks=2)
             _eds, rows, cols = extend_tpu.extend_roots_device(grid)

@@ -94,6 +94,7 @@ class StateStore:
         self._smt_lock = threading.Lock()
 
     def get(self, key: bytes) -> bytes | None:
+        # lint: allow(C005) reason=handler-thread reads are lock-free by design; dict.get is GIL-atomic and values are immutable bytes, _smt_lock guards SMT mutation only
         return self._data.get(key)
 
     def _set_locked(self, key: bytes, value: bytes) -> None:
@@ -177,6 +178,7 @@ class StateStore:
         """Advance one version and return the deterministic app hash."""
         self.version += 1
         self.commit_hash_refresh()
+        # lint: allow(C005) reason=commit runs only on the single block-production thread; handler threads read app_hashes for finalized versions that never change
         return self.app_hashes[self.version]
 
     # --- checkpoint / resume ---

@@ -1,1 +1,29 @@
-"""Operator tools (reference: tools/)."""
+"""Operator tools (reference: tools/).
+
+Submodule re-exports are LAZY (PEP 562, same shape as the `app`
+package): `tools.perf_ledger` pulls numpy for its median/MAD math and
+`tools.blocktime` pulls urllib, but `tools.analysis` (celestia-lint,
+`make analyze`) is pure-stdlib AST and must import in a stripped
+environment without dragging either in.
+"""
+
+_EXPORTS = {
+    "analysis": ("celestia_tpu.tools.analysis", None),
+    "blocktime": ("celestia_tpu.tools.blocktime", None),
+    "perf_ledger": ("celestia_tpu.tools.perf_ledger", None),
+}
+
+
+def __getattr__(name: str):
+    try:
+        module, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+
+    mod = importlib.import_module(module)
+    return mod if attr is None else getattr(mod, attr)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,84 @@
+"""celestia-lint: AST-based concurrency / determinism / registry-drift
+analyzer (specs/analysis.md, ADR-020).
+
+Run as `make analyze` or `python -m celestia_tpu.tools.analysis`.
+Stdlib-only, never imports the modules it checks — safe without
+cryptography, JAX, or a device, and finishes in seconds.
+
+    from celestia_tpu.tools.analysis import run_analysis
+    report = run_analysis(pathlib.Path("."))
+    report.new_findings   # what would fail the gate
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+
+from celestia_tpu.tools.analysis import (
+    concurrency, determinism, registry,
+)
+from celestia_tpu.tools.analysis.core import (  # noqa: F401 — public API
+    BaselineError, Finding, Project, RULES, apply_baseline,
+    apply_waivers, collect_waivers, load_baseline, load_project,
+)
+
+__all__ = ["Finding", "Project", "Report", "RULES", "BaselineError",
+           "load_project", "run_analysis"]
+
+
+@dataclasses.dataclass
+class Report:
+    all_findings: list[Finding]      # before waivers/baseline
+    new_findings: list[Finding]      # what fails the gate
+    waived: int
+    baselined: int
+
+    def to_dict(self) -> dict:
+        by_rule: dict[str, int] = {}
+        for f in self.new_findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        return {
+            "schema": "celestia-lint/1",
+            "total_findings": len(self.all_findings),
+            "new_findings": [f.to_dict() for f in self.new_findings],
+            "new_by_rule": dict(sorted(by_rule.items())),
+            "waived": self.waived,
+            "baselined": self.baselined,
+        }
+
+
+def run_analysis(root: pathlib.Path | str,
+                 baseline_path: pathlib.Path | str | None = None,
+                 package: str = "celestia_tpu",
+                 specs: str = "specs",
+                 tests: str = "tests") -> Report:
+    """All four passes over `root`, waivers and baseline applied.
+    Raises BaselineError when the baseline file itself is invalid."""
+    project = load_project(pathlib.Path(root), package=package,
+                           specs=specs, tests=tests)
+    findings: list[Finding] = []
+    findings.extend(concurrency.run_pass(project))
+    findings.extend(determinism.run_pass(project))
+    findings.extend(registry.run_pass(project))
+
+    waivers = []
+    for mod in project.modules + project.test_files:
+        ws, bad = collect_waivers(mod)
+        waivers.extend(ws)
+        findings.extend(bad)  # S001: waiver without reason
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    after_waivers = apply_waivers(findings, waivers)
+    entries = []
+    if baseline_path is not None:
+        p = pathlib.Path(baseline_path)
+        if p.exists():
+            entries = load_baseline(p)
+    new = apply_baseline(after_waivers, entries)
+    return Report(
+        all_findings=findings,
+        new_findings=new,
+        waived=len(findings) - len(after_waivers),
+        baselined=len(after_waivers) - len(new),
+    )

@@ -1,0 +1,70 @@
+"""CLI for celestia-lint: `python -m celestia_tpu.tools.analysis`.
+
+Exit codes: 0 clean (no NEW findings), 1 new findings or an invalid
+baseline/waiver, 2 usage error. `--json` writes the machine-readable
+report (the perf-ledger-style trend artifact `make analyze` keeps)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+from celestia_tpu.tools.analysis import BaselineError, RULES, run_analysis
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="celestia-lint",
+        description="AST concurrency/determinism/registry-drift lint "
+                    "(specs/analysis.md)")
+    ap.add_argument("--root", default=".",
+                    help="repo root (default: cwd)")
+    ap.add_argument("--baseline", default="config/lint_baseline.json",
+                    help="committed baseline; pass '' to disable")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="write the machine-readable report here")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, text in sorted(RULES.items()):
+            print(f"  {rule}  {text}")
+        return 0
+
+    root = pathlib.Path(args.root)
+    baseline = args.baseline or None
+    if baseline is not None:
+        baseline = root / baseline
+    t0 = time.monotonic()
+    try:
+        report = run_analysis(root, baseline_path=baseline)
+    except BaselineError as e:
+        print(f"celestia-lint: BASELINE INVALID: {e}", file=sys.stderr)
+        return 1
+    elapsed = time.monotonic() - t0
+
+    if args.json_out:
+        doc = report.to_dict()
+        doc["elapsed_s"] = round(elapsed, 3)
+        pathlib.Path(args.json_out).write_text(
+            json.dumps(doc, indent=2, sort_keys=True) + "\n",
+            encoding="utf-8")
+
+    for f in report.new_findings:
+        print(f.render())
+    suffix = (f"({len(report.all_findings)} raw, {report.waived} waived, "
+              f"{report.baselined} baselined, {elapsed:.1f}s)")
+    if report.new_findings:
+        print(f"celestia-lint: {len(report.new_findings)} new finding(s) "
+              f"{suffix}", file=sys.stderr)
+        return 1
+    print(f"celestia-lint: clean {suffix}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

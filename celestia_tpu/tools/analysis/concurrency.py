@@ -1,0 +1,540 @@
+"""Concurrency lint (rules C001-C005, specs/analysis.md).
+
+Pure-AST reasoning about the package's `threading` usage:
+
+  C001  lock-order inversion — every `with <lock>` nesting contributes
+        an edge to a global acquisition graph; an edge observed in both
+        directions, or one that runs AGAINST the partial order declared
+        in specs/serving.md (`## Lock ordering`), is a deadlock seed.
+  C002  lock held across a device transfer or blocking call (the slice
+        caches learned this the hard way — transfers run unlocked with
+        fence flags, ADR-017).
+  C003  lock held across `faults.fire` — a `delay` fault rule would
+        turn injected latency into lock convoy.
+  C004  `Condition.wait` outside a `while` predicate loop (lost-wakeup
+        / spurious-wakeup hazard). `Event.wait` is exempt.
+  C005  a field mutated under the class's lock but ALSO read outside
+        it (the dispatcher `depth` tear, the da slice-cache tear).
+        Aggregated one finding per (class, field).
+
+Lock identity is a token "module.attr": `self._cv` in node/dispatch.py
+is `dispatch._cv`; a foreign acquisition like devnet's
+`with self.node._lock` resolves to `node._lock`. Methods reachable ONLY
+from call sites holding lock L (the `_locked` helper convention, e.g.
+`_apply_block_locked`) are analyzed with L pre-held — a fixpoint over
+the intra-class call graph, so the rules neither miss races inside
+helpers nor flag helper bodies that in fact always run locked.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+from celestia_tpu.tools.analysis.core import (
+    Finding, Module, Project, dotted,
+)
+
+_LOCK_CTORS = {"Lock": "lock", "RLock": "lock", "Condition": "cond",
+               "Semaphore": "lock", "BoundedSemaphore": "lock",
+               "Event": "event"}
+
+# calls that move bytes over the interconnect or block the thread —
+# never while holding a lock (C002)
+_TRANSFER_TAILS = {
+    "device_put", "device_get", "device_put_chunked", "device_get_chunked",
+    "eds_rows_batch", "eds_row", "eds_col", "eds_share",
+    "block_until_ready", "copy_to_host_async",
+}
+_BLOCKING = {"time.sleep", "socket.accept", "socket.recv", "urlopen"}
+
+# write entry points of the process-global telemetry/tracing singletons;
+# each briefly takes that module's internal lock, so a call while holding
+# another lock contributes a C001 edge to the graph (they must stay
+# LEAVES of the declared order)
+_TELEMETRY_METHODS = {"incr_counter", "set_gauge", "observe", "measure",
+                      "measure_since"}
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "add",
+             "remove", "discard", "pop", "popleft", "popitem", "clear",
+             "insert", "update", "setdefault", "sort"}
+
+
+@dataclasses.dataclass
+class LockInfo:
+    token: str     # "module.attr"
+    kind: str      # lock | cond | event
+    attr: str
+
+
+@dataclasses.dataclass
+class _Edge:
+    outer: str
+    inner: str
+    relpath: str
+    line: int
+    symbol: str
+
+
+def declared_order(project: Project) -> dict[str, int]:
+    """Parse the `## Lock ordering` section of specs/serving.md into
+    token -> rank (lower = acquired first). Tokens on the same arrow
+    segment (separated by `/`) share a rank."""
+    text = project.spec_files.get("specs/serving.md", "")
+    ranks: dict[str, int] = {}
+    in_section = False
+    for line in text.splitlines():
+        if re.match(r"^#+\s", line):
+            in_section = bool(re.search(r"lock ordering", line, re.I))
+            continue
+        if not in_section:
+            continue
+        if "→" in line or "->" in line:
+            segments = re.split(r"→|->", line)
+            for rank, seg in enumerate(segments):
+                for tok in re.findall(r"`([\w.]+)`", seg):
+                    ranks.setdefault(tok, rank)
+    return ranks
+
+
+def _collect_locks(project: Project) -> tuple[dict, dict]:
+    """-> (per-relpath {class or None: {attr: LockInfo}},
+           global attr -> set of owning module names). Keyed by relpath
+    because short module names collide (node/__init__.py vs
+    node/node.py are both "node"); tokens keep the short name."""
+    by_module: dict[str, dict] = {}
+    attr_owners: dict[str, set[str]] = {}
+    for mod in project.modules:
+        classes: dict = {}
+        for node in ast.walk(mod.tree):
+            owner_cls = None
+            if isinstance(node, ast.ClassDef):
+                owner_cls = node.name
+                body = ast.walk(node)
+            elif node is mod.tree:
+                body = ast.iter_child_nodes(node)
+            else:
+                continue
+            for sub in body:
+                if not isinstance(sub, ast.Assign):
+                    continue
+                kind = _ctor_kind(sub.value)
+                if kind is None:
+                    continue
+                for tgt in sub.targets:
+                    attr = None
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        attr = tgt.attr
+                    elif owner_cls is None and isinstance(tgt, ast.Name):
+                        attr = tgt.id
+                    if attr is None:
+                        continue
+                    info = LockInfo(f"{mod.name}.{attr}", kind, attr)
+                    classes.setdefault(owner_cls, {})[attr] = info
+                    attr_owners.setdefault(attr, set()).add(mod.name)
+        by_module[mod.relpath] = classes
+    return by_module, attr_owners
+
+
+def _ctor_kind(value: ast.AST) -> str | None:
+    if not isinstance(value, ast.Call):
+        return None
+    name = dotted(value.func) or ""
+    tail = name.rsplit(".", 1)[-1]
+    return _LOCK_CTORS.get(tail)
+
+
+class _FuncScan:
+    """One walk over a function body tracking the held-lock stack."""
+
+    def __init__(self, analyzer: "ConcurrencyPass", mod: Module,
+                 cls: str | None, func: ast.AST, symbol: str,
+                 base_held: tuple[str, ...], record: bool):
+        self.a = analyzer
+        self.mod = mod
+        self.cls = cls
+        self.symbol = symbol
+        self.record = record   # False on pass 1 (call-site collection)
+        self.local_conds: set[str] = set()
+        for sub in ast.walk(func):
+            if isinstance(sub, ast.Assign) and _ctor_kind(sub.value) == "cond":
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.local_conds.add(tgt.id)
+        body = getattr(func, "body", [])
+        self.visit_block(body, base_held, 0)
+
+    # -- token resolution ------------------------------------------------
+
+    def lock_token(self, expr: ast.AST) -> LockInfo | None:
+        name = dotted(expr)
+        if name is None:
+            return None
+        parts = name.split(".")
+        attr = parts[-1]
+        if len(parts) == 1:
+            # bare name: module-level lock or function-local Condition
+            if attr in self.local_conds:
+                return LockInfo(f"{self.mod.name}.{attr}", "cond", attr)
+            info = (self.a.locks.get(self.mod.relpath, {})
+                    .get(None, {}).get(attr))
+            return info
+        base = parts[-2]
+        if base == "self" and len(parts) == 2:
+            info = (self.a.locks.get(self.mod.relpath, {})
+                    .get(self.cls, {}).get(attr))
+            if info is not None:
+                return info
+            # self.<attr> not declared in this class (mixin/other init)
+            if attr in self.a.attr_owners:
+                return LockInfo(f"{self.mod.name}.{attr}",
+                                self.a.kind_of(attr), attr)
+            return None
+        # foreign chain (self.node._lock, job.lock): if exactly one
+        # module declares a lock under this attr name, it IS that lock
+        owners = self.a.attr_owners.get(attr, set())
+        if len(owners) == 1:
+            return LockInfo(f"{next(iter(owners))}.{attr}",
+                            self.a.kind_of(attr), attr)
+        if owners:
+            return LockInfo(f"{base}.{attr}", self.a.kind_of(attr), attr)
+        return None
+
+    # -- traversal -------------------------------------------------------
+
+    def visit_block(self, stmts: list, held: tuple[str, ...],
+                    while_depth: int) -> None:
+        for stmt in stmts:
+            self.visit_stmt(stmt, held, while_depth)
+
+    def visit_stmt(self, stmt: ast.AST, held: tuple[str, ...],
+                   while_depth: int) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs run later, on their own stack
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in stmt.items:
+                self.scan_expr(item.context_expr, inner, while_depth)
+                info = self.lock_token(item.context_expr)
+                if info is not None and info.kind != "event":
+                    if self.record:
+                        for h in inner:
+                            if h != info.token:
+                                self.a.edges.append(_Edge(
+                                    h, info.token, self.mod.relpath,
+                                    stmt.lineno, self.symbol))
+                    inner = inner + (info.token,)
+            self.visit_block(stmt.body, inner, while_depth)
+            return
+        if isinstance(stmt, ast.While):
+            self.scan_expr(stmt.test, held, while_depth)
+            self.visit_block(stmt.body, held, while_depth + 1)
+            self.visit_block(stmt.orelse, held, while_depth + 1)
+            return
+        # generic: scan this statement's expressions, then child blocks
+        # (except handlers are ast.excepthandler, not ast.stmt — recurse
+        # into their bodies explicitly or C-rules go blind in `except`)
+        for field, value in ast.iter_fields(stmt):
+            if isinstance(value, list) and value \
+                    and isinstance(value[0], ast.stmt):
+                self.visit_block(value, held, while_depth)
+            elif isinstance(value, ast.expr):
+                self.scan_expr(value, held, while_depth)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.expr):
+                        self.scan_expr(v, held, while_depth)
+                    elif isinstance(v, ast.excepthandler):
+                        if v.type is not None:
+                            self.scan_expr(v.type, held, while_depth)
+                        self.visit_block(v.body, held, while_depth)
+        # assignment targets double as mutations for C005
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.Delete)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target] if isinstance(stmt, ast.AugAssign)
+                       else stmt.targets)
+            for tgt in targets:
+                self.note_target_mutation(tgt, held, stmt.lineno)
+
+    def note_target_mutation(self, tgt: ast.AST, held, line: int) -> None:
+        # self.X = ..., self.X[...] = ..., del self.X[...]
+        node = tgt
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                self.note_target_mutation(elt, held, line)
+            return
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            self.a.note_access(self.mod, self.cls, node.attr, held,
+                               line, self.symbol, mutation=True,
+                               record=self.record)
+
+    def scan_expr(self, expr: ast.AST, held: tuple[str, ...],
+                  while_depth: int) -> None:
+        for node in self.walk_expr(expr):
+            if isinstance(node, ast.Call):
+                self.scan_call(node, held, while_depth)
+            elif (isinstance(node, ast.Attribute)
+                  and isinstance(node.ctx, ast.Load)
+                  and isinstance(node.value, ast.Name)
+                  and node.value.id == "self"):
+                self.a.note_access(self.mod, self.cls, node.attr, held,
+                                   node.lineno, self.symbol,
+                                   mutation=False, record=self.record)
+
+    @staticmethod
+    def walk_expr(expr: ast.AST):
+        # ast.walk minus Lambda bodies (deferred execution)
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.Lambda):
+                    continue
+                stack.append(child)
+
+    def scan_call(self, call: ast.Call, held: tuple[str, ...],
+                  while_depth: int) -> None:
+        name = dotted(call.func) or ""
+        tail = name.rsplit(".", 1)[-1]
+        # intra-class call sites feed the locked-helper fixpoint
+        if (self.cls is not None and isinstance(call.func, ast.Attribute)
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id == "self"):
+            self.a.note_call_site(self.mod.name, self.cls, self.symbol,
+                                  tail, held)
+        # C005 mutation via container method: self.X.append(...)
+        if (tail in _MUTATORS and isinstance(call.func, ast.Attribute)):
+            base = call.func.value
+            if (isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"):
+                self.a.note_access(self.mod, self.cls, base.attr, held,
+                                   call.lineno, self.symbol,
+                                   mutation=True, record=self.record)
+        if not self.record:
+            return
+        # C004: Condition.wait must sit inside a while predicate loop
+        if tail == "wait" and isinstance(call.func, ast.Attribute):
+            info = self.lock_token(call.func.value)
+            if info is not None and info.kind == "cond" \
+                    and while_depth == 0:
+                self.a.findings.append(Finding(
+                    rule="C004", path=self.mod.relpath, line=call.lineno,
+                    symbol=self.symbol, match=info.token,
+                    message=f"{info.token}.wait() outside a while "
+                            "predicate loop — spurious wakeup / lost "
+                            "notify hazard",
+                ))
+            if info is not None:
+                return  # cond.wait releases the lock; not C002
+        if not held:
+            return
+        # C002: transfers / blocking calls under a lock
+        if tail in _TRANSFER_TAILS or name in _BLOCKING:
+            self.a.findings.append(Finding(
+                rule="C002", path=self.mod.relpath, line=call.lineno,
+                symbol=self.symbol, match=f"{held[-1]}:{tail}",
+                message=f"{tail}() called while holding {held[-1]} — "
+                        "run transfers/blocking work unlocked (fence "
+                        "with a busy flag instead)",
+            ))
+        # C003: fault sites under a lock
+        if tail == "fire" and (name.startswith("faults.")
+                               or name == "fire"):
+            self.a.findings.append(Finding(
+                rule="C003", path=self.mod.relpath, line=call.lineno,
+                symbol=self.symbol, match=f"{held[-1]}:fire",
+                message=f"faults.fire() while holding {held[-1]} — an "
+                        "injected delay would convoy every waiter",
+            ))
+        # implied leaf-lock edges for the C001 graph
+        base_name = name.rsplit(".", 2)
+        if tail in _TELEMETRY_METHODS and ("metrics" in base_name[0]
+                                           or "metrics" in name):
+            for h in held:
+                self.a.edges.append(_Edge(h, "telemetry._lock",
+                                          self.mod.relpath, call.lineno,
+                                          self.symbol))
+        if name in ("tracing.span", "tracing.emit"):
+            for h in held:
+                self.a.edges.append(_Edge(h, "tracing._lock",
+                                          self.mod.relpath, call.lineno,
+                                          self.symbol))
+
+
+class ConcurrencyPass:
+    def __init__(self, project: Project):
+        self.project = project
+        self.locks, self.attr_owners = _collect_locks(project)
+        self._kinds: dict[str, str] = {}
+        for classes in self.locks.values():
+            for attrs in classes.values():
+                for info in attrs.values():
+                    # prefer cond over lock when modules disagree
+                    prev = self._kinds.get(info.attr)
+                    if prev is None or info.kind == "cond":
+                        self._kinds[info.attr] = info.kind
+        self.edges: list[_Edge] = []
+        self.findings: list[Finding] = []
+        # (module, class, callee) -> list of held tuples at call sites,
+        # tagged with the calling method name
+        self.call_sites: dict[tuple, list[tuple[str, tuple]]] = {}
+        # (module, class, attr) -> {"mut": [(held, line, sym)],
+        #                           "read": [(held, line, sym)]}
+        self.accesses: dict[tuple, dict[str, list]] = {}
+
+    def kind_of(self, attr: str) -> str:
+        return self._kinds.get(attr, "lock")
+
+    def note_call_site(self, modname: str, cls: str, caller_sym: str,
+                       callee: str, held: tuple) -> None:
+        caller = caller_sym.rsplit(".", 1)[-1]
+        self.call_sites.setdefault((modname, cls, callee), []).append(
+            (caller, held))
+
+    def note_access(self, mod: Module, cls: str | None, attr: str,
+                    held: tuple, line: int, symbol: str,
+                    mutation: bool, record: bool) -> None:
+        if cls is None or not record:
+            return
+        method = symbol.rsplit(".", 1)[-1]
+        if method == "__init__":
+            return  # construction is single-threaded
+        kind = "mut" if mutation else "read"
+        self.accesses.setdefault((mod.relpath, mod.name, cls, attr),
+                                 {"mut": [], "read": []})[kind].append(
+            (held, line, symbol))
+
+    # -- locked-helper fixpoint ----------------------------------------- #
+
+    def _base_held(self, mod: Module) -> dict[tuple[str, str], tuple]:
+        """(class, method) -> locks held at EVERY call site (the
+        `_locked` helper convention), from a pass-1 scan."""
+        methods: dict[tuple[str, str], ast.AST] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        methods[(node.name, sub.name)] = sub
+        # pass 1: collect call sites with lexically-held locks only
+        self.call_sites.clear()
+        for (cls, name), func in methods.items():
+            _FuncScan(self, mod, cls, func, f"{cls}.{name}", (), False)
+        base: dict[tuple[str, str], tuple] = {}
+        TOP = None  # unknown = "all locks"
+        for (cls, name) in methods:
+            has_sites = (mod.name, cls, name) in self.call_sites
+            if name.startswith("_") and not name.startswith("__") \
+                    and has_sites:
+                base[(cls, name)] = TOP
+            else:
+                base[(cls, name)] = ()
+        for _ in range(len(methods) + 1):
+            changed = False
+            for (cls, name), cur in base.items():
+                if cur == ():
+                    continue
+                sets = []
+                for caller, held in self.call_sites.get(
+                        (mod.name, cls, name), []):
+                    caller_base = base.get((cls, caller), ())
+                    if caller_base is TOP:
+                        continue  # unknown caller contributes nothing yet
+                    sets.append(set(held) | set(caller_base))
+                if not sets:
+                    continue
+                new = sets[0]
+                for s in sets[1:]:
+                    new &= s
+                new_t = tuple(sorted(new))
+                if cur is TOP or set(cur) != new:
+                    base[(cls, name)] = new_t
+                    changed = True
+            if not changed:
+                break
+        return {k: (v if v is not TOP else ()) for k, v in base.items()}
+
+    # -- driver ---------------------------------------------------------- #
+
+    def run(self) -> list[Finding]:
+        for mod in self.project.modules:
+            base = self._base_held(mod)
+            self.call_sites.clear()
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            _FuncScan(self, mod, node.name, sub,
+                                      f"{node.name}.{sub.name}",
+                                      base.get((node.name, sub.name), ()),
+                                      True)
+            for node in mod.tree.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    _FuncScan(self, mod, None, node, node.name, (), True)
+        self._check_order()
+        self._check_unguarded()
+        return self.findings
+
+    def _check_order(self) -> None:
+        ranks = declared_order(self.project)
+        seen: dict[tuple[str, str], _Edge] = {}
+        for e in self.edges:
+            seen.setdefault((e.outer, e.inner), e)
+        reported: set[frozenset] = set()
+        for (a, b), e in seen.items():
+            rev = seen.get((b, a))
+            pair = frozenset((a, b))
+            if rev is not None and pair not in reported:
+                reported.add(pair)
+                self.findings.append(Finding(
+                    rule="C001", path=e.relpath, line=e.line,
+                    symbol=e.symbol, match=f"{a}<->{b}",
+                    message=f"lock-order inversion: {a} -> {b} here but "
+                            f"{b} -> {a} at {rev.relpath}:{rev.line} "
+                            f"({rev.symbol}) — deadlock seed",
+                ))
+            ra, rb = ranks.get(a), ranks.get(b)
+            if ra is not None and rb is not None and ra > rb:
+                self.findings.append(Finding(
+                    rule="C001", path=e.relpath, line=e.line,
+                    symbol=e.symbol, match=f"{a}->{b}",
+                    message=f"acquisition {a} -> {b} runs against the "
+                            "declared partial order in specs/serving.md "
+                            "(## Lock ordering)",
+                ))
+
+    def _check_unguarded(self) -> None:
+        for (relpath, modname, cls, attr), acc in sorted(
+                self.accesses.items()):
+            guards = {t for held, _l, _s in acc["mut"] for t in held
+                      if t.startswith(f"{modname}.")}
+            if not guards:
+                continue
+            unlocked_reads = sorted({(line, sym) for held, line, sym
+                                     in acc["read"] + acc["mut"]
+                                     if not guards & set(held)})
+            if not unlocked_reads:
+                continue
+            line, sym = unlocked_reads[0]
+            self.findings.append(Finding(
+                rule="C005", path=relpath, line=line,
+                symbol=f"{cls}", match=attr,
+                message=f"{cls}.{attr} is mutated under "
+                        f"{'/'.join(sorted(guards))} but accessed "
+                        f"without it at {len(unlocked_reads)} site(s) "
+                        f"(first: {sym}) — torn-read hazard",
+            ))
+
+
+def run_pass(project: Project) -> list[Finding]:
+    return ConcurrencyPass(project).run()

@@ -1,0 +1,282 @@
+"""celestia-lint core: source loading, findings, waivers, baseline.
+
+The analyzer is deliberately dependency-free and import-free: it parses
+the package with `ast` and NEVER imports the modules it checks, so
+`make analyze` runs in seconds without cryptography, JAX, or a device
+(specs/analysis.md). Everything downstream of this module — the
+concurrency, determinism, and registry passes — consumes the
+`Project` view built here and returns `Finding`s; this module owns the
+two suppression channels that keep the gate green-by-default:
+
+    inline waivers   `# lint: allow(RULE[,RULE]) reason=...` on the
+                     finding's line or the line directly above it
+    baseline         `config/lint_baseline.json` — committed, reviewed
+                     findings that predate the gate; matched by stable
+                     fingerprint (rule, path, symbol, match), never by
+                     line number, so unrelated edits don't invalidate it
+
+Both channels REQUIRE a reason string: a waiver without one is itself a
+finding (S001), a baseline entry without one fails the run outright.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+import re
+
+# rule catalog — specs/analysis.md is the prose version; keep in sync
+RULES = {
+    "C001": "lock-order-inversion (against the declared partial order "
+            "or a cycle in the observed acquisition graph)",
+    "C002": "lock held across a device transfer / blocking call",
+    "C003": "lock held across a fault-site call (faults.fire)",
+    "C004": "Condition.wait outside a while predicate loop",
+    "C005": "lock-guarded field also read outside the lock",
+    "D101": "unordered set iteration in a DAH-critical module",
+    "D102": "wall-clock / RNG call in a DAH-critical module",
+    "D103": "float dtype in a byte-level encoding path",
+    "D104": "host/device drift hazard inside a jitted function",
+    "R201": "fault-site registry drift (code vs spec vs coverage test)",
+    "R202": "telemetry metric written but undocumented in specs",
+    "R203": "tracing span emitted but undocumented in specs",
+    "R204": "SLO objective references a metric nothing writes",
+    "S001": "lint waiver without a reason string",
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    symbol: str        # enclosing qualname ("Class.method", "<module>")
+    match: str         # stable short token for baseline matching
+    message: str
+
+    def fingerprint(self) -> tuple[str, str, str, str]:
+        # line-number-free on purpose: baselines survive unrelated edits
+        return (self.rule, self.path, self.symbol, self.match)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} [{self.symbol}] "
+                f"{self.message}")
+
+
+@dataclasses.dataclass
+class Module:
+    path: pathlib.Path
+    relpath: str       # forward-slash, relative to project root
+    name: str          # short module name ("dispatch", "da", ...)
+    tree: ast.Module
+    lines: list[str]
+
+
+@dataclasses.dataclass
+class Project:
+    root: pathlib.Path
+    modules: list[Module]
+    spec_files: dict[str, str]    # relpath -> text (specs/*.md)
+    test_files: list[Module]      # parsed tests/*.py
+
+    def module(self, name: str) -> Module | None:
+        for m in self.modules:
+            if m.name == name:
+                return m
+        return None
+
+
+def _short_name(relpath: str) -> str:
+    parts = relpath.split("/")
+    stem = parts[-1][:-3]  # drop .py
+    if stem == "__init__" and len(parts) >= 2:
+        return parts[-2]
+    return stem
+
+
+def _parse_file(root: pathlib.Path, path: pathlib.Path) -> Module | None:
+    rel = path.relative_to(root).as_posix()
+    try:
+        text = path.read_text(encoding="utf-8")
+        tree = ast.parse(text, filename=rel)
+    except (SyntaxError, UnicodeDecodeError, OSError):
+        return None
+    return Module(path=path, relpath=rel, name=_short_name(rel),
+                  tree=tree, lines=text.splitlines())
+
+
+def load_project(root: pathlib.Path, package: str = "celestia_tpu",
+                 specs: str = "specs", tests: str = "tests") -> Project:
+    root = pathlib.Path(root)
+    modules: list[Module] = []
+    pkg_dir = root / package
+    if pkg_dir.is_dir():
+        for path in sorted(pkg_dir.rglob("*.py")):
+            m = _parse_file(root, path)
+            if m is not None:
+                modules.append(m)
+    spec_files: dict[str, str] = {}
+    specs_dir = root / specs
+    if specs_dir.is_dir():
+        for path in sorted(specs_dir.glob("*.md")):
+            try:
+                spec_files[path.relative_to(root).as_posix()] = \
+                    path.read_text(encoding="utf-8")
+            except (UnicodeDecodeError, OSError):
+                pass
+    test_files: list[Module] = []
+    tests_dir = root / tests
+    if tests_dir.is_dir():
+        for path in sorted(tests_dir.glob("*.py")):
+            m = _parse_file(root, path)
+            if m is not None:
+                test_files.append(m)
+    return Project(root=root, modules=modules, spec_files=spec_files,
+                   test_files=test_files)
+
+
+# --- inline waivers ---------------------------------------------------- #
+
+_WAIVER_RE = re.compile(
+    r"#\s*lint:\s*allow\(([A-Z]\d{3}(?:\s*,\s*[A-Z]\d{3})*)\)"
+    r"(?:\s+reason=(.*))?$"
+)
+
+
+@dataclasses.dataclass
+class Waiver:
+    relpath: str
+    line: int          # 1-based line the comment sits on
+    rules: frozenset[str]
+    reason: str
+
+
+def collect_waivers(module: Module) -> tuple[list[Waiver], list[Finding]]:
+    """All `# lint: allow(...)` comments in one module, plus S001
+    findings for waivers missing a reason."""
+    waivers: list[Waiver] = []
+    bad: list[Finding] = []
+    for i, line in enumerate(module.lines, start=1):
+        m = _WAIVER_RE.search(line)
+        if not m:
+            continue
+        rules = frozenset(r.strip() for r in m.group(1).split(","))
+        reason = (m.group(2) or "").strip()
+        if not reason:
+            bad.append(Finding(
+                rule="S001", path=module.relpath, line=i,
+                symbol="<module>", match=",".join(sorted(rules)),
+                message="waiver carries no reason= — every suppression "
+                        "must say why",
+            ))
+            continue
+        waivers.append(Waiver(module.relpath, i, rules, reason))
+    return waivers, bad
+
+
+def apply_waivers(findings: list[Finding],
+                  waivers: list[Waiver]) -> list[Finding]:
+    """A waiver covers findings of its rules on ITS line or the line
+    directly below it (comment-above style)."""
+    index: dict[tuple[str, int], list[Waiver]] = {}
+    for w in waivers:
+        index.setdefault((w.relpath, w.line), []).append(w)
+        index.setdefault((w.relpath, w.line + 1), []).append(w)
+    kept = []
+    for f in findings:
+        covered = any(f.rule in w.rules
+                      for w in index.get((f.path, f.line), []))
+        if not covered:
+            kept.append(f)
+    return kept
+
+
+# --- baseline ---------------------------------------------------------- #
+
+class BaselineError(ValueError):
+    """The committed baseline itself is invalid (e.g. an entry without
+    a reason) — the run fails regardless of findings."""
+
+
+def load_baseline(path: pathlib.Path) -> list[dict]:
+    data = json.loads(path.read_text(encoding="utf-8"))
+    entries = data.get("entries", [])
+    for e in entries:
+        for key in ("rule", "path", "symbol", "match", "reason"):
+            if not str(e.get(key, "")).strip():
+                raise BaselineError(
+                    f"baseline entry {e!r} is missing {key!r} — every "
+                    "baselined finding needs a written reason"
+                )
+    return entries
+
+
+def apply_baseline(findings: list[Finding],
+                   entries: list[dict]) -> list[Finding]:
+    known = {(e["rule"], e["path"], e["symbol"], e["match"])
+             for e in entries}
+    return [f for f in findings if f.fingerprint() not in known]
+
+
+# --- shared AST helpers ------------------------------------------------ #
+
+def qualname_map(tree: ast.Module) -> dict[ast.AST, str]:
+    """node -> enclosing qualname for every function/class def."""
+    out: dict[ast.AST, str] = {}
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                out[child] = q
+                visit(child, q)
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def enclosing_symbol(tree: ast.Module, target: ast.AST) -> str:
+    """Qualname of the innermost def/class containing `target`."""
+    best = "<module>"
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        nonlocal best
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                if _contains(child, target):
+                    best = q
+                    visit(child, q)
+                    return
+            visit(child, prefix)
+
+    visit(tree, "")
+    return best
+
+
+def _contains(node: ast.AST, target: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if sub is target:
+            return True
+    return False
+
+
+def dotted(expr: ast.AST) -> str | None:
+    """`a.b.c` -> "a.b.c"; None for anything not a pure name chain."""
+    parts: list[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None

@@ -1,0 +1,229 @@
+"""Consensus-determinism lint (rules D101-D104, specs/analysis.md).
+
+The DAH must come out byte-identical on every path — TPU, host, fused
+kernel — and across every node (specs/da.md). These rules flag the
+statically-visible ways that invariant breaks, scoped to the modules
+whose output feeds the DAH:
+
+  D101  iterating a `set` (unordered) where order can leak into
+        encoded/hashed bytes; `sorted(...)` wrapping is the fix
+  D102  wall-clock (`time.time`, `datetime.now`) or RNG calls — block
+        content must be a pure function of its inputs
+        (`time.monotonic`/`perf_counter` are telemetry-only and exempt)
+  D103  float dtypes in byte-level encoding code — float accumulation
+        rounds differently across backends; shares are integer bytes
+  D104  host/device drift inside jitted functions: `np.*` applied to a
+        traced parameter silently falls back to host semantics, and a
+        Python `if` on a non-static parameter burns the branch into the
+        compiled program for every subsequent call
+"""
+
+from __future__ import annotations
+
+import ast
+
+from celestia_tpu.tools.analysis.core import (
+    Finding, Module, Project, dotted, enclosing_symbol,
+)
+
+# module short-names whose bytes feed the DataAvailabilityHeader
+DAH_MODULES = {"shares", "square", "da", "proof", "extend_tpu",
+               "rs_pallas"}
+
+_WALLCLOCK = {"time.time", "time.time_ns", "datetime.now",
+              "datetime.utcnow", "datetime.datetime.now"}
+_RNG_PREFIXES = ("random.", "np.random.", "numpy.random.",
+                 "jax.random.", "secrets.")
+_RNG_BARE = {"urandom", "getrandbits", "randbytes"}
+_FLOAT_DTYPES = {"float16", "float32", "float64", "bfloat16", "float"}
+
+
+def _is_dah_module(mod: Module) -> bool:
+    return mod.name in DAH_MODULES
+
+
+def _jit_static_names(func: ast.AST) -> tuple[bool, set[str]]:
+    """(is_jitted, static arg names) from @jax.jit / @partial(jax.jit,
+    static_argnames=...) / @functools.partial(jit, ...) decorators."""
+    static: set[str] = set()
+    jitted = False
+    for dec in getattr(func, "decorator_list", []):
+        call = dec if isinstance(dec, ast.Call) else None
+        name = dotted(call.func if call else dec) or ""
+        tail = name.rsplit(".", 1)[-1]
+        inner = ""
+        if tail == "partial" and call is not None and call.args:
+            inner = dotted(call.args[0]) or ""
+        if tail == "jit" or inner.rsplit(".", 1)[-1] == "jit":
+            jitted = True
+            if call is not None:
+                for kw in call.keywords:
+                    if kw.arg in ("static_argnames", "static_argnums"):
+                        for sub in ast.walk(kw.value):
+                            if isinstance(sub, ast.Constant) \
+                                    and isinstance(sub.value, str):
+                                static.add(sub.value)
+    return jitted, static
+
+
+def _set_like(expr: ast.AST, local_sets: set[str]) -> bool:
+    if isinstance(expr, ast.Set):
+        return True
+    if isinstance(expr, ast.Call):
+        name = dotted(expr.func) or ""
+        if name == "set" or name.endswith(".union") \
+                or name.endswith(".intersection") \
+                or name.endswith(".difference"):
+            return True
+    if isinstance(expr, ast.Name) and expr.id in local_sets:
+        return True
+    if isinstance(expr, ast.Attribute) and expr.attr in local_sets:
+        return True
+    return False
+
+
+def run_pass(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in project.modules:
+        if not _is_dah_module(mod):
+            continue
+        findings.extend(_scan_module(mod))
+    return findings
+
+
+def _scan_module(mod: Module) -> list[Finding]:
+    findings: list[Finding] = []
+
+    # names assigned from set() / set literals, per module (coarse but
+    # effective: DAH modules barely use sets at all)
+    local_sets: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and _set_like(node.value, set()):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    local_sets.add(tgt.id)
+                elif isinstance(tgt, ast.Attribute):
+                    local_sets.add(tgt.attr)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and _set_like(node.value, set()):
+            if isinstance(node.target, ast.Name):
+                local_sets.add(node.target.id)
+
+    for node in ast.walk(mod.tree):
+        # D101: for-loop or comprehension over an unordered set
+        iters: list[ast.AST] = []
+        if isinstance(node, ast.For):
+            iters = [node.iter]
+        elif isinstance(node, (ast.ListComp, ast.SetComp,
+                               ast.GeneratorExp, ast.DictComp)):
+            iters = [gen.iter for gen in node.generators]
+        for it in iters:
+            if _set_like(it, local_sets):
+                findings.append(Finding(
+                    rule="D101", path=mod.relpath, line=node.lineno,
+                    symbol=enclosing_symbol(mod.tree, node),
+                    match="set-iteration",
+                    message="iteration over an unordered set in a "
+                            "DAH-critical module — wrap in sorted() so "
+                            "byte output cannot depend on hash order",
+                ))
+
+        if isinstance(node, ast.Call):
+            name = dotted(node.func) or ""
+            tail = name.rsplit(".", 1)[-1]
+            if name in _WALLCLOCK or tail in _RNG_BARE \
+                    or any(name.startswith(p) for p in _RNG_PREFIXES):
+                findings.append(Finding(
+                    rule="D102", path=mod.relpath, line=node.lineno,
+                    symbol=enclosing_symbol(mod.tree, node),
+                    match=name or tail,
+                    message=f"{name or tail}() in a DAH-critical module "
+                            "— consensus bytes must not depend on clock "
+                            "or randomness",
+                ))
+            # D103: .astype(float) / dtype=float in encoding code
+            if tail == "astype" and node.args:
+                dt = _dtype_name(node.args[0])
+                if dt in _FLOAT_DTYPES:
+                    findings.append(_d103(mod, node, dt))
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    dt = _dtype_name(kw.value)
+                    if dt in _FLOAT_DTYPES:
+                        findings.append(_d103(mod, node, dt))
+
+        # D104: hazards inside jitted functions
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            jitted, static = _jit_static_names(node)
+            if not jitted:
+                continue
+            params = {a.arg for a in node.args.args
+                      + node.args.posonlyargs + node.args.kwonlyargs}
+            traced = params - static - {"self"}
+            findings.extend(_scan_jitted(mod, node, traced))
+    return findings
+
+
+def _d103(mod: Module, node: ast.Call, dt: str) -> Finding:
+    return Finding(
+        rule="D103", path=mod.relpath, line=node.lineno,
+        symbol=enclosing_symbol(mod.tree, node), match=dt,
+        message=f"float dtype {dt!r} in a byte-level encoding module — "
+                "GF(256) share math is integer-exact; float "
+                "accumulation rounds differently across backends",
+    )
+
+
+def _scan_jitted(mod: Module, func: ast.AST,
+                 traced: set[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    symbol = enclosing_symbol(mod.tree, func)
+    if symbol == "<module>":
+        symbol = func.name
+    for node in ast.walk(func):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not func:
+            continue
+        # np.* forced onto a traced value -> silent host fallback
+        if isinstance(node, ast.Call):
+            name = dotted(node.func) or ""
+            if name.startswith(("np.", "numpy.")):
+                for arg in node.args:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name) and sub.id in traced:
+                            findings.append(Finding(
+                                rule="D104", path=mod.relpath,
+                                line=node.lineno, symbol=symbol,
+                                match=f"np:{sub.id}",
+                                message=f"{name}() applied to traced "
+                                        f"parameter {sub.id!r} inside a "
+                                        "jitted function — np falls back "
+                                        "to host and breaks under jit",
+                            ))
+                            break
+                    else:
+                        continue
+                    break
+        # Python branch on a traced value -> trace-time specialization
+        if isinstance(node, (ast.If, ast.IfExp)):
+            for sub in ast.walk(node.test):
+                if isinstance(sub, ast.Name) and sub.id in traced:
+                    findings.append(Finding(
+                        rule="D104", path=mod.relpath, line=node.lineno,
+                        symbol=symbol, match=f"branch:{sub.id}",
+                        message=f"Python branch on traced parameter "
+                                f"{sub.id!r} inside a jitted function — "
+                                "mark it static_argnames or use "
+                                "jnp.where/lax.cond",
+                    ))
+                    break
+    return findings
+
+
+def _dtype_name(expr: ast.AST) -> str | None:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    name = dotted(expr)
+    if name:
+        return name.rsplit(".", 1)[-1]
+    return None

@@ -1,0 +1,260 @@
+"""Registry-drift lint (rules R201-R204, specs/analysis.md).
+
+Three registries keep growing across PRs and have already drifted once
+each: the fault-site list (code, specs/faults.md, and the parametrized
+coverage test disagreed by five sites after PRs 5-9), the telemetry
+metric/span catalog (specs/observability.md), and the SLO objective
+table. This pass cross-checks them from the AST and markdown alone:
+
+  R201  fault sites: every literal `faults.fire("site")` in the package
+        must appear in specs/faults.md, in the faults.py module
+        docstring, AND in the TestFaultSiteCoverage parametrize list;
+        sites documented but never fired are drift too
+  R202  every literal metric name written through the telemetry
+        registry must be documented in some specs/*.md (wildcard rows
+        like `probe_cycle_*` match)
+  R203  same for literal `tracing.span(...)`/`tracing.emit(...)` names
+  R204  every metric an SLO objective reads must be one the package
+        actually writes — a dead objective can never breach
+
+Dynamic (f-string) names are skipped: the catalog rule only binds
+literals, and every dynamic family is expected to carry a wildcard row
+in the specs.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+
+from celestia_tpu.tools.analysis.core import (
+    Finding, Module, Project, dotted,
+)
+
+_METRIC_WRITERS = {"incr_counter", "set_gauge", "observe", "measure",
+                   "measure_since"}
+_SITE_RE = re.compile(r"`([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)`")
+# a site token in running prose (the faults.py docstring registry
+# lists sites as an aligned plain-text table, no backticks)
+_BARE_SITE_RE = re.compile(r"\b([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)\b")
+# leading identifier of a backticked token — `rpc_shed_total{reason=x}`
+# documents rpc_shed_total
+_TOKEN_RE = re.compile(r"`([A-Za-z_][\w.*]*)[^`]*`")
+
+
+def _literal_str(expr: ast.AST) -> str | None:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    return None
+
+
+def _fired_sites(project: Project) -> dict[str, tuple[Module, int]]:
+    sites: dict[str, tuple[Module, int]] = {}
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func) or ""
+            if name.rsplit(".", 1)[-1] != "fire":
+                continue
+            if not (name == "fire" or name.endswith("faults.fire")
+                    or name == "faults.fire"):
+                continue
+            if node.args:
+                lit = _literal_str(node.args[0])
+                if lit is not None:
+                    sites.setdefault(lit, (mod, node.lineno))
+    return sites
+
+
+def _spec_sites(project: Project) -> set[str]:
+    text = project.spec_files.get("specs/faults.md", "")
+    return {m for line in text.splitlines() if line.lstrip().startswith("|")
+            for m in _SITE_RE.findall(line)}
+
+
+def _docstring_sites(project: Project) -> set[str]:
+    mod = project.module("faults")
+    if mod is None:
+        return set()
+    doc = ast.get_docstring(mod.tree) or ""
+    return set(_SITE_RE.findall(doc)) | set(_BARE_SITE_RE.findall(doc))
+
+
+def _coverage_sites(project: Project) -> set[str] | None:
+    """The parametrize list of TestFaultSiteCoverage, or None when the
+    test file/class doesn't exist (fixture projects)."""
+    for tf in project.test_files:
+        for node in ast.walk(tf.tree):
+            if not (isinstance(node, ast.ClassDef)
+                    and node.name == "TestFaultSiteCoverage"):
+                continue
+            sites: set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    name = dotted(sub.func) or ""
+                    if name.endswith("parametrize") and len(sub.args) >= 2:
+                        for elt in ast.walk(sub.args[1]):
+                            lit = _literal_str(elt)
+                            if lit and "." in lit:
+                                sites.add(lit)
+            return sites
+    return None
+
+
+def _doc_tokens(project: Project) -> set[str]:
+    """Every backticked token in every spec — the documentation
+    universe for metric and span names (wildcards included)."""
+    tokens: set[str] = set()
+    for text in project.spec_files.values():
+        tokens.update(_TOKEN_RE.findall(text))
+    return tokens
+
+
+def _documented(name: str, tokens: set[str],
+                wildcards: list[str]) -> bool:
+    if name in tokens:
+        return True
+    return any(fnmatch.fnmatchcase(name, w) for w in wildcards)
+
+
+def _written_metrics(project: Project) -> dict[str, tuple[Module, int]]:
+    out: dict[str, tuple[Module, int]] = {}
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = dotted(node.func) or ""
+            if name.rsplit(".", 1)[-1] not in _METRIC_WRITERS:
+                continue
+            lit = _literal_str(node.args[0])
+            if lit is not None:
+                out.setdefault(lit, (mod, node.lineno))
+    return out
+
+
+def _emitted_spans(project: Project) -> dict[str, tuple[Module, int]]:
+    out: dict[str, tuple[Module, int]] = {}
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = dotted(node.func) or ""
+            if name not in ("tracing.span", "tracing.emit", "span",
+                            "emit"):
+                continue
+            if name in ("span", "emit") and mod.name != "tracing":
+                continue
+            lit = _literal_str(node.args[0])
+            if lit is not None:
+                out.setdefault(lit, (mod, node.lineno))
+    return out
+
+
+def _slo_metric_refs(project: Project) -> list[tuple[str, Module, int]]:
+    """Metric names the SLO objective table reads (literal string
+    keywords of objective constructors in slo.py)."""
+    mod = project.module("slo")
+    if mod is None:
+        return []
+    refs: list[tuple[str, Module, int]] = []
+    func = None
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.FunctionDef) \
+                and node.name == "default_objectives":
+            func = node
+            break
+    if func is None:
+        return []
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Call):
+            continue
+        for kw in node.keywords:
+            if kw.arg in ("counter", "good", "total", "metric",
+                          "numerator", "denominator", "histogram"):
+                lit = _literal_str(kw.value)
+                if lit is not None:
+                    refs.append((lit, mod, node.lineno))
+    return refs
+
+
+def run_pass(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+
+    # R201 — the fault-site registry, four-way
+    fired = _fired_sites(project)
+    spec = _spec_sites(project)
+    doc = _docstring_sites(project)
+    covered = _coverage_sites(project)
+    faults_mod = project.module("faults")
+    for site, (mod, line) in sorted(fired.items()):
+        missing = []
+        if spec and site not in spec:
+            missing.append("specs/faults.md")
+        if doc and site not in doc:
+            missing.append("the faults.py docstring registry")
+        if covered is not None and site not in covered:
+            missing.append("TestFaultSiteCoverage")
+        if missing:
+            findings.append(Finding(
+                rule="R201", path=mod.relpath, line=line,
+                symbol="<module>", match=site,
+                message=f"fault site {site!r} is fired here but missing "
+                        f"from {', '.join(missing)}",
+            ))
+    for site in sorted(spec - set(fired)):
+        anchor = faults_mod or (project.modules[0]
+                                if project.modules else None)
+        if anchor is None:
+            continue
+        findings.append(Finding(
+            rule="R201", path=anchor.relpath, line=1,
+            symbol="<module>", match=site,
+            message=f"fault site {site!r} is documented in "
+                    "specs/faults.md but nothing fires it",
+        ))
+    if covered is not None:
+        for site in sorted(covered - set(fired)):
+            anchor = faults_mod or project.modules[0]
+            findings.append(Finding(
+                rule="R201", path=anchor.relpath, line=1,
+                symbol="<module>", match=site,
+                message=f"fault site {site!r} is in the coverage test "
+                        "parametrize list but nothing fires it",
+            ))
+
+    # R202/R203 — telemetry catalogs
+    tokens = _doc_tokens(project)
+    wildcards = [t for t in tokens if "*" in t]
+    for name, (mod, line) in sorted(_written_metrics(project).items()):
+        if mod.name in ("telemetry",):
+            continue  # the registry's own internals
+        if not _documented(name, tokens, wildcards):
+            findings.append(Finding(
+                rule="R202", path=mod.relpath, line=line,
+                symbol="<module>", match=name,
+                message=f"metric {name!r} is written here but appears "
+                        "in no specs/*.md catalog",
+            ))
+    for name, (mod, line) in sorted(_emitted_spans(project).items()):
+        if not _documented(name, tokens, wildcards):
+            findings.append(Finding(
+                rule="R203", path=mod.relpath, line=line,
+                symbol="<module>", match=name,
+                message=f"span {name!r} is emitted here but appears in "
+                        "no specs/*.md catalog",
+            ))
+
+    # R204 — every objective-referenced metric has a writer
+    written = set(_written_metrics(project))
+    for name, mod, line in _slo_metric_refs(project):
+        if name not in written:
+            findings.append(Finding(
+                rule="R204", path=mod.relpath, line=line,
+                symbol="default_objectives", match=name,
+                message=f"SLO objective reads metric {name!r} but "
+                        "nothing in the package writes it — the "
+                        "objective can never observe reality",
+            ))
+    return findings

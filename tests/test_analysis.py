@@ -1,0 +1,626 @@
+"""celestia-lint suite (celestia_tpu/tools/analysis, specs/analysis.md,
+ADR-020).
+
+Every rule gets a seeded-violation fixture project — a tiny on-disk tree
+with exactly one planted defect — and a FIXED twin, proving both that
+the rule detects the defect and that the repaired idiom passes clean
+(an analyzer that cannot go green on good code just trains people to
+waive it). On top of the per-rule pairs:
+
+  * the suppression protocol: inline `# lint: allow(...)` waivers
+    (reasonless waivers are themselves findings, S001), the committed
+    baseline (entries without reasons fail the whole run), and the
+    new-findings-only gate semantics;
+  * the CLI contract `make analyze` relies on: exit 0 clean, exit 1 on
+    a planted violation, `--json` report schema;
+  * the self-gate: the analyzer runs green on THIS repository with the
+    committed baseline, in well under the 60 s budget, without
+    importing a single module it checks.
+"""
+
+import json
+import pathlib
+import textwrap
+import time
+
+import pytest
+
+from celestia_tpu.tools.analysis import (
+    BaselineError,
+    RULES,
+    run_analysis,
+)
+from celestia_tpu.tools.analysis.__main__ import main as lint_main
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def make_project(tmp_path, files):
+    """Write a fixture tree ({relpath: source}) and return its root."""
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text), encoding="utf-8")
+    return tmp_path
+
+
+def rules_found(tmp_path, files, baseline=None):
+    root = make_project(tmp_path, files)
+    report = run_analysis(root, baseline_path=baseline)
+    return {f.rule for f in report.new_findings}, report
+
+
+# --------------------------------------------------------------------- #
+# per-rule seeded fixtures: detection AND clean-pass on the fixed twin
+
+
+LOCKS_INIT = """\
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._x = threading.Lock()
+            self._y = threading.Lock()
+"""
+
+FIXTURES = {
+    "C001-inversion": (
+        {"celestia_tpu/pair.py": LOCKS_INIT + """\
+
+        def one(self):
+            with self._x:
+                with self._y:
+                    return 1
+
+        def two(self):
+            with self._y:
+                with self._x:
+                    return 2
+"""},
+        {"celestia_tpu/pair.py": LOCKS_INIT + """\
+
+        def one(self):
+            with self._x:
+                with self._y:
+                    return 1
+
+        def two(self):
+            with self._x:
+                with self._y:
+                    return 2
+"""},
+        "C001",
+    ),
+    "C001-declared-order": (
+        {
+            "celestia_tpu/pair.py": LOCKS_INIT + """\
+
+        def wrong(self):
+            with self._y:
+                with self._x:
+                    return 1
+""",
+            "specs/serving.md": """\
+            # Serving
+
+            ## Lock ordering
+
+            `pair._x` → `pair._y`
+""",
+        },
+        {
+            "celestia_tpu/pair.py": LOCKS_INIT + """\
+
+        def right(self):
+            with self._x:
+                with self._y:
+                    return 1
+""",
+            "specs/serving.md": """\
+            # Serving
+
+            ## Lock ordering
+
+            `pair._x` → `pair._y`
+""",
+        },
+        "C001",
+    ),
+    "C002-transfer-under-lock": (
+        {"celestia_tpu/pool.py": """\
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._offsets = {}
+
+        def put(self, key, data):
+            with self._lock:
+                dev = transfers.device_put_chunked(data)
+                self._offsets[key] = dev
+"""},
+        {"celestia_tpu/pool.py": """\
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._offsets = {}
+
+        def put(self, key, data):
+            dev = transfers.device_put_chunked(data)
+            with self._lock:
+                self._offsets[key] = dev
+"""},
+        "C002",
+    ),
+    "C003-fire-under-lock": (
+        {"celestia_tpu/svc.py": """\
+    import threading
+    from celestia_tpu import faults
+
+    class Svc:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def handle(self):
+            with self._lock:
+                faults.fire("svc.handle")
+                self._n += 1
+"""},
+        {"celestia_tpu/svc.py": """\
+    import threading
+    from celestia_tpu import faults
+
+    class Svc:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def handle(self):
+            faults.fire("svc.handle")
+            with self._lock:
+                self._n += 1
+"""},
+        "C003",
+    ),
+    "C004-wait-outside-while": (
+        {"celestia_tpu/waiter.py": """\
+    import threading
+
+    class Waiter:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self._ready = False
+
+        def block(self):
+            with self._cond:
+                self._cond.wait()
+"""},
+        {"celestia_tpu/waiter.py": """\
+    import threading
+
+    class Waiter:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self._ready = False
+
+        def block(self):
+            with self._cond:
+                while not self._ready:
+                    self._cond.wait()
+"""},
+        "C004",
+    ),
+    "C005-torn-read": (
+        {"celestia_tpu/gauge.py": """\
+    import threading
+
+    class Gauge:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._depth = 0
+
+        def bump(self):
+            with self._lock:
+                self._depth += 1
+
+        def peek(self):
+            return self._depth
+"""},
+        {"celestia_tpu/gauge.py": """\
+    import threading
+
+    class Gauge:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._depth = 0
+
+        def bump(self):
+            with self._lock:
+                self._depth += 1
+
+        def peek(self):
+            with self._lock:
+                return self._depth
+"""},
+        "C005",
+    ),
+    "D101-set-iteration": (
+        {"celestia_tpu/square.py": """\
+    def roots(cells):
+        out = []
+        for c in set(cells):
+            out.append(c)
+        return out
+"""},
+        {"celestia_tpu/square.py": """\
+    def roots(cells):
+        out = []
+        for c in sorted(set(cells)):
+            out.append(c)
+        return out
+"""},
+        "D101",
+    ),
+    "D102-wallclock": (
+        {"celestia_tpu/square.py": """\
+    import time
+
+    def stamp():
+        return time.time()
+"""},
+        {"celestia_tpu/square.py": """\
+    import time
+
+    def stamp():
+        return time.monotonic()
+"""},
+        "D102",
+    ),
+    "D103-float-encoding": (
+        {"celestia_tpu/shares.py": """\
+    import numpy as np
+
+    def pad(n):
+        return np.zeros((n,), dtype="float32")
+"""},
+        {"celestia_tpu/shares.py": """\
+    import numpy as np
+
+    def pad(n):
+        return np.zeros((n,), dtype="uint8")
+"""},
+        "D103",
+    ),
+    "D104-jit-drift": (
+        {"celestia_tpu/extend_tpu.py": """\
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def extend(x, flag):
+        if flag:
+            return np.asarray(x)
+        return x
+"""},
+        {"celestia_tpu/extend_tpu.py": """\
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("flag",))
+    def extend(x, flag):
+        if flag:
+            return jnp.asarray(x)
+        return x
+"""},
+        "D104",
+    ),
+    "R201-fault-site-drift": (
+        {
+            "celestia_tpu/faults.py": '''\
+    """Sites: rpc.get"""
+
+    def fire(site, **ctx):
+        return None
+''',
+            "celestia_tpu/client.py": """\
+    from celestia_tpu import faults
+
+    def get():
+        faults.fire("rpc.get")
+
+    def ghost():
+        faults.fire("ghost.site")
+""",
+            "specs/faults.md": """\
+            # Faults
+
+            | site | where |
+            |---|---|
+            | `rpc.get` | transport |
+""",
+            "tests/test_cov.py": """\
+    import pytest
+
+    class TestFaultSiteCoverage:
+        @pytest.mark.parametrize("site", ["rpc.get"])
+        def test_site_fires(self, site):
+            pass
+""",
+        },
+        {
+            "celestia_tpu/faults.py": '''\
+    """Sites: rpc.get ghost.site"""
+
+    def fire(site, **ctx):
+        return None
+''',
+            "celestia_tpu/client.py": """\
+    from celestia_tpu import faults
+
+    def get():
+        faults.fire("rpc.get")
+
+    def ghost():
+        faults.fire("ghost.site")
+""",
+            "specs/faults.md": """\
+            # Faults
+
+            | site | where |
+            |---|---|
+            | `rpc.get` | transport |
+            | `ghost.site` | spectral |
+""",
+            "tests/test_cov.py": """\
+    import pytest
+
+    class TestFaultSiteCoverage:
+        @pytest.mark.parametrize("site", ["rpc.get", "ghost.site"])
+        def test_site_fires(self, site):
+            pass
+""",
+        },
+        "R201",
+    ),
+    "R202-undocumented-metric": (
+        {
+            "celestia_tpu/worker.py": """\
+    from celestia_tpu.telemetry import metrics
+
+    def work():
+        metrics.incr_counter("arena_fill_total")
+""",
+            "specs/observability.md": "# Observability\n",
+        },
+        {
+            "celestia_tpu/worker.py": """\
+    from celestia_tpu.telemetry import metrics
+
+    def work():
+        metrics.incr_counter("arena_fill_total")
+""",
+            "specs/observability.md":
+                "# Observability\n\n`arena_fill_total` counts fills.\n",
+        },
+        "R202",
+    ),
+    "R203-undocumented-span": (
+        {
+            "celestia_tpu/worker.py": """\
+    from celestia_tpu.telemetry import tracing
+
+    def work():
+        with tracing.span("work.body"):
+            pass
+""",
+            "specs/observability.md": "# Observability\n",
+        },
+        {
+            "celestia_tpu/worker.py": """\
+    from celestia_tpu.telemetry import tracing
+
+    def work():
+        with tracing.span("work.body"):
+            pass
+""",
+            "specs/observability.md":
+                "# Observability\n\n`work.body` wraps the body.\n",
+        },
+        "R203",
+    ),
+    "R204-dead-objective": (
+        {
+            "celestia_tpu/slo.py": """\
+    def default_objectives():
+        return [Objective(counter="never_written_total")]
+""",
+        },
+        {
+            "celestia_tpu/slo.py": """\
+    def default_objectives():
+        return [Objective(counter="never_written_total")]
+""",
+            "celestia_tpu/worker.py": """\
+    from celestia_tpu.telemetry import metrics
+
+    def work():
+        metrics.incr_counter("never_written_total")
+""",
+            "specs/observability.md":
+                "# Observability\n\n`never_written_total` is real.\n",
+        },
+        "R204",
+    ),
+}
+
+
+class TestSeededFixtures:
+    @pytest.mark.parametrize("name", sorted(FIXTURES))
+    def test_rule_detects_planted_violation(self, name, tmp_path):
+        bad, _good, rule = FIXTURES[name]
+        found, report = rules_found(tmp_path, bad)
+        assert rule in found, (
+            f"{name}: planted {rule} not detected; findings: "
+            f"{[f.render() for f in report.new_findings]}"
+        )
+
+    @pytest.mark.parametrize("name", sorted(FIXTURES))
+    def test_fixed_twin_passes_clean(self, name, tmp_path):
+        _bad, good, rule = FIXTURES[name]
+        found, report = rules_found(tmp_path, good)
+        assert rule not in found, (
+            f"{name}: fixed code still flags {rule}: "
+            f"{[f.render() for f in report.new_findings]}"
+        )
+
+    def test_every_rule_has_catalog_text(self):
+        planted = {rule for _b, _g, rule in FIXTURES.values()}
+        assert planted <= set(RULES)
+        # each rule family is exercised by at least one fixture
+        assert {"C001", "C002", "C003", "C004", "C005"} <= planted
+        assert {"D101", "D102", "D103", "D104"} <= planted
+        assert {"R201", "R202", "R203", "R204"} <= planted
+
+
+# --------------------------------------------------------------------- #
+# suppression protocol: waivers, baseline, new-findings-only gate
+
+
+class TestSuppression:
+    C005_BAD = FIXTURES["C005-torn-read"][0]
+
+    def test_waiver_with_reason_suppresses(self, tmp_path):
+        files = dict(self.C005_BAD)
+        files["celestia_tpu/gauge.py"] = files[
+            "celestia_tpu/gauge.py"
+        ].replace(
+            "        def peek(self):\n",
+            "        def peek(self):\n"
+            "            # lint: allow(C005) reason=monitoring gauge; "
+            "a stale int is fine\n",
+        )
+        found, report = rules_found(tmp_path, files)
+        assert "C005" not in found
+        assert report.waived == 1
+        # the raw finding still exists — waivers hide, they don't heal
+        assert any(f.rule == "C005" for f in report.all_findings)
+
+    def test_waiver_without_reason_is_s001(self, tmp_path):
+        files = dict(self.C005_BAD)
+        files["celestia_tpu/gauge.py"] = files[
+            "celestia_tpu/gauge.py"
+        ].replace(
+            "        def peek(self):\n",
+            "        def peek(self):\n"
+            "            # lint: allow(C005)\n",
+        )
+        found, _report = rules_found(tmp_path, files)
+        assert "S001" in found
+        # a reasonless waiver does NOT suppress its target
+        assert "C005" in found
+
+    def test_baseline_suppresses_by_fingerprint(self, tmp_path):
+        root = make_project(tmp_path, self.C005_BAD)
+        baseline = root / "lint_baseline.json"
+        baseline.write_text(json.dumps({"entries": [{
+            "rule": "C005", "path": "celestia_tpu/gauge.py",
+            "symbol": "Gauge", "match": "_depth",
+            "reason": "pre-gate finding, tracked in the fixture",
+        }]}), encoding="utf-8")
+        report = run_analysis(root, baseline_path=baseline)
+        assert not report.new_findings
+        assert report.baselined == 1
+
+    def test_baseline_entry_without_reason_fails_run(self, tmp_path):
+        root = make_project(tmp_path, self.C005_BAD)
+        baseline = root / "lint_baseline.json"
+        baseline.write_text(json.dumps({"entries": [{
+            "rule": "C005", "path": "celestia_tpu/gauge.py",
+            "symbol": "Gauge", "match": "_depth", "reason": "  ",
+        }]}), encoding="utf-8")
+        with pytest.raises(BaselineError):
+            run_analysis(root, baseline_path=baseline)
+
+    def test_new_finding_fails_despite_baseline(self, tmp_path):
+        files = dict(self.C005_BAD)
+        files.update(FIXTURES["C002-transfer-under-lock"][0])
+        root = make_project(tmp_path, files)
+        baseline = root / "lint_baseline.json"
+        baseline.write_text(json.dumps({"entries": [{
+            "rule": "C005", "path": "celestia_tpu/gauge.py",
+            "symbol": "Gauge", "match": "_depth",
+            "reason": "pre-gate finding",
+        }]}), encoding="utf-8")
+        report = run_analysis(root, baseline_path=baseline)
+        assert {f.rule for f in report.new_findings} == {"C002"}
+
+
+# --------------------------------------------------------------------- #
+# CLI contract (`make analyze`)
+
+
+class TestCli:
+    def test_exit_nonzero_on_planted_violation(self, tmp_path, capsys):
+        root = make_project(tmp_path, FIXTURES["C002-transfer-under-lock"][0])
+        rc = lint_main(["--root", str(root), "--baseline", ""])
+        assert rc == 1
+        assert "C002" in capsys.readouterr().out
+
+    def test_exit_zero_and_json_report_on_clean(self, tmp_path, capsys):
+        root = make_project(tmp_path, FIXTURES["C002-transfer-under-lock"][1])
+        out = root / "report.json"
+        rc = lint_main(["--root", str(root), "--baseline", "",
+                        "--json", str(out)])
+        assert rc == 0
+        assert "clean" in capsys.readouterr().out
+        doc = json.loads(out.read_text())
+        assert doc["schema"] == "celestia-lint/1"
+        assert doc["new_findings"] == []
+        assert "elapsed_s" in doc
+
+    def test_list_rules(self, capsys):
+        rc = lint_main(["--list-rules"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        for rule in RULES:
+            assert rule in out
+
+
+# --------------------------------------------------------------------- #
+# the self-gate: this repository passes its own analyzer
+
+
+class TestSelfGate:
+    def test_repo_is_clean_under_committed_baseline(self):
+        t0 = time.monotonic()
+        report = run_analysis(
+            REPO_ROOT,
+            baseline_path=REPO_ROOT / "config" / "lint_baseline.json",
+        )
+        elapsed = time.monotonic() - t0
+        assert not report.new_findings, (
+            "the committed tree must lint clean:\n"
+            + "\n".join(f.render() for f in report.new_findings)
+        )
+        assert elapsed < 60.0, f"analyze budget blown: {elapsed:.1f}s"
+
+    def test_committed_baseline_entries_all_carry_reasons(self):
+        doc = json.loads(
+            (REPO_ROOT / "config" / "lint_baseline.json").read_text()
+        )
+        assert doc["entries"], "baseline exists but is empty"
+        for e in doc["entries"]:
+            assert e["reason"].strip(), f"reasonless baseline entry: {e}"
+
+    def test_repo_waivers_all_carry_reasons(self):
+        report = run_analysis(
+            REPO_ROOT,
+            baseline_path=REPO_ROOT / "config" / "lint_baseline.json",
+        )
+        assert not any(f.rule == "S001" for f in report.all_findings)

@@ -532,6 +532,52 @@ class TestFaultSiteCoverage:
                 watchtowers=[fast_client(server.url)],
             )
             lc.accept_header(1)
+        elif site == "dispatch.enqueue":
+            from celestia_tpu.node.dispatch import DeviceDispatcher
+
+            # no start(): admission fires, then the call degrades to
+            # inline execution — no thread to clean up
+            DeviceDispatcher(capacity=2).submit(fn=lambda: 1,
+                                                label="coverage")
+        elif site == "dispatch.run":
+            from celestia_tpu.node.dispatch import DeviceDispatcher
+
+            d = DeviceDispatcher(capacity=2).start()
+            try:
+                d.submit(fn=lambda: 1, label="coverage")
+            finally:
+                d.drain()
+        elif site == "dispatch.batch":
+            from celestia_tpu.node.dispatch import DeviceDispatcher
+
+            d = DeviceDispatcher(capacity=4, batch_window_s=0.0,
+                                 max_batch=4).start()
+            try:
+                d.submit(batch_key="coverage",
+                         batch_exec=lambda payloads: payloads,
+                         payload=1, label="coverage")
+            finally:
+                d.drain()
+        elif site in ("cache.demote", "cache.faultin"):
+            import jax
+            import jax.numpy as jnp
+
+            from celestia_tpu.node.eds_cache import PagedEdsCache
+
+            eds = da.extend_shares(chain_shares(2, 1))
+            dev = da.ExtendedDataSquare.from_device(
+                jax.device_put(jnp.asarray(eds.data)),
+                eds.original_width,
+            )
+            # 2 pages under a 1-page budget: put() demotes the cold
+            # page, and walking every row faults it back in
+            page_bytes = 2 * eds.data.shape[1] * eds.data.shape[2]
+            cache = PagedEdsCache(rows_per_page=2,
+                                  device_byte_budget=page_bytes)
+            cache.put(1, dev)
+            paged = cache.get(1)
+            for i in range(eds.data.shape[0]):
+                paged.row(i)
         else:  # pragma: no cover — keep the list and the spec in sync
             pytest.fail(f"no driver for documented site {site!r}")
 
@@ -547,6 +593,11 @@ class TestFaultSiteCoverage:
         "transfer.chunk",
         "probe.request",
         "watchtower.befp",
+        "dispatch.enqueue",
+        "dispatch.run",
+        "dispatch.batch",
+        "cache.demote",
+        "cache.faultin",
     ])
     def test_site_fires(self, site, net):
         with faults.inject(
