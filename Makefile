@@ -5,8 +5,10 @@ PY ?= python
 
 .PHONY: test test-all test-slow chaos bench bench-transfers dryrun native \
 	trace-smoke bench-gate obs-smoke sdc-smoke storm-smoke storm-bench \
+	store-smoke gateway-bench \
 	scenario-smoke scenario-pfb-storm scenario-rolling-outage \
-	scenario-sdc-under-storm scenario-rejoin-under-load scenarios \
+	scenario-sdc-under-storm scenario-rejoin-under-load \
+	scenario-gateway-fleet scenarios \
 	kernel-smoke bench-fused analyze
 
 # Static analysis gate (specs/analysis.md, ADR-020): AST-level
@@ -114,6 +116,17 @@ sdc-smoke:
 storm-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/storm_smoke.py
 
+# Block-store durability drill (specs/store.md, ADR-021): persist a
+# chain into the CRC32C-guarded on-disk store through the real node,
+# restart over the same directory, and require re-index + serving of
+# every persisted height with byte-identical DAHs, NMT-verified
+# shares, and disk-backed page reads; a CRC-corrupted page must be
+# REFUSED (IntegrityError + SDC detection, never torn bytes) and
+# truncated/garbage files quarantined at re-index. CPU-only,
+# crypto-free, seconds.
+store-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/store_smoke.py
+
 # Continuous-batching throughput gate (specs/serving.md, ADR-017): the
 # full das-storm — 32 concurrent light clients through the real RPC
 # stack, unbatched phase then batched phase on identical config with
@@ -126,6 +139,18 @@ storm-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py --das-storm \
 		--seconds 4 --threads 32 --k 8 --paged-budget 98304 \
 		--require-speedup 2.0 --ledger storm_ledger.json
+
+# Horizontal-scaling gate (ADR-021): one backend vs a 3-backend fleet
+# behind the consistent-hash gateway on identical client load, every
+# accepted sample NMT-verified. The require-scaling floor only asserts
+# the fleet does not COLLAPSE (the CI box is 1-core, so the phases tie
+# there; real scaling headroom needs cores). --ledger feeds the
+# lower-is-better gateway_ms_per_accepted_sample series `make
+# bench-gate` judges. CPU-only, ~8 s.
+gateway-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --gateway-fleet \
+		--seconds 3 --threads 16 --k 8 --fleet 3 \
+		--require-scaling 0.7 --ledger storm_ledger.json
 
 # Fused-kernel smoke gate (ADR-019): fused extend+hash DAH byte-parity
 # vs the host oracle at k ∈ {32, 64} (production dispatch + the
@@ -175,9 +200,18 @@ scenario-rejoin-under-load:
 	JAX_PLATFORMS=cpu $(PY) -m celestia_tpu.scenarios rejoin-under-load \
 		--ledger scenario_ledger.json
 
-# All four suites back to back.
+# Fleet campaign (ADR-021): a DAS flash crowd through the consistent-
+# hash gateway over a 3-node fleet with rolling backend restarts; each
+# restarted backend must re-index its on-disk block store and serve
+# byte-identical DAHs from disk.
+scenario-gateway-fleet:
+	JAX_PLATFORMS=cpu $(PY) -m celestia_tpu.scenarios gateway-fleet \
+		--ledger scenario_ledger.json
+
+# All five suites back to back.
 scenarios: scenario-pfb-storm scenario-rolling-outage \
-	scenario-sdc-under-storm scenario-rejoin-under-load
+	scenario-sdc-under-storm scenario-rejoin-under-load \
+	scenario-gateway-fleet
 
 # The driver's multichip compile/execute check on a virtual CPU mesh.
 dryrun:

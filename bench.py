@@ -1838,6 +1838,192 @@ def main_das_storm(seconds: float = 4.0, threads: int = 32, k: int = 8,
         raise SystemExit("das-storm failed: " + "; ".join(failures))
 
 
+def _gateway_fleet_phase(label: str, n: int, *, seconds: float,
+                         threads: int, k: int, heights: int,
+                         queue_capacity: int, deadline_ms: int):
+    """One gateway-fleet phase: n chaosnet backends (byte-identical
+    replicas — same k/seed/chain) behind node/gateway.Gateway, with
+    `threads` closed-loop light clients sampling random cells THROUGH
+    the gateway and NMT-verifying every accepted share against the
+    canonical DAH. Returns the phase counters + samples/sec."""
+    import json as _json
+    import random as _random
+    import threading as _threading
+    import urllib.error
+    import urllib.request
+
+    from celestia_tpu.node.gateway import Gateway
+    from celestia_tpu.node.rpc import RpcServer
+    from celestia_tpu.scenarios.world import _verify_sample
+    from celestia_tpu.telemetry import metrics
+    from celestia_tpu.testutil.chaosnet import RpcChaosNode
+
+    nodes = [RpcChaosNode(heights=heights, k=k, seed=7,
+                          chain_id="gateway-bench") for _ in range(n)]
+    servers = [RpcServer(nd, port=0, queue_capacity=queue_capacity)
+               for nd in nodes]
+    for s in servers:
+        s.start()
+    gw = Gateway([f"http://127.0.0.1:{s.port}" for s in servers])
+    gw.start()
+    base = gw.url
+    # the replicas are byte-identical, so one node's DAHs are THE
+    # verification oracle no matter which backend the ring picked
+    dahs = {h: nodes[0].block_dah(h) for h in range(1, heights + 1)}
+    w = 2 * k
+    counts = {"ok": 0, "shed": 0, "deadline": 0, "not_found": 0,
+              "error": 0}
+    verify_failures = 0
+    lock = _threading.Lock()
+    stop = _threading.Event()
+    hedges0 = metrics.get_counter("gateway_hedge_total")
+
+    def client(seed: int) -> None:
+        nonlocal verify_failures
+        rng = _random.Random(seed)
+        while not stop.is_set():
+            h = rng.randint(1, heights)
+            i, j = rng.randrange(w), rng.randrange(w)
+            req = urllib.request.Request(
+                f"{base}/sample/{h}/{i}/{j}",
+                headers={"X-Deadline-Ms": str(deadline_ms)})
+            try:
+                with urllib.request.urlopen(req, timeout=5.0) as resp:
+                    body = _json.loads(resp.read())
+                ok = _verify_sample(dahs[h], k, i, j, body)
+                with lock:
+                    counts["ok"] += 1
+                    if not ok:
+                        verify_failures += 1
+            except urllib.error.HTTPError as e:
+                key = {503: "shed", 504: "deadline",
+                       404: "not_found"}.get(e.code, "error")
+                with lock:
+                    counts[key] += 1
+            except Exception:  # noqa: BLE001 — transport-level failure
+                with lock:
+                    counts["error"] += 1
+
+    t0 = time.perf_counter()
+    workers = [_threading.Thread(target=client, args=(1000 + ci,),
+                                 daemon=True) for ci in range(threads)]
+    for t in workers:
+        t.start()
+    stop.wait(seconds)
+    stop.set()
+    for t in workers:
+        t.join(timeout=10)
+    wall = time.perf_counter() - t0
+    gw.stop()
+    for s in servers:
+        s.stop(drain_timeout=2.0)
+    sps = round(counts["ok"] / wall, 1) if wall > 0 else 0.0
+    return {
+        "label": label,
+        "backends": n,
+        "wall_s": round(wall, 2),
+        "counts": counts,
+        "verify_failures": verify_failures,
+        "samples_per_sec": sps,
+        "hedges": metrics.get_counter("gateway_hedge_total") - hedges0,
+    }
+
+
+def main_gateway_fleet(seconds: float = 3.0, threads: int = 16, k: int = 8,
+                       heights: int = 4, queue_capacity: int = 128,
+                       deadline_ms: int = 2000, fleet: int = 3,
+                       ledger: str | None = None,
+                       require_scaling: float | None = None):
+    """`python bench.py --gateway-fleet` / `make gateway-bench`: the
+    ADR-021 horizontal-scaling config. Two phases on identical client
+    load — ONE backend behind the gateway, then `fleet` backends — each
+    phase driving `threads` closed-loop light clients through the
+    consistent-hash (height, row) ring with every accepted sample
+    NMT-verified against the canonical DAH. Reports samples/sec per
+    phase and the fleet/single scaling ratio.
+
+    The backends are in-process Python servers sharing one GIL, so the
+    expected scaling is MODEST (the win is real: N dispatcher queues +
+    N sha256 proving paths that release the GIL) — --require-scaling
+    gates on a floor when set. Exit is nonzero on any accepted sample
+    that fails NMT verification or any HTTP-level error.
+
+    --ledger PATH appends the fleet phase to the storm ledger as the
+    lower-is-better `gateway_ms_per_accepted_sample` series that
+    `make bench-gate` (tools/perf_ledger.py) judges."""
+    import json as _json
+    import os as _os
+
+    common = dict(seconds=seconds, threads=threads, k=k, heights=heights,
+                  queue_capacity=queue_capacity, deadline_ms=deadline_ms)
+    single = _gateway_fleet_phase("single", 1, **common)
+    fleet_phase = _gateway_fleet_phase(f"fleet-{fleet}", fleet, **common)
+    scaling = (
+        round(fleet_phase["samples_per_sec"] / single["samples_per_sec"], 2)
+        if single["samples_per_sec"] else None
+    )
+    out = {
+        "mode": "gateway-fleet",
+        "threads": threads,
+        "k": k,
+        "heights": heights,
+        "fleet": fleet,
+        # scaling is cpu-bound: on a 1-core box the phases tie (the
+        # gate below should only assert no collapse); real headroom
+        # needs cores for the N dispatcher/proving paths to land on
+        "cpus": _os.cpu_count(),
+        "single": single,
+        "fleet_phase": fleet_phase,
+        "scaling_vs_single": scaling,
+    }
+    print(_json.dumps(out))
+
+    if ledger:
+        doc = {"runs": []}
+        if _os.path.exists(ledger):
+            try:
+                with open(ledger) as f:
+                    loaded = _json.load(f)
+                if isinstance(loaded, dict) and isinstance(
+                        loaded.get("runs"), list):
+                    doc = loaded
+            except (OSError, ValueError):
+                pass  # unreadable ledger: start fresh rather than crash
+        sps = fleet_phase["samples_per_sec"]
+        doc["runs"].append({
+            "ts": time.time(),
+            "mode": "gateway-fleet",
+            "threads": threads, "k": k, "seconds": seconds,
+            "fleet": fleet,
+            "samples_per_sec": sps,
+            "gateway_ms_per_accepted_sample": (round(1000.0 / sps, 4)
+                                               if sps else None),
+            "scaling_vs_single": scaling,
+        })
+        doc["runs"] = doc["runs"][-40:]  # capped history
+        with open(ledger, "w") as f:
+            _json.dump(doc, f, indent=1)
+        print(f"storm ledger updated: {ledger} "
+              f"({len(doc['runs'])} runs)", file=sys.stderr)
+
+    failures = []
+    for phase in (single, fleet_phase):
+        if phase["verify_failures"]:
+            failures.append(
+                f"{phase['verify_failures']} accepted samples failed "
+                f"NMT verification ({phase['label']})")
+        if phase["counts"]["error"]:
+            failures.append(
+                f"{phase['counts']['error']} HTTP-level errors "
+                f"({phase['label']})")
+    if require_scaling is not None and (
+            scaling is None or scaling < require_scaling):
+        failures.append(
+            f"fleet scaling {scaling} < required {require_scaling}")
+    if failures:
+        raise SystemExit("gateway-fleet failed: " + "; ".join(failures))
+
+
 def main_fused_kernels():
     """`python bench.py --fused-kernels`: the ADR-019 step-change
     configs alone — fused Pallas extend+hash roots-only vs the XLA
@@ -2046,6 +2232,25 @@ if __name__ == "__main__":
                         raise SystemExit(f"{_flag} requires a value")
                     _kw[_key] = _cast(sys.argv[_i + 1])
             main_das_storm_lite(**_kw)
+        elif "--gateway-fleet" in sys.argv:
+            _kw = {}
+            for _flag, _key, _cast in (
+                ("--seconds", "seconds", float),
+                ("--threads", "threads", int),
+                ("--k", "k", int),
+                ("--heights", "heights", int),
+                ("--queue-capacity", "queue_capacity", int),
+                ("--deadline-ms", "deadline_ms", int),
+                ("--fleet", "fleet", int),
+                ("--ledger", "ledger", str),
+                ("--require-scaling", "require_scaling", float),
+            ):
+                if _flag in sys.argv:
+                    _i = sys.argv.index(_flag)
+                    if _i + 1 >= len(sys.argv):
+                        raise SystemExit(f"{_flag} requires a value")
+                    _kw[_key] = _cast(sys.argv[_i + 1])
+            main_gateway_fleet(**_kw)
         elif "--transfers" in sys.argv:
             main_transfers()
         elif "--fused-kernels" in sys.argv:

@@ -502,6 +502,31 @@ def cmd_ops(args):
     sys.exit(0 if mism == 0 else 1)
 
 
+def cmd_store(args):
+    """`celestia-tpu store stat|verify`: inspect or deep-verify the
+    CRC32C-guarded on-disk block store under --home (specs/store.md,
+    ADR-021). `stat` re-indexes shallowly (header + size checks) and
+    prints the index summary; `verify` additionally checks EVERY page
+    record's CRC and exits 1 when any file was quarantined — the
+    offline bit-rot audit for a node's persisted chain."""
+    from celestia_tpu.store import BlockStore
+
+    home = _home(args)
+    root = home / "store"
+    if not root.is_dir():
+        print(json.dumps({"error": f"no block store at {root}"}),
+              file=sys.stderr)
+        sys.exit(1)
+    store = BlockStore(root)
+    report = store.reindex(deep=(args.store_cmd == "verify"))
+    doc = dict(store.stats())
+    doc["cmd"] = args.store_cmd
+    doc["skipped_files"] = report["skipped"]
+    print(json.dumps(doc, indent=2))
+    if args.store_cmd == "verify" and report["skipped"]:
+        sys.exit(1)
+
+
 def cmd_light(args):
     """Fraud-aware light client (specs/fraud_proofs.md consumer role):
     follow headers from a primary full node, screen each against
@@ -675,6 +700,12 @@ def main(argv=None):
     p_compact.add_argument("--keep-recent", type=int, default=100,
                            help="blocks to retain below the snapshot height")
 
+    p_store = sub.add_parser(
+        "store", help="inspect (stat) or CRC-audit (verify) the on-disk "
+        "block store under --home; verify exits 1 on any quarantined "
+        "file")
+    p_store.add_argument("store_cmd", choices=["stat", "verify"])
+
     p_light = sub.add_parser(
         "light", help="fraud-aware light client: follow headers from a "
         "primary node, reject on verified bad-encoding proofs")
@@ -715,6 +746,7 @@ def main(argv=None):
         "addrbook": cmd_addrbook,
         "rollback": cmd_rollback,
         "compact": cmd_compact,
+        "store": cmd_store,
         "light": cmd_light,
     }[args.cmd](args)
 

@@ -20,6 +20,10 @@ touching production code paths:
     dispatch.batch         one gathered micro-batch       (node/dispatch.py)
     cache.demote           paged-cache page D2H demote    (node/eds_cache.py)
     cache.faultin          paged-cache page H2D fault-in  (node/eds_cache.py)
+    store.write            block-store put, pre-write     (store/__init__.py)
+    store.read             block-store page read          (store/__init__.py)
+    gateway.route          gateway ring routing decision  (node/gateway.py)
+    gateway.hedge          gateway hedged retry hop       (node/gateway.py)
 
 The dispatch trio drives overload drills deterministically: a ``delay``
 rule at ``dispatch.run`` stalls the single dispatcher thread, which
@@ -29,7 +33,13 @@ threads at the admission door instead. An ``error`` at either site
 surfaces through the route's standard error path; at ``dispatch.batch``
 it fails every waiter of the gathered group. The ``cache.*`` pair is
 the paged cache's SDC model: a ``bitflip`` at ``cache.faultin`` is
-caught by the page CRC before any reader sees the bytes.
+caught by the page CRC before any reader sees the bytes. The
+``store.*`` pair is the disk analogue: a ``bitflip`` at
+``store.write`` mangles a page payload after its CRC was stamped —
+rot-on-disk the read path must refuse — while ``store.read`` faults
+the page fetch itself. The ``gateway.*`` pair drills fleet routing:
+``gateway.route`` fires at the ring-ownership decision, and
+``gateway.hedge`` on every retry hop to the next ring position.
 
 Fault kinds:
 

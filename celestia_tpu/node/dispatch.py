@@ -522,4 +522,13 @@ class DeviceDispatcher:
             self._set_depth_gauge_locked()
 
     def _set_depth_gauge_locked(self) -> None:
-        self.metrics.set_gauge("rpc_queue_depth", float(len(self._queue)))
+        if self.name != "device-dispatcher":
+            # fleet backends (gateway.py): one depth series per named
+            # dispatcher, so an operator sees WHICH backend is deep.
+            # The default name keeps the unlabeled series byte-stable.
+            self.metrics.set_gauge("rpc_queue_depth",
+                                   float(len(self._queue)),
+                                   dispatcher=self.name)
+        else:
+            self.metrics.set_gauge("rpc_queue_depth",
+                                   float(len(self._queue)))

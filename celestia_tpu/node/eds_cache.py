@@ -19,6 +19,16 @@ place — so eviction can never tear a page under a reader. Fault sites
 `cache.demote` / `cache.faultin` model in-flight damage on each leg
 (specs/faults.md); the stored checksum must catch it.
 
+With a `store` attached (`celestia_tpu.store.BlockStore`) the cache
+gains a THIRD tier: demotion goes device→host→disk. Host copies of
+store-persisted pages are dropped ("spilled") once host bytes exceed
+`host_byte_budget` — the page's CRC stays on the page — and a later
+fault-in reads the one page record back from the store (which verifies
+its own record CRC) before the usual checksum + upload. A restarted
+node adopts a whole persisted height without touching the device via
+`load_from_store`: every page starts on disk and faults in on first
+read.
+
 The module stays importable stdlib-only (class definitions only —
 numpy/jax/transfers are imported lazily inside the paged methods), so
 the serving race regression tests still run in stripped (crypto-free)
@@ -168,11 +178,16 @@ class PagedEds:
     _ROW_MEMO_CAP = 8  # same burst memo the EDS slice cache provides
 
     def __init__(self, cache: "PagedEdsCache", height: int,
-                 pages: list[_Page], original_width: int):
+                 pages: list[_Page], original_width: int,
+                 rows_per_page: int | None = None):
         self._cache = cache
         self.height = height
         self.pages = pages
         self.original_width = original_width
+        # per-instance paging: a store-loaded height keeps the page
+        # geometry it was PERSISTED with, which may differ from the
+        # cache's current default
+        self.rows_per_page = int(rows_per_page or cache.rows_per_page)
         self._row_memo: dict[int, list[bytes]] = {}
         self._memo_lock = threading.Lock()
         self._host_full = None  # memoized whole-square materialization
@@ -190,7 +205,7 @@ class PagedEds:
     # -- cell/axis reads ------------------------------------------------ #
 
     def _page_for(self, i: int) -> _Page:
-        return self.pages[i // self._cache.rows_per_page]
+        return self.pages[i // self.rows_per_page]
 
     def _memo_get(self, i: int):
         with self._memo_lock:
@@ -249,8 +264,7 @@ class PagedEds:
 
             by_page: dict[int, list[int]] = {}
             for i in misses:
-                by_page.setdefault(i // self._cache.rows_per_page,
-                                   []).append(i)
+                by_page.setdefault(i // self.rows_per_page, []).append(i)
             for page_idx, rows in by_page.items():
                 page = self.pages[page_idx]
                 dev = self._cache._pin_resident(page)
@@ -364,16 +378,24 @@ class PagedEdsCache:
     DEFAULT_ROWS_PER_PAGE = 8
     DEFAULT_DEVICE_BYTE_BUDGET = 128 << 20
     DEFAULT_MAX_HEIGHTS = 4
+    DEFAULT_HOST_BYTE_BUDGET = 512 << 20
 
     def __init__(self, rows_per_page: int | None = None,
                  device_byte_budget: int | None = None,
-                 max_heights: int | None = None):
+                 max_heights: int | None = None,
+                 store=None, host_byte_budget: int | None = None):
         self.rows_per_page = int(rows_per_page or
                                  self.DEFAULT_ROWS_PER_PAGE)
         self.device_byte_budget = int(
             device_byte_budget if device_byte_budget is not None
             else self.DEFAULT_DEVICE_BYTE_BUDGET)
         self.max_heights = int(max_heights or self.DEFAULT_MAX_HEIGHTS)
+        # third tier (specs/store.md): host copies of store-persisted
+        # pages spill to disk past this budget; None store = two tiers
+        self.store = store
+        self.host_byte_budget = int(
+            host_byte_budget if host_byte_budget is not None
+            else self.DEFAULT_HOST_BYTE_BUDGET)
         self._entries: collections.OrderedDict[int, object] = \
             collections.OrderedDict()
         self._height_pins: collections.Counter[int] = collections.Counter()
@@ -451,6 +473,40 @@ class PagedEdsCache:
             pages.append(page)
         return PagedEds(self, height, pages,
                         getattr(value, "original_width", width // 2))
+
+    def load_from_store(self, height: int):
+        """Adopt a persisted height from the attached BlockStore without
+        touching the device: every page starts on DISK (dev=None,
+        host=None, crc=the store record's CRC) and faults in on first
+        read. This is the restart path — a re-indexed node serves deep
+        history page-by-page instead of re-extending the square."""
+        if self.store is None:
+            raise RuntimeError("no BlockStore attached")
+        entry = self.store.entry(height)
+        crcs = self.store.page_crcs(height)
+        width = 2 * entry.k
+        pages: list[_Page] = []
+        for index in range(entry.page_count):
+            lo = index * entry.rows_per_page
+            hi = min(lo + entry.rows_per_page, width)
+            page = _Page(height, index, lo, hi,
+                         (hi - lo) * width * entry.share_size)
+            page.crc = crcs[index]
+            page.last_touch = next(self._tick)
+            pages.append(page)
+        paged = PagedEds(self, height, pages, entry.k,
+                         rows_per_page=entry.rows_per_page)
+        with self._cond:
+            if height in self._entries:
+                self._drop_pages_locked(height)
+            self._entries[height] = paged
+            self._entries.move_to_end(height)
+            self._pages.extend(pages)
+            self.stats_counters["heights_from_store"] += 1
+            self._evict_heights_locked()
+            self._publish_locked()
+        self._count("eds_cache_height_store_load_total")
+        return paged
 
     def _drop_pages_locked(self, height: int) -> None:
         self._pages = [p for p in self._pages if p.height != height]
@@ -545,6 +601,22 @@ class PagedEdsCache:
         from celestia_tpu.ops import transfers
 
         host = page.host
+        if host is None:
+            # third tier: the host copy was spilled (or the height was
+            # adopted via load_from_store) — read the one page record
+            # back from disk. read_page verifies the RECORD's CRC
+            # itself; the cache re-checks against the page's stamped
+            # CRC below, so a rotted record can never reach the device.
+            if self.store is None:
+                raise RuntimeError(
+                    f"page (height={page.height} page={page.index}) has "
+                    f"no host copy and no BlockStore is attached")
+            host, crc = self.store.read_page(page.height, page.index)
+            if page.crc is None:
+                page.crc = crc  # busy-fenced: only this reader writes
+            with self._cond:
+                self.stats_counters["page_store_loads"] += 1
+            self._count("eds_cache_page_store_load_total")
         flip = faults.fire("cache.faultin", height=page.height,
                            page=page.index)
         if flip is not None:
@@ -580,7 +652,7 @@ class PagedEdsCache:
         while True:
             with self._cond:
                 if self._device_bytes_locked() <= self.device_byte_budget:
-                    return
+                    break
                 victim = None
                 for p in self._pages:
                     if p.dev is None or p.pins > 0 or p.busy:
@@ -588,7 +660,7 @@ class PagedEdsCache:
                     if victim is None or p.last_touch < victim.last_touch:
                         victim = p
                 if victim is None:
-                    return  # everything pinned/busy: soft overshoot
+                    break  # everything pinned/busy: soft overshoot
                 victim.busy = True
                 dev = victim.dev
             try:
@@ -607,6 +679,36 @@ class PagedEdsCache:
                 self._count("eds_cache_page_demote_total")
                 self._publish_locked()
                 self._cond.notify_all()
+        self._spill_to_budget()
+
+    def _spill_to_budget(self) -> None:
+        """Third-tier spill: drop host copies of STORE-PERSISTED pages
+        until host bytes fit `host_byte_budget`. The page's CRC stays on
+        the page — a later fault-in reads the record back from the store
+        and re-verifies against it. Pages whose height is not persisted
+        are never spilled (their host copy is the only copy)."""
+        if self.store is None:
+            return
+        while True:
+            with self._cond:
+                host_bytes = sum(p.nbytes for p in self._pages
+                                 if p.host is not None and p.dev is None)
+                if host_bytes <= self.host_byte_budget:
+                    return
+                victim = None
+                for p in self._pages:
+                    if (p.host is None or p.dev is not None or
+                            p.pins > 0 or p.busy):
+                        continue
+                    if p.height not in self.store:
+                        continue
+                    if victim is None or p.last_touch < victim.last_touch:
+                        victim = p
+                if victim is None:
+                    return
+                victim.host = None
+                self.stats_counters["page_spills"] += 1
+            self._count("eds_cache_page_spill_total")
 
     def _demote(self, page: _Page, dev):
         from celestia_tpu import faults, integrity
@@ -653,14 +755,21 @@ class PagedEdsCache:
         """The /status surface: residency, budget, and flow counters."""
         with self._cond:
             resident = sum(1 for p in self._pages if p.dev is not None)
+            on_host = sum(1 for p in self._pages
+                          if p.host is not None and p.dev is None)
             return {
                 "kind": "paged",
                 "heights": len(self._entries),
                 "pages": len(self._pages),
                 "pages_resident": resident,
                 "pages_demoted": len(self._pages) - resident,
+                "pages_on_disk": len(self._pages) - resident - on_host,
                 "device_bytes": self._device_bytes_locked(),
                 "device_byte_budget": self.device_byte_budget,
+                "host_bytes": sum(p.nbytes for p in self._pages
+                                  if p.host is not None and
+                                  p.dev is None),
+                "host_byte_budget": self.host_byte_budget,
                 "rows_per_page": self.rows_per_page,
                 "pin_count": sum(p.pins for p in self._pages) +
                 sum(self._height_pins.values()),
@@ -669,4 +778,9 @@ class PagedEdsCache:
                 "page_demotes": self.stats_counters["page_demotes"],
                 "page_faultins": self.stats_counters["page_faultins"],
                 "page_corrupt": self.stats_counters["page_corrupt"],
+                "page_spills": self.stats_counters["page_spills"],
+                "page_store_loads":
+                    self.stats_counters["page_store_loads"],
+                "heights_from_store":
+                    self.stats_counters["heights_from_store"],
             }

@@ -1,0 +1,355 @@
+"""Consistent-hash DAS gateway over N backend nodes (ADR-021).
+
+The first request path that crosses a node boundary: a thin HTTP
+front door that routes `/sample/<h>/<i>/<j>` by **(height, row)** onto
+a consistent-hash ring of backend base URLs. Keying by (height, row)
+— not the full coordinate — means every sample of the same row lands
+on the same backend, so that backend's dispatcher coalesces them into
+ONE batched sliced read (ADR-017) and its prover memo hashes the row
+once (ADR-019); a per-(h,i,j) key would shred the batch.
+
+The gateway adds NO admission or deadline logic of its own: each
+backend's `rpc.py` dispatcher keeps its bounded queue, X-Deadline-Ms
+budget (forwarded verbatim), and drain semantics. What the gateway
+adds is placement and failover:
+
+  * hedged retry — a backend 503 (shed) or connection failure moves
+    the request to the NEXT distinct ring position (`gateway.hedge`
+    fault site + `gateway_hedge_total`); non-503 HTTP statuses (404,
+    400) are backend answers and pass through untouched;
+  * ring rebalance — `add_backend`/`remove_backend` re-point only the
+    vnode arcs that move (consistent hashing), so a join/leave does
+    not reshuffle the whole keyspace;
+  * `/status` aggregation — one document with every backend's own
+    `/status` plus the ring view; `/readyz` is ready iff ≥1 backend
+    is ready.
+
+Locking: `HashRing._ring_lock` guards the vnode table and backend
+set; it is the FIRST lock in the specs/serving.md declared order and
+is NEVER held across a backend fetch (`urlopen` is a blocking call —
+celestia-lint C002): routing snapshots the candidate list under the
+lock, then fetches unlocked.
+
+Fault sites (specs/faults.md): `gateway.route` fires once per routing
+decision (delay/error rules model a slow or failing router);
+`gateway.hedge` fires before each failover hop (delay rules model
+hedge latency; error rules a failover path that itself fails).
+"""
+
+from __future__ import annotations
+
+import bisect
+import http.server
+import json
+import threading
+import urllib.error
+import urllib.request
+from hashlib import sha256
+
+from celestia_tpu import faults
+from celestia_tpu.log import logger
+from celestia_tpu.telemetry import metrics
+
+log = logger("gateway")
+
+DEFAULT_VNODES = 64
+
+
+class HashRing:
+    """Consistent-hash ring of backend base URLs.
+
+    Each backend owns `vnodes` pseudo-random points on a 64-bit ring
+    (SHA-256 of "url#i" — deterministic across processes, no seed);
+    a key's owner is the first point clockwise from the key's hash,
+    and failover candidates are the next DISTINCT backends in ring
+    order, so hedging never retries the same failed backend."""
+
+    def __init__(self, backends=(), vnodes: int = DEFAULT_VNODES):
+        self.vnodes = int(vnodes)
+        self._ring_lock = threading.Lock()
+        self._points: list[tuple[int, str]] = []  # sorted (hash, url)
+        self._backend_set: set[str] = set()
+        for b in backends:
+            self.add(b)
+
+    @staticmethod
+    def _hash(s: str) -> int:
+        return int.from_bytes(sha256(s.encode()).digest()[:8], "big")
+
+    def add(self, backend: str) -> None:
+        with self._ring_lock:
+            if backend in self._backend_set:
+                return
+            self._backend_set.add(backend)
+            for v in range(self.vnodes):
+                self._points.append(
+                    (self._hash(f"{backend}#{v}"), backend))
+            self._points.sort()
+        self._publish()
+
+    def remove(self, backend: str) -> None:
+        with self._ring_lock:
+            if backend not in self._backend_set:
+                return
+            self._backend_set.discard(backend)
+            self._points = [p for p in self._points if p[1] != backend]
+        self._publish()
+
+    def backends(self) -> list[str]:
+        with self._ring_lock:
+            return sorted(self._backend_set)
+
+    def owners(self, key: str, n: int | None = None) -> list[str]:
+        """The key's owner followed by the next distinct backends in
+        ring order — the hedge candidate sequence. Snapshot-read under
+        the ring lock; the fetches happen after it is released."""
+        h = self._hash(key)
+        out: list[str] = []
+        with self._ring_lock:
+            if not self._points:
+                return out
+            limit = len(self._backend_set) if n is None else \
+                min(n, len(self._backend_set))
+            start = bisect.bisect_left(self._points, (h, ""))
+            for step in range(len(self._points)):
+                backend = self._points[(start + step) %
+                                       len(self._points)][1]
+                if backend not in out:
+                    out.append(backend)
+                    if len(out) >= limit:
+                        break
+        return out
+
+    def __len__(self) -> int:
+        with self._ring_lock:
+            return len(self._backend_set)
+
+    def _publish(self) -> None:
+        with self._ring_lock:
+            n = len(self._backend_set)
+        metrics.set_gauge("gateway_ring_backends", float(n))
+
+
+class Gateway:
+    """Thin HTTP gateway over N in-process backend nodes.
+
+    GETs proxy to the route key's ring owner with hedged failover;
+    `/status` and `/readyz` aggregate across every backend. The
+    gateway holds no block state and accepts no writes (POST → 405 —
+    tx submission goes to a backend directly)."""
+
+    def __init__(self, backends=(), host: str = "127.0.0.1",
+                 port: int = 0, *, vnodes: int = DEFAULT_VNODES,
+                 timeout_s: float = 10.0):
+        self.ring = HashRing(backends, vnodes=vnodes)
+        self.timeout_s = float(timeout_s)
+        gw = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _reply(self, status: int, body: bytes,
+                       content_type: str = "application/json",
+                       backend: str | None = None) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                if backend:
+                    self.send_header("X-Gateway-Backend", backend)
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client went away; nothing to salvage
+
+            def do_POST(self):
+                doc = json.dumps({"error": "gateway is read-only",
+                                  "status": 405}).encode()
+                self._reply(405, doc)
+
+            def do_GET(self):
+                metrics.incr_counter("gateway_requests_total")
+                try:
+                    if self.path == "/status":
+                        self._reply(200, gw._status_doc())
+                        return
+                    if self.path == "/healthz":
+                        self._reply(200, b'{"ok": true}')
+                        return
+                    if self.path == "/readyz":
+                        status, doc = gw._readyz_doc()
+                        self._reply(status, doc)
+                        return
+                    status, body, backend = gw.route(
+                        self.path,
+                        deadline_ms=self.headers.get("X-Deadline-Ms"))
+                    self._reply(status, body, backend=backend)
+                except Exception as e:  # noqa: BLE001 — a routing
+                    # failure (no backends, armed error rule, every
+                    # candidate down) is an unavailability answer,
+                    # never a stack trace on the wire
+                    doc = json.dumps({"error": "gateway_unavailable",
+                                      "reason": str(e),
+                                      "status": 503}).encode()
+                    self._reply(503, doc)
+
+        class _Server(http.server.ThreadingHTTPServer):
+            # match rpc.py: admission control belongs to each
+            # backend's dispatcher queue, not the kernel backlog
+            request_queue_size = 128
+
+        self.server = _Server((host, port), Handler)
+        self.host = host
+        self.port = self.server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ------------------------------------------------------ #
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def add_backend(self, backend: str) -> None:
+        self.ring.add(backend)
+
+    def remove_backend(self, backend: str) -> None:
+        self.ring.remove(backend)
+
+    # -- routing -------------------------------------------------------- #
+
+    @staticmethod
+    def _route_key(path: str) -> str:
+        """(height, row) routing key as "h:i". `/sample/<h>/<i>/<j>`
+        keys on its own row; other height-addressed routes (`/dah/<h>`,
+        `/eds/<h>`, `/proof/share/<h>:<s>:<e>`, ...) key on (height, 0)
+        so a height's metadata colocates with its row-0 samples; paths
+        with no height hash on themselves (stable, arbitrary owner)."""
+        parts = [p for p in path.split("?")[0].split("/") if p]
+        if len(parts) >= 3 and parts[0] == "sample":
+            try:
+                return f"{int(parts[1])}:{int(parts[2])}"
+            except ValueError:
+                return path
+        for part in parts[1:2] + parts[2:3]:
+            token = part.split(":")[0]
+            try:
+                return f"{int(token)}:0"
+            except ValueError:
+                continue
+        return path
+
+    def route(self, path: str, deadline_ms: str | None = None):
+        """Route one GET: pick the key's ring owner, fetch, hedge to
+        the next distinct ring position on 503/connection failure.
+        Returns (status, body, backend)."""
+        key = self._route_key(path)
+        candidates = self.ring.owners(key)
+        faults.fire("gateway.route", key=key,
+                    candidates=len(candidates))
+        if not candidates:
+            raise RuntimeError("no backends on the ring")
+        return self.fetch_hedged(path, candidates,
+                                 deadline_ms=deadline_ms)
+
+    def fetch_hedged(self, path: str, candidates: list[str],
+                     deadline_ms: str | None = None):
+        """Try candidates in order; hop on 503 (shed) or connection
+        failure, pass every other status through as the backend's
+        answer. The ring lock is NOT held here — candidates are a
+        snapshot."""
+        last_shed = None
+        last_err: Exception | None = None
+        for attempt, backend in enumerate(candidates):
+            if attempt:
+                faults.fire("gateway.hedge", backend=backend,
+                            attempt=attempt)
+                metrics.incr_counter("gateway_hedge_total")
+            req = urllib.request.Request(backend + path)
+            if deadline_ms:
+                req.add_header("X-Deadline-Ms", str(deadline_ms))
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout_s) as resp:
+                    return resp.status, resp.read(), backend
+            except urllib.error.HTTPError as e:
+                body = e.read()
+                if e.code == 503:
+                    # a shed is load placement gone wrong — exactly
+                    # what the hedge exists for
+                    metrics.incr_counter("gateway_backend_error_total",
+                                         backend=backend)
+                    last_shed = (e.code, body, backend)
+                    continue
+                return e.code, body, backend  # backend's real answer
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                metrics.incr_counter("gateway_backend_error_total",
+                                     backend=backend)
+                last_err = e
+                continue
+        if last_shed is not None:
+            return last_shed  # every candidate shed: surface the 503
+        raise ConnectionError(
+            f"every backend failed for {path}: {last_err}")
+
+    # -- aggregation ---------------------------------------------------- #
+
+    def _backend_doc(self, backend: str, path: str):
+        try:
+            with urllib.request.urlopen(backend + path,
+                                        timeout=self.timeout_s) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read() or b"{}")
+            except (ValueError, OSError):
+                return e.code, {"error": f"http {e.code}"}
+        except Exception as e:  # noqa: BLE001 — a dead backend is data
+            return None, {"error": str(e)}
+
+    def _status_doc(self) -> bytes:
+        backends = self.ring.backends()
+        per = {}
+        for backend in backends:
+            _status, doc = self._backend_doc(backend, "/status")
+            per[backend] = doc
+        heights = [d.get("height") for d in per.values()
+                   if isinstance(d.get("height"), int)]
+        return json.dumps({
+            # the MIN backend height: the head every ring member can
+            # serve — what a prober/light client should sample so a
+            # just-produced height doesn't race the slower replicas
+            "height": min(heights) if heights else 0,
+            "gateway": {
+                "url": self.url,
+                "backends": backends,
+                "ring_backends": len(self.ring),
+            },
+            "backends": per,
+        }).encode()
+
+    def _readyz_doc(self):
+        backends = self.ring.backends()
+        ready = []
+        for backend in backends:
+            status, _doc = self._backend_doc(backend, "/readyz")
+            if status == 200:
+                ready.append(backend)
+        doc = json.dumps({
+            "ready": bool(ready),
+            "ready_backends": len(ready),
+            "backends": len(backends),
+        }).encode()
+        return (200 if ready else 503), doc

@@ -210,7 +210,24 @@ class Node:
         # pages demote to checksummed host copies and fault back in on
         # access, and per-page pins keep eviction out of in-flight reads
         self._dah_cache: dict[int, object] = {}
-        self._eds_cache = PagedEdsCache()
+        self.home = pathlib.Path(home) if home else None
+        if self.home:
+            (self.home / "blocks").mkdir(parents=True, exist_ok=True)
+        # durable third tier (ADR-021): home-backed nodes persist
+        # retained squares (pages + DAH + row-tree levels) to a
+        # CRC-guarded BlockStore under home/store, re-indexed on
+        # startup so a restarted node serves deep history from disk
+        self.store = None
+        if self.home:
+            try:
+                from celestia_tpu.store import BlockStore
+
+                self.store = BlockStore(self.home / "store")
+                self.store.reindex()
+            except Exception as e:  # noqa: BLE001 — store is best-effort
+                log.info("block store unavailable", error=str(e))
+                self.store = None
+        self._eds_cache = PagedEdsCache(store=self.store)
         # per-height NMT row-prover memo for the batched sample path
         # (ADR-019): device-resident squares seed every row's subtree
         # memo from ONE device reduce (`extend_tpu.eds_row_levels_device`
@@ -219,9 +236,6 @@ class Node:
         # across batches. Entry: (levels | None, {row: prover}).
         self._prover_cache: dict[int, tuple] = {}
         self._PROVER_CACHE_HEIGHTS = 4
-        self.home = pathlib.Path(home) if home else None
-        if self.home:
-            (self.home / "blocks").mkdir(parents=True, exist_ok=True)
         # The RPC server calls in from handler threads
         # (ThreadingHTTPServer) while the node thread produces blocks.
         # State-mutating entries (CheckTx speculation, the block pipeline)
@@ -433,6 +447,7 @@ class Node:
                                   height=block.height):
                     eds = self.app.extend_block(proposal.txs)
                     self._eds_cache.put(block.height, eds)
+                self._persist_block_eds(block.height, eds)
             except Exception as e:  # noqa: BLE001 — retention is a cache
                 log.info("eds retention failed", error=str(e))
 
@@ -448,6 +463,40 @@ class Node:
         if self.home:
             path = self.home / "blocks" / f"{block.height}.json"
             path.write_text(json.dumps(block.to_json()))
+
+    def _persist_block_eds(self, height: int, eds) -> None:
+        """Best-effort durable retention: write the committed square's
+        pages + served DAH (+ device row-tree levels when the square is
+        device-resident) to the BlockStore, so a restart serves this
+        height from disk with byte-identical DAH and provers. A failed
+        put degrades to reconstruction, never fails the block."""
+        if self.store is None:
+            return
+        try:
+            import numpy as np
+
+            dah = self.block_dah(height)
+            if dah is None:
+                return
+            levels = None
+            arr = getattr(eds, "device_data", None)
+            if arr is not None:
+                try:
+                    from celestia_tpu.ops import extend_tpu
+
+                    levels = extend_tpu.eds_row_levels_device(arr)
+                except Exception:  # noqa: BLE001 — levels are optional
+                    levels = None
+            data = np.asarray(getattr(eds, "data", eds))
+            width = int(getattr(eds, "original_width",
+                                data.shape[0] // 2))
+            rpp = getattr(self._eds_cache, "rows_per_page", None) or 8
+            self.store.put_eds(height, data, width,
+                               dah_doc=dah.to_json(), levels=levels,
+                               rows_per_page=rpp)
+        except Exception as e:  # noqa: BLE001 — persistence is a cache
+            log.info("eds persistence failed", height=height,
+                     error=str(e))
 
     # --- queries ---
 
@@ -505,6 +554,15 @@ class Node:
         cached = self._eds_cache.get(height)  # cache holds its own lock
         if cached is not None:
             return cached
+        if (self.store is not None and height in self.store
+                and hasattr(self._eds_cache, "load_from_store")):
+            # restart path: adopt the persisted height page-by-page —
+            # every page starts on disk and faults in on first read
+            try:
+                return self._eds_cache.load_from_store(height)
+            except Exception as e:  # noqa: BLE001 — fall back to rebuild
+                log.info("store load failed; reconstructing",
+                         height=height, error=str(e))
         block = self.blocks.get(height)
         if block is None:
             return None
@@ -632,6 +690,12 @@ class Node:
                     from celestia_tpu.ops import extend_tpu
 
                     levels = extend_tpu.eds_row_levels_device(arr)
+                elif self.store is not None and height in self.store:
+                    # store-loaded square (no device buffer): the
+                    # persisted row-tree levels seed provers that are
+                    # byte-identical to the pre-restart ones — zero
+                    # hashing on the restart path too
+                    levels = self.store.read_levels(height)
             except Exception as exc:  # device trouble must not fail DAS
                 log.info("device prover seeding failed; host fallback",
                          height=height, error=str(exc))
@@ -697,6 +761,18 @@ class Node:
             return dah
         from celestia_tpu import da
 
+        if self.store is not None and height in self.store:
+            # serve the STORED DAH: post-restart /dah bytes must equal
+            # the pre-restart bytes exactly (the store wrote what this
+            # node served), and no square materialization is needed
+            try:
+                dah = da.DataAvailabilityHeader.from_json(
+                    self.store.read_dah(height))
+                self._dah_cache[height] = dah
+                return dah
+            except Exception as e:  # noqa: BLE001 — recompute instead
+                log.info("stored DAH unreadable; recomputing",
+                         height=height, error=str(e))
         # root computation bulk-reads a device-resident square once:
         # borrow keeps the entry pinned across that fetch
         with self._borrow_eds(height) as eds:

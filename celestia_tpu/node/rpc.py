@@ -256,6 +256,7 @@ def _handler_for(node: Node, dispatcher: DeviceDispatcher | None = None,
                     )
                 elif parts == ["status"]:
                     eds_cache = getattr(node, "_eds_cache", None)
+                    store = getattr(node, "store", None)
                     self._reply(
                         {
                             # paged EDS cache residency/flow (ADR-017):
@@ -263,6 +264,12 @@ def _handler_for(node: Node, dispatcher: DeviceDispatcher | None = None,
                             "eds_cache": (
                                 eds_cache.stats()
                                 if hasattr(eds_cache, "stats") else None
+                            ),
+                            # durable block store (ADR-021): persisted
+                            # height range + flow, mirrors store_*
+                            "store": (
+                                store.stats()
+                                if hasattr(store, "stats") else None
                             ),
                             "chain_id": node.app.chain_id,
                             "height": node.latest_height(),

@@ -59,7 +59,12 @@ def run_scenario(scenario: Scenario, *, seed: int = 1337,
     """Execute one scenario end to end; returns the scenario report."""
     if registry is None:
         from celestia_tpu.telemetry import metrics as registry
-    world = ScenarioWorld(scenario, seed, registry=registry)
+    if getattr(scenario, "fleet", 0):
+        from .fleet import FleetWorld
+
+        world = FleetWorld(scenario, seed, registry=registry)
+    else:
+        world = ScenarioWorld(scenario, seed, registry=registry)
     injector = faults.FaultInjector(campaign_rules(scenario), seed=seed)
     engine = slo.SloEngine(registry=registry)
     phases: list[dict] = []
@@ -108,6 +113,8 @@ def run_scenario(scenario: Scenario, *, seed: int = 1337,
         "scenario_slo_pass": v["pass"],
         "breaches": v["breaches"],
     }
+    if hasattr(world, "fleet_report"):
+        report["world"]["fleet"] = world.fleet_report()
     if report_path:
         with open(report_path, "w") as f:
             json.dump(report, f, indent=2)

@@ -20,6 +20,11 @@ Each is a production-emulation campaign judged by the SLO board:
                       the primary over a faulted transport (errors,
                       resets, a corrupted payload) while the flash
                       crowd continues; it must converge byte-identically.
+    gateway-fleet     a DAS flash crowd through the consistent-hash
+                      gateway over a 3-node fleet with rolling backend
+                      restarts; each restarted backend must re-index
+                      its on-disk block store and serve byte-identical
+                      DAHs from disk (ADR-021).
     smoke             the crypto-free CI gate: every engine mechanism
                       (profiles, phase-scoped campaigns, SDC drill,
                       strike/recover, windowed verdict) in a few
@@ -197,6 +202,48 @@ def _rejoin_under_load() -> Scenario:
     )
 
 
+def _gateway_fleet() -> Scenario:
+    return Scenario(
+        name="gateway-fleet",
+        description=("DAS flash crowd through the consistent-hash "
+                     "gateway over a 3-node fleet with rolling backend "
+                     "restarts; every restarted backend must re-index "
+                     "its block store and serve byte-identical DAHs "
+                     "from disk"),
+        k=4,
+        fleet=3,
+        queue_capacity=64,
+        block_interval_s=0.25,
+        initial_heights=2,
+        phases=(
+            Phase(name="warmup", duration_s=2.0, loads=(
+                LoadSpec(kind="das", clients=3),
+            )),
+            Phase(name="flash-crowd", duration_s=3.0, loads=(
+                LoadSpec(kind="das", clients=8),
+            ), campaigns=(
+                # a slow router mid-crowd: placement latency must not
+                # move availability (the backends do the real work)
+                CampaignRule(site="gateway.route", kind="delay",
+                             delay_s=0.005, times=10, after=5),
+            )),
+            Phase(name="rolling-restart-1", duration_s=3.0,
+                  enter_actions=("backend_restart",),
+                  loads=(
+                      LoadSpec(kind="das", clients=5),
+                  )),
+            Phase(name="rolling-restart-2", duration_s=3.0,
+                  enter_actions=("backend_restart",),
+                  loads=(
+                      LoadSpec(kind="das", clients=5),
+                  )),
+        ),
+        invariants=("prober_verified", "dah_byte_identical",
+                    "readyz_well_ordered",
+                    "restarted_serves_from_store"),
+    )
+
+
 def _smoke() -> Scenario:
     return Scenario(
         name="smoke",
@@ -242,7 +289,7 @@ def _smoke() -> Scenario:
 SCENARIOS = {
     fn().name: fn
     for fn in (_pfb_storm, _rolling_outage, _sdc_under_storm,
-               _rejoin_under_load, _smoke)
+               _rejoin_under_load, _gateway_fleet, _smoke)
 }
 
 

@@ -32,12 +32,13 @@ import dataclasses
 LOAD_KINDS = ("das", "pfb", "follower_sync")
 
 #: phase-boundary world actions engine.py may apply
-ACTIONS = ("tpu_strike", "tpu_recover", "sdc_clear", "follower_boot")
+ACTIONS = ("tpu_strike", "tpu_recover", "sdc_clear", "follower_boot",
+           "backend_restart")
 
 #: invariant probes verdict.py implements
 INVARIANTS = ("prober_verified", "dah_byte_identical",
               "readyz_well_ordered", "zero_undetected_sdc",
-              "follower_caught_up")
+              "follower_caught_up", "restarted_serves_from_store")
 
 #: fault sites whose bitflips are silent-data-corruption injections —
 #: the zero_undetected_sdc probe counts timeline entries at these
@@ -118,6 +119,10 @@ class Scenario:
     default_deadline_s: float = 8.0
     sdc_producer: bool = False  # produce via audited device extends
     mempool_cap: int = 512
+    # fleet mode (ADR-021): >0 boots that many store-backed backend
+    # nodes behind a consistent-hash gateway (scenarios/fleet.py) and
+    # every load/probe hits the GATEWAY url; 0 = single-node world
+    fleet: int = 0
     # verdict contract
     allowed_breaches: frozenset[str] = frozenset()
     required_breaches: frozenset[str] = frozenset()
@@ -141,3 +146,16 @@ class Scenario:
         if uses_follower and not boots_follower:
             raise ValueError("follower_sync load without a follower_boot "
                              "enter action")
+        uses_restart = any(
+            "backend_restart" in p.enter_actions + p.exit_actions
+            for p in self.phases)
+        if (uses_restart or "restarted_serves_from_store"
+                in self.invariants) and self.fleet < 2:
+            raise ValueError("backend_restart / restarted_serves_from_"
+                             "store require fleet >= 2 (the primary "
+                             "never restarts; a restartable backend "
+                             "must exist)")
+        if self.fleet and self.sdc_producer:
+            raise ValueError("fleet mode produces through the plain "
+                             "lockstep path; sdc_producer is "
+                             "single-node only")

@@ -114,6 +114,13 @@ def default_objectives() -> list[Objective]:
         Objective(name="rpc_admission", kind="ratio",
                   good="rpc_dispatch_admitted_total",
                   total="rpc_dispatch_total", target=0.9),
+        # durable-store integrity (ADR-021): a page/DAH/levels record
+        # whose CRC failed on read means data rotted ON DISK (or a
+        # torn write escaped the atomic-rename contract). The read was
+        # refused — no torn bytes served — but any occurrence is a
+        # breach: the store exists so restarts can TRUST it.
+        Objective(name="store_integrity", kind="counter_max",
+                  counter="store_read_corrupt_total", limit=0.0),
     ]
 
 

@@ -1,0 +1,474 @@
+"""Durable EDS block store — the third tier behind `PagedEdsCache`.
+
+One file per height (`<height>.ctps`) holding the three artifacts a
+restarted node needs to serve `/sample` + `/proof/share` without
+re-extending anything:
+
+    EDS row-group pages   the SAME row-group granularity the paged
+                          device cache uses (ADR-017), written at
+                          FIXED offsets so a fault-in reads one page
+                          record, never the square
+    row-tree levels       the device-computed NMT node levels
+                          (ADR-019) that seed byte-identical
+                          `NmtRowProver`s after restart (optional —
+                          crypto-free embedders persist without them)
+    DAH                   the served DataAvailabilityHeader JSON, so
+                          post-restart `/dah` bytes equal the
+                          pre-restart bytes exactly
+
+Every record payload carries its own CRC32C (same engine as the cache
+tiers, `integrity.crc32c`): a read whose checksum mismatches raises
+`IntegrityError` + `record_sdc("store.read")` — torn or rotted data is
+never returned. Writes are atomic (temp file + rename), so a crash
+mid-put leaves at worst a `.tmp` orphan, never a half-indexed height.
+
+Re-index (`reindex()`) is how a restarted node adopts the directory:
+damaged files — truncated tail records, corrupt headers, CRC-mismatched
+pages, duplicate heights — are SKIPPED with a
+`store_reindex_skipped_total{reason=...}` bump, never a startup crash.
+
+Layout (specs/store.md is the normative format doc):
+
+    header (64 bytes, fixed):
+      magic=CTPS u32-version height k share_size rows_per_page
+      page_count dah_len levels_len dah_crc levels_crc page_slot
+      header_crc (CRC32C over the preceding 52 bytes)
+    DAH JSON bytes        (dah_len,   crc = dah_crc)
+    levels blob           (levels_len, crc = levels_crc; 0 = absent)
+    page records, fixed offsets:
+      record i at  64 + dah_len + levels_len + i * (16 + page_slot)
+      record header: nbytes u32, crc u32, reserved u64
+      payload: nbytes bytes of row-major uint8 shares, zero-padded to
+      page_slot (slot = rows_per_page * 2k * share_size)
+
+Fault sites (specs/faults.md): `store.write` fires once per `put`
+before the file lands (corrupt/bitflip rules mangle the first page
+payload AFTER its CRC was computed — the on-disk-rot drill);
+`store.read` fires on every page read with the bytes in hand
+(bitflip rules mangle them BEFORE the CRC check, so the drill proves
+detection, not luck).
+
+Stdlib-importable: numpy is imported lazily inside the methods that
+touch share bytes, mirroring node/eds_cache.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pathlib
+import struct
+import threading
+
+from celestia_tpu import faults
+from celestia_tpu.integrity import IntegrityError, crc32c, record_sdc
+from celestia_tpu.log import logger
+from celestia_tpu.telemetry import metrics
+
+log = logger("store")
+
+MAGIC = b"CTPS"
+VERSION = 1
+SUFFIX = ".ctps"
+
+_HEADER = struct.Struct("<4sIQIIIIIIIII")  # 52 bytes of fields
+_HEADER_CRC = struct.Struct("<I")
+HEADER_SIZE = 64  # fields + crc, zero-padded
+_RECORD = struct.Struct("<IIQ")  # nbytes, crc, reserved
+RECORD_HEADER_SIZE = _RECORD.size
+
+DEFAULT_ROWS_PER_PAGE = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class StoreEntry:
+    """One indexed height: everything a fault-in needs to seek straight
+    to a page record without re-reading the header."""
+
+    path: pathlib.Path
+    height: int
+    k: int
+    share_size: int
+    rows_per_page: int
+    page_count: int
+    page_slot: int
+    dah_len: int
+    levels_len: int
+    dah_crc: int
+    levels_crc: int
+
+    @property
+    def page_base(self) -> int:
+        return HEADER_SIZE + self.dah_len + self.levels_len
+
+    def page_offset(self, index: int) -> int:
+        return self.page_base + index * (RECORD_HEADER_SIZE + self.page_slot)
+
+    def page_rows(self, index: int) -> int:
+        width = 2 * self.k
+        lo = index * self.rows_per_page
+        return min(self.rows_per_page, width - lo)
+
+
+def _pack_header(entry_fields: dict) -> bytes:
+    raw = _HEADER.pack(
+        MAGIC, VERSION, entry_fields["height"], entry_fields["k"],
+        entry_fields["share_size"], entry_fields["rows_per_page"],
+        entry_fields["page_count"], entry_fields["dah_len"],
+        entry_fields["levels_len"], entry_fields["dah_crc"],
+        entry_fields["levels_crc"], entry_fields["page_slot"],
+    )
+    raw += _HEADER_CRC.pack(crc32c(raw))
+    return raw.ljust(HEADER_SIZE, b"\x00")
+
+
+def pack_levels(levels) -> bytes:
+    """Serialize the per-height row-tree node levels
+    (`ops/extend_tpu.eds_row_levels_device` output: one uint8 array of
+    90-byte NMT nodes per tree level, leaves first)."""
+    import numpy as np
+
+    out = [struct.pack("<I", len(levels))]
+    for lvl in levels:
+        arr = np.ascontiguousarray(np.asarray(lvl, dtype=np.uint8))
+        rows, nodes, width = arr.shape
+        out.append(struct.pack("<III", rows, nodes, width))
+        out.append(arr.tobytes())
+    return b"".join(out)
+
+
+def unpack_levels(blob: bytes):
+    import numpy as np
+
+    (count,) = struct.unpack_from("<I", blob, 0)
+    off = 4
+    levels = []
+    for _ in range(count):
+        rows, nodes, width = struct.unpack_from("<III", blob, off)
+        off += 12
+        size = rows * nodes * width
+        arr = np.frombuffer(blob, dtype=np.uint8, count=size, offset=off)
+        levels.append(arr.reshape(rows, nodes, width).copy())
+        off += size
+    return levels
+
+
+class BlockStore:
+    """CRC32C-guarded on-disk block store under one directory.
+
+    The index (`_index`, height -> StoreEntry, plus the skip counters)
+    is guarded by `_index_lock` — declared in the specs/serving.md lock
+    order between the cache locks and the leaf locks. File I/O and CRC
+    math run UNLOCKED: records are immutable once renamed into place,
+    so readers only need the entry snapshot."""
+
+    def __init__(self, root: str | os.PathLike):
+        self.root = pathlib.Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self._index_lock = threading.Lock()
+        self._index: dict[int, StoreEntry] = {}
+        self._skipped: dict[str, int] = {}
+        self._page_reads = 0
+        self._puts = 0
+        self._write_errors = 0
+
+    # -- write ---------------------------------------------------------- #
+
+    def put_eds(self, height: int, eds_np, original_width: int, *,
+                dah_doc: dict, levels=None,
+                rows_per_page: int = DEFAULT_ROWS_PER_PAGE) -> StoreEntry:
+        """Persist one height: the host EDS array split into row-group
+        pages, the served DAH JSON, and (optionally) the device
+        row-tree levels. Atomic — the height is visible only after the
+        rename, and a re-put replaces the old file in one step."""
+        import numpy as np
+
+        arr = np.ascontiguousarray(np.asarray(eds_np, dtype=np.uint8))
+        width, _w2, share_size = arr.shape
+        if width != 2 * original_width:
+            raise ValueError(
+                f"EDS width {width} != 2*k for k={original_width}")
+        rows_per_page = max(1, min(int(rows_per_page), width))
+        page_count = -(-width // rows_per_page)
+        page_slot = rows_per_page * width * share_size
+
+        dah_bytes = json.dumps(dah_doc, sort_keys=True).encode()
+        levels_bytes = pack_levels(levels) if levels else b""
+        pages = []
+        for i in range(page_count):
+            lo = i * rows_per_page
+            hi = min(lo + rows_per_page, width)
+            payload = arr[lo:hi].tobytes()
+            pages.append((payload, crc32c(payload)))
+
+        # the write drill: corrupt/bitflip rules mangle the first page
+        # payload AFTER its CRC was computed — rot-on-disk that the
+        # next read MUST catch. Fired before any bytes land so delay/
+        # error rules hold or fail the put itself.
+        flip = faults.fire("store.write", height=height, pages=page_count)
+        if flip is not None and pages:
+            pages[0] = (flip(pages[0][0]), pages[0][1])
+
+        fields = {
+            "height": height, "k": original_width,
+            "share_size": share_size, "rows_per_page": rows_per_page,
+            "page_count": page_count, "dah_len": len(dah_bytes),
+            "levels_len": len(levels_bytes), "dah_crc": crc32c(dah_bytes),
+            "levels_crc": crc32c(levels_bytes), "page_slot": page_slot,
+        }
+        path = self.root / f"{height}{SUFFIX}"
+        tmp = self.root / f"{height}{SUFFIX}.tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(_pack_header(fields))
+                f.write(dah_bytes)
+                f.write(levels_bytes)
+                for payload, crc in pages:
+                    f.write(_RECORD.pack(len(payload), crc, 0))
+                    f.write(payload.ljust(page_slot, b"\x00"))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except Exception:
+            with self._index_lock:
+                self._write_errors += 1
+            metrics.incr_counter("store_write_error_total")
+            tmp.unlink(missing_ok=True)
+            raise
+        entry = StoreEntry(path=path, **fields)
+        with self._index_lock:
+            self._index[height] = entry
+            self._puts += 1
+        metrics.incr_counter("store_put_total")
+        self._publish()
+        return entry
+
+    # -- re-index ------------------------------------------------------- #
+
+    def reindex(self, deep: bool = True) -> dict:
+        """Scan the directory and rebuild the height index — the
+        restart path. Damaged files are skipped with a
+        `store_reindex_skipped_total{reason=...}` bump (reasons:
+        bad_header, truncated, page_crc, duplicate), never a crash.
+        `deep` additionally verifies every page record's CRC (the
+        default: CI stores are small; pass False to adopt a large
+        archive lazily and let per-read CRC checks catch rot)."""
+        found: dict[int, StoreEntry] = {}
+        skipped: dict[str, int] = {}
+
+        def skip(path: pathlib.Path, reason: str) -> None:
+            skipped[reason] = skipped.get(reason, 0) + 1
+            metrics.incr_counter("store_reindex_skipped_total",
+                                 reason=reason)
+            log.warn("store re-index skipped file", file=path.name,
+                     reason=reason)
+
+        for path in sorted(self.root.glob(f"*{SUFFIX}")):
+            entry = self._read_header(path)
+            if entry is None:
+                skip(path, "bad_header")
+                continue
+            expected = entry.page_offset(entry.page_count)
+            try:
+                size = path.stat().st_size
+            except OSError:
+                skip(path, "bad_header")
+                continue
+            if size < expected:
+                skip(path, "truncated")
+                continue
+            if entry.height in found:
+                skip(path, "duplicate")
+                continue
+            if deep and not self._verify_pages(entry):
+                skip(path, "page_crc")
+                continue
+            found[entry.height] = entry
+        with self._index_lock:
+            self._index = found
+            for reason, n in skipped.items():
+                self._skipped[reason] = self._skipped.get(reason, 0) + n
+        self._publish()
+        report = {"heights": len(found), "skipped": skipped}
+        log.info("store re-indexed", root=str(self.root), **report)
+        return report
+
+    def _read_header(self, path: pathlib.Path) -> StoreEntry | None:
+        try:
+            with open(path, "rb") as f:
+                raw = f.read(HEADER_SIZE)
+        except OSError:
+            return None
+        if len(raw) < _HEADER.size + _HEADER_CRC.size:
+            return None
+        fields = raw[: _HEADER.size]
+        (stored_crc,) = _HEADER_CRC.unpack_from(raw, _HEADER.size)
+        if crc32c(fields) != stored_crc:
+            return None
+        (magic, version, height, k, share_size, rows_per_page,
+         page_count, dah_len, levels_len, dah_crc, levels_crc,
+         page_slot) = _HEADER.unpack(fields)
+        if magic != MAGIC or version != VERSION:
+            return None
+        if k <= 0 or rows_per_page <= 0 or page_count <= 0:
+            return None
+        return StoreEntry(
+            path=path, height=height, k=k, share_size=share_size,
+            rows_per_page=rows_per_page, page_count=page_count,
+            page_slot=page_slot, dah_len=dah_len, levels_len=levels_len,
+            dah_crc=dah_crc, levels_crc=levels_crc,
+        )
+
+    def _verify_pages(self, entry: StoreEntry) -> bool:
+        try:
+            with open(entry.path, "rb") as f:
+                for i in range(entry.page_count):
+                    f.seek(entry.page_offset(i))
+                    rec = f.read(RECORD_HEADER_SIZE)
+                    nbytes, crc, _r = _RECORD.unpack(rec)
+                    payload = f.read(nbytes)
+                    if len(payload) != nbytes or crc32c(payload) != crc:
+                        return False
+        except (OSError, struct.error):
+            return False
+        return True
+
+    # -- read ----------------------------------------------------------- #
+
+    def entry(self, height: int) -> StoreEntry | None:
+        with self._index_lock:
+            return self._index.get(height)
+
+    def heights(self) -> list[int]:
+        with self._index_lock:
+            return sorted(self._index)
+
+    def __contains__(self, height: int) -> bool:
+        with self._index_lock:
+            return height in self._index
+
+    def __len__(self) -> int:
+        with self._index_lock:
+            return len(self._index)
+
+    def _require(self, height: int) -> StoreEntry:
+        entry = self.entry(height)
+        if entry is None:
+            raise KeyError(f"height {height} not in store")
+        return entry
+
+    def read_page(self, height: int, index: int):
+        """One page record -> (uint8 array (rows, 2k, share_size),
+        payload CRC32C). ONE seek + one bounded read — never the
+        square. CRC mismatch (rot, torn write, injected flip) raises
+        `IntegrityError` after `record_sdc("store.read")`; the caller
+        never sees mangled shares."""
+        import numpy as np
+
+        entry = self._require(height)
+        if not (0 <= index < entry.page_count):
+            raise IndexError(
+                f"page {index} out of range ({entry.page_count} pages)")
+        with open(entry.path, "rb") as f:
+            f.seek(entry.page_offset(index))
+            nbytes, crc, _r = _RECORD.unpack(f.read(RECORD_HEADER_SIZE))
+            payload = f.read(nbytes)
+        # the read drill: a bitflip rule mangles the bytes BEFORE the
+        # CRC check — detection proves the guard, not luck
+        flip = faults.fire("store.read", height=height, page=index)
+        if flip is not None:
+            payload = bytes(flip(payload))
+        if len(payload) != nbytes or crc32c(payload) != crc:
+            record_sdc("store.read")
+            metrics.incr_counter("store_read_corrupt_total")
+            err = IntegrityError(
+                f"store page CRC mismatch at height {height} page "
+                f"{index} — refusing to serve torn data")
+            err.site = "store.read"
+            raise err
+        with self._index_lock:
+            self._page_reads += 1
+        metrics.incr_counter("store_page_read_total")
+        rows = entry.page_rows(index)
+        arr = np.frombuffer(payload, dtype=np.uint8).reshape(
+            rows, 2 * entry.k, entry.share_size)
+        return arr, crc
+
+    def page_crcs(self, height: int) -> list[int]:
+        """Every page record's stored CRC (header reads only) — what a
+        store-seeded cache page adopts before its first fault-in."""
+        entry = self._require(height)
+        crcs = []
+        with open(entry.path, "rb") as f:
+            for i in range(entry.page_count):
+                f.seek(entry.page_offset(i))
+                _n, crc, _r = _RECORD.unpack(f.read(RECORD_HEADER_SIZE))
+                crcs.append(crc)
+        return crcs
+
+    def read_dah(self, height: int) -> dict:
+        """The stored DataAvailabilityHeader JSON doc — byte-identical
+        to what the node served before restart."""
+        entry = self._require(height)
+        with open(entry.path, "rb") as f:
+            f.seek(HEADER_SIZE)
+            raw = f.read(entry.dah_len)
+        if len(raw) != entry.dah_len or crc32c(raw) != entry.dah_crc:
+            record_sdc("store.read")
+            metrics.incr_counter("store_read_corrupt_total")
+            err = IntegrityError(
+                f"store DAH CRC mismatch at height {height}")
+            err.site = "store.read"
+            raise err
+        return json.loads(raw)
+
+    def read_levels(self, height: int):
+        """The stored row-tree node levels, or None when the height was
+        persisted without them (crypto-free embedders)."""
+        entry = self._require(height)
+        if entry.levels_len == 0:
+            return None
+        with open(entry.path, "rb") as f:
+            f.seek(HEADER_SIZE + entry.dah_len)
+            raw = f.read(entry.levels_len)
+        if len(raw) != entry.levels_len or crc32c(raw) != entry.levels_crc:
+            record_sdc("store.read")
+            metrics.incr_counter("store_read_corrupt_total")
+            err = IntegrityError(
+                f"store levels CRC mismatch at height {height}")
+            err.site = "store.read"
+            raise err
+        return unpack_levels(raw)
+
+    # -- introspection -------------------------------------------------- #
+
+    def stats(self) -> dict:
+        with self._index_lock:
+            heights = sorted(self._index)
+            skipped = dict(self._skipped)
+            page_reads = self._page_reads
+            puts = self._puts
+            write_errors = self._write_errors
+            nbytes = sum(e.page_offset(e.page_count)
+                         for e in self._index.values())
+        return {
+            "kind": "blockstore",
+            "root": str(self.root),
+            "heights": len(heights),
+            "height_lo": heights[0] if heights else None,
+            "height_hi": heights[-1] if heights else None,
+            "bytes": nbytes,
+            "puts": puts,
+            "page_reads": page_reads,
+            "write_errors": write_errors,
+            "reindex_skipped": skipped,
+        }
+
+    def _publish(self) -> None:
+        with self._index_lock:
+            n = len(self._index)
+            nbytes = sum(e.page_offset(e.page_count)
+                         for e in self._index.values())
+        metrics.set_gauge("store_heights", float(n))
+        metrics.set_gauge("store_bytes", float(nbytes))

@@ -117,6 +117,11 @@ class Registry:
             self._register(key, name, labels)
             self.gauges[key] = value
 
+    def get_gauge(self, name: str, **labels) -> float | None:
+        """Read a gauge (None if never set) — test/assert helper."""
+        with self._lock:
+            return self.gauges.get(_key(name, labels))
+
     def observe(self, name: str, value: float, **labels) -> None:
         """Record one histogram observation (seconds)."""
         key = _key(name, labels)

@@ -123,8 +123,20 @@ class RpcChaosNode(ChaosNode):
     def __init__(self, heights: int = 2, k: int = 2, seed: int = 7,
                  chain_id: str = "chaos-net",
                  paged_budget_bytes: int | None = None,
-                 rows_per_page: int = 8):
-        # paged mode first: grow() in super().__init__ feeds the cache
+                 rows_per_page: int = 8,
+                 store_dir=None):
+        # durable store first (ADR-021): a restart is modelled as a
+        # NEW instance with heights=0 over the same store_dir — the
+        # re-index adopts every persisted height, and the serve path
+        # answers from disk pages + the stored DAH bytes
+        self.store = None
+        self._rows_per_page = rows_per_page
+        if store_dir is not None:
+            from celestia_tpu.store import BlockStore
+
+            self.store = BlockStore(store_dir)
+            self.store.reindex()
+        # paged mode next: grow() in super().__init__ feeds the cache
         self._eds_cache = None
         if paged_budget_bytes is not None:
             try:
@@ -136,6 +148,7 @@ class RpcChaosNode(ChaosNode):
                     rows_per_page=rows_per_page,
                     device_byte_budget=paged_budget_bytes,
                     max_heights=1 << 30,  # heights bound by the harness
+                    store=self.store,
                 )
             except ImportError:
                 pass  # stripped environment: host squares, no paging
@@ -154,6 +167,26 @@ class RpcChaosNode(ChaosNode):
         self.started_at = time.monotonic()
         self.slo = None
         self.prober = None
+        # persist the initial blocks (idempotent: a re-put over the
+        # same deterministic chain rewrites identical records)
+        for h in sorted(self.blocks):
+            eds, dah = self.blocks[h]
+            self._persist(h, eds, dah)
+
+    def _persist(self, height: int, eds, dah) -> None:
+        """Best-effort durable write — mirrors Node._persist_block_eds
+        (crypto-free: no row-tree levels; provers rebuild host-side)."""
+        if self.store is None:
+            return
+        try:
+            import numpy as np
+
+            self.store.put_eds(height, np.asarray(eds.data),
+                               eds.original_width,
+                               dah_doc=dah.to_json(),
+                               rows_per_page=self._rows_per_page)
+        except Exception:  # noqa: BLE001 — persistence is best-effort
+            pass
 
     def grow(self) -> int:
         """Append the next height (the produce_block analogue): what
@@ -170,6 +203,8 @@ class RpcChaosNode(ChaosNode):
                 jax.device_put(eds.data), eds.original_width
             )
             self._eds_cache.put(h, dev_eds)
+        if getattr(self, "store", None) is not None:
+            self._persist(h, *self.blocks[h])
         return h
 
     # -- the Node query surface node/rpc.py's served routes touch ------ #
@@ -177,16 +212,47 @@ class RpcChaosNode(ChaosNode):
     def _eds_for(self, height: int):
         """The serving read source: the paged-cache entry when paged
         mode is on (falling back to the host square on a miss), else
-        the host ExtendedDataSquare."""
+        the host ExtendedDataSquare; store-persisted heights a fresh
+        instance never built (the restart path) are adopted from DISK
+        — page-granular through the cache when paged, else assembled
+        from CRC-verified page reads."""
         if self._eds_cache is not None:
             paged = self._eds_cache.get(height)
             if paged is not None:
                 return paged
+            if (self.store is not None and height in self.store
+                    and hasattr(self._eds_cache, "load_from_store")):
+                return self._eds_cache.load_from_store(height)
         entry = self.blocks.get(height)
-        return entry[0] if entry else None
+        if entry is not None:
+            return entry[0]
+        if self.store is not None and height in self.store:
+            import numpy as np
+
+            e = self.store.entry(height)
+            parts = [self.store.read_page(height, i)[0]
+                     for i in range(e.page_count)]
+            return da.ExtendedDataSquare(
+                np.concatenate(parts, axis=0), e.k)
+        return None
+
+    def latest_height(self) -> int:
+        top = max(self.blocks, default=0)
+        if self.store is not None:
+            stored = self.store.heights()
+            if stored:
+                top = max(top, stored[-1])
+        return top
 
     def block_dah(self, height: int):
-        return self.dah(height)
+        dah = self.dah(height)
+        if dah is not None:
+            return dah
+        if self.store is not None and height in self.store:
+            # stored DAH: post-restart /dah bytes == pre-restart bytes
+            return da.DataAvailabilityHeader.from_json(
+                self.store.read_dah(height))
+        return None
 
     def block_eds(self, height: int):
         return self._eds_for(height)

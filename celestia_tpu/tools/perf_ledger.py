@@ -79,6 +79,12 @@ TRACKED: dict[str, list[tuple[str | None, str]]] = {
     # (`make storm-bench`). Not extracted from BENCH rounds — the
     # loader folds it in from storm_ledger.json, hence no paths here.
     "storm_ms_per_accepted_sample": [],
+    # horizontal serving: per-accepted-sample wall of the fleet phase
+    # of `bench.py --gateway-fleet` (`make gateway-bench`, ADR-021) —
+    # N backends behind the consistent-hash gateway, every accepted
+    # sample NMT-verified. Folded from storm_ledger.json runs that
+    # carry the gateway series key.
+    "gateway_ms_per_accepted_sample": [],
     # robustness: contract breaches per scenario run (`make scenario-*`,
     # specs/scenarios.md) — 0 means every SLO and invariant held. Folded
     # from scenario_ledger.json; a breaching run judges as a regression
@@ -244,6 +250,11 @@ def load_ledger(root: str) -> dict[str, list[tuple[str, float]]]:
                 if isinstance(v, (int, float)):
                     ledger["storm_ms_per_accepted_sample"].append(
                         (f"storm_ledger.json#{idx}", float(v)))
+                g = (run.get("gateway_ms_per_accepted_sample")
+                     if isinstance(run, dict) else None)
+                if isinstance(g, (int, float)):
+                    ledger["gateway_ms_per_accepted_sample"].append(
+                        (f"storm_ledger.json#{idx}", float(g)))
     # scenario ledger (`python -m celestia_tpu.scenarios --ledger`):
     # each run's breach count is one point of the scenario_slo_pass
     # series — the healthy trajectory is all zeros, so any breaching

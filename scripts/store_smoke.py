@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Block-store smoke gate (specs/store.md, ADR-021, `make store-smoke`).
+
+Boots the real node/rpc.py serving stack over the crypto-free chaosnet
+facade with an on-disk BlockStore armed, and fails (non-zero exit)
+unless:
+
+  1. every produced height lands in the store (CRC32C-guarded pages +
+     DAH + record index) and /status exposes the store stats block,
+  2. a RESTART — a fresh node over the same store directory, booted
+     with zero in-memory blocks — re-indexes the store and serves
+     /dah + /sample for the persisted heights with the DAH
+     byte-identical to pre-restart and every share NMT-verified,
+  3. the restarted node's page-read counter moved (the bytes came off
+     disk, not from a cache that could not have survived the restart),
+  4. a CRC-corrupted page is REFUSED: read_page raises IntegrityError,
+     bumps `store_read_corrupt_total` + `sdc_detected_total`, and the
+     serving path answers the poisoned height without ever returning
+     torn bytes,
+  5. a truncated-tail page file and a garbage file are quarantined by
+     re-index (`store_reindex_skipped_total` moves; startup survives).
+
+CPU-only, crypto-free, seconds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def fetch(base: str, path: str):
+    req = urllib.request.Request(base + path)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except ValueError:
+            return e.code, {}
+
+
+def gate(ok: bool, what: str) -> None:
+    print(("PASS " if ok else "FAIL ") + what)
+    if not ok:
+        raise SystemExit(f"store-smoke: {what}")
+
+
+def verify_sample(dah, k: int, i: int, j: int, body: dict) -> bool:
+    from celestia_tpu.da import erasured_leaf_namespace
+    from celestia_tpu.proof import NmtRangeProof
+
+    try:
+        share = bytes.fromhex(body["share"])
+        p = body["proof"]
+        proof = NmtRangeProof(
+            start=int(p["start"]), end=int(p["end"]),
+            nodes=[bytes.fromhex(x) for x in p["nodes"]],
+            tree_size=int(p["tree_size"]),
+        )
+        ns = erasured_leaf_namespace(i, j, share, k)
+        proof.verify_inclusion(dah.row_roots[i], [ns], [share])
+        return True
+    except Exception:  # noqa: BLE001 — any verification failure counts
+        return False
+
+
+def main() -> int:
+    from celestia_tpu.node.rpc import RpcServer
+    from celestia_tpu.telemetry import metrics
+    from celestia_tpu.testutil.chaosnet import RpcChaosNode
+
+    k, heights = 4, 3
+    root = tempfile.mkdtemp(prefix="store-smoke-")
+    try:
+        # -- 1: write path ------------------------------------------- #
+        node = RpcChaosNode(heights=heights, k=k, seed=7,
+                            store_dir=root)
+        server = RpcServer(node, port=0)
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        gate(sorted(node.store.heights()) == list(range(1, heights + 1)),
+             f"all {heights} produced heights persisted to the store")
+        _status, doc = fetch(base, "/status")
+        gate(isinstance(doc.get("store"), dict)
+             and doc["store"].get("heights") == heights,
+             "/status exposes the store stats block")
+        pre_dah = {h: node.block_dah(h).hash().hex()
+                   for h in range(1, heights + 1)}
+        server.stop(drain_timeout=5.0)
+
+        # -- 2+3: restart → re-index → serve from disk ---------------- #
+        reads0 = metrics.get_counter("store_page_read_total")
+        node2 = RpcChaosNode(heights=0, k=k, seed=7, store_dir=root)
+        server2 = RpcServer(node2, port=0)
+        server2.start()
+        base2 = f"http://127.0.0.1:{server2.port}"
+        gate(node2.latest_height() == heights,
+             "restarted node re-indexed the persisted heights")
+        from celestia_tpu import da
+
+        w = 2 * k
+        verified = 0
+        for h in range(1, heights + 1):
+            status, dah_doc = fetch(base2, f"/dah/{h}")
+            gate(status == 200, f"restarted /dah/{h} answers 200")
+            dah = da.DataAvailabilityHeader.from_json(dah_doc)
+            gate(dah.hash().hex() == pre_dah[h],
+                 f"height {h} DAH byte-identical across restart")
+            for i, j in ((0, 0), (w - 1, w - 1)):
+                status, body = fetch(base2, f"/sample/{h}/{i}/{j}")
+                gate(status == 200,
+                     f"restarted /sample/{h}/{i}/{j} answers 200")
+                gate(verify_sample(dah, k, i, j, body),
+                     f"restarted sample ({h},{i},{j}) NMT-verifies")
+                verified += 1
+        gate(verified == heights * 2, f"{verified} samples verified")
+        gate(metrics.get_counter("store_page_read_total") > reads0,
+             "page-read counter moved: the shares came off disk")
+        server2.stop(drain_timeout=5.0)
+
+        # -- 4: CRC-corrupt page refused ------------------------------ #
+        from celestia_tpu.integrity import IntegrityError
+        from celestia_tpu.store import BlockStore
+
+        from celestia_tpu.store import RECORD_HEADER_SIZE
+
+        entry = node2.store.entry(2)
+        payload_at = entry.page_offset(0) + RECORD_HEADER_SIZE
+        with open(entry.path, "r+b") as f:
+            f.seek(payload_at)  # first payload byte; stored CRC kept
+            byte = f.read(1)
+            f.seek(payload_at)
+            f.write(bytes([byte[0] ^ 0x40]))
+        corrupt0 = metrics.get_counter("store_read_corrupt_total")
+        sdc0 = metrics.get_counter("sdc_detected_total", site="store.read")
+        fresh = BlockStore(root)
+        fresh.reindex(deep=False)  # shallow: the read path must catch it
+        refused = False
+        try:
+            fresh.read_page(2, 0)
+        except IntegrityError as e:
+            refused = getattr(e, "site", None) == "store.read"
+        gate(refused, "CRC-corrupt page refused with IntegrityError")
+        gate(metrics.get_counter("store_read_corrupt_total") > corrupt0,
+             "store_read_corrupt_total moved on the refusal")
+        gate(metrics.get_counter("sdc_detected_total", site="store.read")
+             > sdc0, "the refusal recorded an SDC detection")
+
+        # -- 5: re-index quarantines damage --------------------------- #
+        trunc0 = metrics.get_counter("store_reindex_skipped_total",
+                                     reason="truncated")
+        crcskip0 = metrics.get_counter("store_reindex_skipped_total",
+                                       reason="page_crc")
+        tail = node2.store.entry(3)
+        with open(tail.path, "r+b") as f:
+            f.truncate(tail.page_offset(0) + RECORD_HEADER_SIZE + 4)
+        with open(os.path.join(root, "999.ctps"), "wb") as f:
+            f.write(b"not a store page file at all")
+        survivor = BlockStore(root)
+        survivor.reindex(deep=True)
+        gate(2 not in survivor,
+             "CRC-corrupt height quarantined by deep re-index")
+        gate(3 not in survivor,
+             "truncated height quarantined, not served")
+        gate(1 in survivor,
+             "the undamaged height survives its damaged neighbors")
+        gate(metrics.get_counter("store_reindex_skipped_total",
+                                 reason="truncated") > trunc0,
+             "re-index skip counter moved for the truncated file")
+        gate(metrics.get_counter("store_reindex_skipped_total",
+                                 reason="page_crc") > crcskip0,
+             "re-index skip counter moved for the corrupt page")
+        print("store-smoke: all gates passed")
+        return 0
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -578,6 +578,34 @@ class TestFaultSiteCoverage:
             paged = cache.get(1)
             for i in range(eds.data.shape[0]):
                 paged.row(i)
+        elif site in ("store.write", "store.read"):
+            import shutil
+            import tempfile
+
+            from celestia_tpu.store import BlockStore
+
+            eds = da.extend_shares(chain_shares(2, 1))
+            dah = da.new_data_availability_header(eds)
+            root = tempfile.mkdtemp(prefix="site-coverage-")
+            try:
+                store = BlockStore(root)
+                store.put_eds(1, eds.data, eds.original_width,
+                              dah_doc=dah.to_json())
+                if site == "store.read":
+                    store.read_page(1, 0)
+            finally:
+                shutil.rmtree(root, ignore_errors=True)
+        elif site in ("gateway.route", "gateway.hedge"):
+            from celestia_tpu.node.gateway import Gateway
+
+            gw = Gateway(backends=[server.url])
+            if site == "gateway.route":
+                gw.route("/dah/1")
+            else:
+                # first candidate is a dead port: the connect failure
+                # hops to the live backend, firing the hedge site
+                gw.fetch_hedged("/dah/1",
+                                ["http://127.0.0.1:1", server.url])
         else:  # pragma: no cover — keep the list and the spec in sync
             pytest.fail(f"no driver for documented site {site!r}")
 
@@ -598,6 +626,10 @@ class TestFaultSiteCoverage:
         "dispatch.batch",
         "cache.demote",
         "cache.faultin",
+        "store.write",
+        "store.read",
+        "gateway.route",
+        "gateway.hedge",
     ])
     def test_site_fires(self, site, net):
         with faults.inject(

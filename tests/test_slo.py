@@ -165,7 +165,7 @@ class TestObjectiveDeclaration:
         names = {o.name for o in default_objectives()}
         assert names == {"sample_availability", "extend_block_p99",
                          "tpu_not_sticky_disabled", "sdc_detected",
-                         "rpc_admission"}
+                         "rpc_admission", "store_integrity"}
 
 
 # ---------------------------------------------------------------------- #
