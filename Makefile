@@ -76,9 +76,15 @@ bench:
 bench-transfers:
 	$(PY) bench.py --transfers
 
-# Tracing acceptance gate (specs/observability.md): one k=32 extend
-# under a recording, validates the Chrome trace-event JSON and requires
-# root spans to cover >=90% of the traced wall. CPU-only, seconds warm.
+# Tracing acceptance gate (specs/observability.md, ADR-022). Device
+# phase: one k=32 extend under a recording (fenced profiling sampled),
+# validates the Chrome trace-event JSON and requires root spans to
+# cover >=90% of the traced wall. Fleet phase: two backend PROCESSES
+# behind a gateway, primary drained + gateway.route fault-armed, one
+# hedged /sample; gates that trace_merge yields ONE valid trace id
+# spanning gateway route+hedge and both backends, stage sums within
+# 10% of the handler span, and rpc_stage_ms exemplars resolving to
+# real spans. CPU-only, under a minute.
 trace-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/trace_smoke.py \
 		--trace-out /tmp/trace_smoke.json

@@ -1840,18 +1840,24 @@ def main_das_storm(seconds: float = 4.0, threads: int = 32, k: int = 8,
 
 def _gateway_fleet_phase(label: str, n: int, *, seconds: float,
                          threads: int, k: int, heights: int,
-                         queue_capacity: int, deadline_ms: int):
+                         queue_capacity: int, deadline_ms: int,
+                         trace_out: str | None = None):
     """One gateway-fleet phase: n chaosnet backends (byte-identical
     replicas — same k/seed/chain) behind node/gateway.Gateway, with
     `threads` closed-loop light clients sampling random cells THROUGH
     the gateway and NMT-verifying every accepted share against the
-    canonical DAH. Returns the phase counters + samples/sec."""
+    canonical DAH. Returns the phase counters + samples/sec.
+    `trace_out` writes the phase's Chrome trace (gateway route/hedge
+    spans + every backend's handler/dispatch spans, one trace id per
+    request) to `<trace_out>.<label>.json` — merge multi-process runs
+    with tools/trace_merge."""
     import json as _json
     import random as _random
     import threading as _threading
     import urllib.error
     import urllib.request
 
+    from celestia_tpu import tracing
     from celestia_tpu.node.gateway import Gateway
     from celestia_tpu.node.rpc import RpcServer
     from celestia_tpu.scenarios.world import _verify_sample
@@ -1904,6 +1910,7 @@ def _gateway_fleet_phase(label: str, n: int, *, seconds: float,
                 with lock:
                     counts["error"] += 1
 
+    rec = tracing.record().start() if trace_out else None
     t0 = time.perf_counter()
     workers = [_threading.Thread(target=client, args=(1000 + ci,),
                                  daemon=True) for ci in range(threads)]
@@ -1914,6 +1921,12 @@ def _gateway_fleet_phase(label: str, n: int, *, seconds: float,
     for t in workers:
         t.join(timeout=10)
     wall = time.perf_counter() - t0
+    if rec is not None:
+        rec.stop()
+        path = f"{trace_out}.{label}.json"
+        rec.write(path)
+        print(f"trace written: {path} ({len(rec.spans)} spans)",
+              file=sys.stderr)
     gw.stop()
     for s in servers:
         s.stop(drain_timeout=2.0)
@@ -1933,7 +1946,8 @@ def main_gateway_fleet(seconds: float = 3.0, threads: int = 16, k: int = 8,
                        heights: int = 4, queue_capacity: int = 128,
                        deadline_ms: int = 2000, fleet: int = 3,
                        ledger: str | None = None,
-                       require_scaling: float | None = None):
+                       require_scaling: float | None = None,
+                       trace_out: str | None = None):
     """`python bench.py --gateway-fleet` / `make gateway-bench`: the
     ADR-021 horizontal-scaling config. Two phases on identical client
     load — ONE backend behind the gateway, then `fleet` backends — each
@@ -1955,7 +1969,8 @@ def main_gateway_fleet(seconds: float = 3.0, threads: int = 16, k: int = 8,
     import os as _os
 
     common = dict(seconds=seconds, threads=threads, k=k, heights=heights,
-                  queue_capacity=queue_capacity, deadline_ms=deadline_ms)
+                  queue_capacity=queue_capacity, deadline_ms=deadline_ms,
+                  trace_out=trace_out)
     single = _gateway_fleet_phase("single", 1, **common)
     fleet_phase = _gateway_fleet_phase(f"fleet-{fleet}", fleet, **common)
     scaling = (
@@ -2189,7 +2204,10 @@ if __name__ == "__main__":
         _trace_path = sys.argv[_i + 1]
         del sys.argv[_i:_i + 2]
     _rec = None
-    if _trace_path is not None:
+    # --gateway-fleet writes PER-PHASE traces inside the phases (so
+    # the single/fleet recordings don't bleed into one file) — the
+    # global recording only wraps the other modes
+    if _trace_path is not None and "--gateway-fleet" not in sys.argv:
         from celestia_tpu import tracing as _tracing
 
         _rec = _tracing.start_recording()
@@ -2250,6 +2268,8 @@ if __name__ == "__main__":
                     if _i + 1 >= len(sys.argv):
                         raise SystemExit(f"{_flag} requires a value")
                     _kw[_key] = _cast(sys.argv[_i + 1])
+            if _trace_path is not None:
+                _kw["trace_out"] = _trace_path
             main_gateway_fleet(**_kw)
         elif "--transfers" in sys.argv:
             main_transfers()

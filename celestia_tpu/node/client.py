@@ -19,7 +19,7 @@ import time
 import urllib.error
 import urllib.request
 
-from celestia_tpu import faults
+from celestia_tpu import faults, tracing
 
 
 class TransportError(Exception):
@@ -141,11 +141,29 @@ class RpcClient:
         out = self._with_retry("rpc.get", path, lambda: self._once_get(path))
         return None if out is _NOT_FOUND else out
 
+    def _trace_header(self) -> str | None:
+        """Outbound ``X-Trace-Context`` when tracing is on: continue
+        the calling thread's open span (the server's handler span then
+        parents under it) or mint a fresh context, so a client-driven
+        request chain is one fleet trace. None (no header) when
+        tracing is off — the disabled path allocates nothing."""
+        if not tracing.enabled():
+            return None
+        sp = tracing.current()
+        if isinstance(sp, tracing.Span) and sp.trace_id:
+            return tracing.header_value(sp.trace_id,
+                                        tracing.wire_span_id(sp))
+        return tracing.mint().header_value()
+
     def _once_get(self, path: str):
         corrupt = faults.fire("rpc.get", url=self.base_url + path)
+        req = urllib.request.Request(self.base_url + path)
+        header = self._trace_header()
+        if header:
+            req.add_header(tracing.TRACE_HEADER, header)
         try:
             with urllib.request.urlopen(
-                self.base_url + path, timeout=self.timeout
+                req, timeout=self.timeout
             ) as resp:
                 raw = resp.read()
         except urllib.error.HTTPError as e:
@@ -174,6 +192,9 @@ class RpcClient:
             data=json.dumps(body).encode(),
             method="POST",
         )
+        header = self._trace_header()
+        if header:
+            req.add_header(tracing.TRACE_HEADER, header)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 raw = resp.read()

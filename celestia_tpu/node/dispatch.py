@@ -94,7 +94,8 @@ class DeadlineExceeded(Exception):
 class _Job:
     __slots__ = ("fn", "label", "deadline", "enqueued_at", "done",
                  "result", "error", "lock", "abandoned", "internal",
-                 "batch_key", "batch_exec", "payload")
+                 "batch_key", "batch_exec", "payload", "origin_span",
+                 "taken_at", "stages")
 
     def __init__(self, fn, label: str, deadline: float | None,
                  internal: bool = False, batch_key=None, batch_exec=None,
@@ -112,6 +113,12 @@ class _Job:
         self.batch_key = batch_key    # hashable group key, None = unbatched
         self.batch_exec = batch_exec  # list[payload] -> list[result]
         self.payload = payload
+        # batch span links (ADR-022): the submitting thread's open span,
+        # so the dispatcher can cross-link request <-> micro-batch spans.
+        # None when tracing is off (one thread-local read).
+        self.origin_span = tracing.current()
+        self.taken_at: float | None = None  # when the loop took the job
+        self.stages: dict | None = None     # per-job stage breakdown
 
 
 class DeviceDispatcher:
@@ -278,7 +285,22 @@ class DeviceDispatcher:
             self.metrics.incr_counter("rpc_dispatch_admitted_total")
             self._set_depth_gauge_locked()
             self._cv.notify_all()
-        return self._await(job)
+        try:
+            return self._await(job)
+        finally:
+            # fold dispatcher-side stage timings (queue_wait /
+            # batch_assembly / exec breakdown) into the request thread's
+            # sink — no-op unless the RPC layer installed one. The
+            # residual between enqueue→return and the attributed stages
+            # (waiter wakeup after done.set(), scheduler overhead) is
+            # kept EXPLICIT as "wake" so the stage sum explains the
+            # handler span instead of silently under-counting
+            if job.stages:
+                wake = (time.monotonic() - job.enqueued_at
+                        - sum(job.stages.values()))
+                if wake > 0.0:
+                    job.stages["wake"] = wake
+                tracing.merge_stages(job.stages)
 
     def _shed(self, reason: str):
         self.metrics.incr_counter("rpc_shed_total", reason=reason)
@@ -351,6 +373,7 @@ class DeviceDispatcher:
                     job = self._internal.popleft()
                 else:
                     job = self._queue.popleft()
+                    job.taken_at = time.monotonic()
                     if job.batch_key is not None and self.max_batch > 1:
                         # _busy covers the gather: drain() keeps waiting
                         # for the group even though the queue looks empty
@@ -394,8 +417,10 @@ class DeviceDispatcher:
         if room <= 0 or not self._queue:
             return
         keep: collections.deque[_Job] = collections.deque()
+        taken = time.monotonic()
         for job in self._queue:
             if room > 0 and job.batch_key == key:
+                job.taken_at = taken
                 group.append(job)
                 room -= 1
             else:
@@ -431,31 +456,65 @@ class DeviceDispatcher:
         self.metrics.incr_counter("dispatch_batched_jobs_total",
                                   float(len(live)))
         self.metrics.observe("dispatch_batch_occupancy", float(len(live)))
-        with tracing.span("dispatch.batch", label=lead.label,
-                          key=str(lead.batch_key), jobs=len(live)):
-            try:
-                # dispatch.run fires once per DEVICE DISPATCH — job or
-                # micro-batch — so the documented drills (delay there
-                # stalls the single consumer; storm-lite, the deadline
-                # tests) keep working unchanged under batching.
-                # dispatch.batch is the group-specific site on top.
-                faults.fire("dispatch.run", label=lead.label)
-                faults.fire("dispatch.batch", label=lead.label,
-                            jobs=len(live))
-                results = lead.batch_exec([j.payload for j in live])
-                if results is None or len(results) != len(live):
-                    raise RuntimeError(
-                        f"batch_exec returned "
-                        f"{0 if results is None else len(results)} results "
-                        f"for {len(live)} payloads"
-                    )
-            except BaseException as e:  # noqa: BLE001 — waiters re-raise
-                self._attribute_error(e, lead.label, "dispatch.batch")
+        # batch span links (ADR-022): the batch span parents under the
+        # LEAD member's request span and records every member's span id;
+        # each member's request span records the batch span id + the
+        # occupancy it rode at. Mutating open member spans cross-thread
+        # is safe: attrs are only serialized after the waiter's span
+        # closes, which cannot happen before done.set() below.
+        origin = lead.origin_span if isinstance(lead.origin_span,
+                                                tracing.Span) else None
+        sink = tracing.push_stage_sink() if tracing.enabled() else None
+        try:
+            with tracing.span("dispatch.batch", parent=origin,
+                              label=lead.label, key=str(lead.batch_key),
+                              jobs=len(live)) as bsp:
+                if isinstance(bsp, tracing.Span):
+                    members = [j.origin_span.span_id for j in live
+                               if isinstance(j.origin_span, tracing.Span)]
+                    if members:
+                        bsp.set(member_span_ids=",".join(
+                            str(m) for m in members))
+                    for job in live:
+                        if isinstance(job.origin_span, tracing.Span):
+                            job.origin_span.set(
+                                batch_span_id=bsp.span_id,
+                                batch_occupancy=len(live))
+                try:
+                    # dispatch.run fires once per DEVICE DISPATCH — job or
+                    # micro-batch — so the documented drills (delay there
+                    # stalls the single consumer; storm-lite, the deadline
+                    # tests) keep working unchanged under batching.
+                    # dispatch.batch is the group-specific site on top.
+                    faults.fire("dispatch.run", label=lead.label)
+                    faults.fire("dispatch.batch", label=lead.label,
+                                jobs=len(live))
+                    with tracing.stage("exec"):
+                        results = lead.batch_exec(
+                            [j.payload for j in live])
+                    if results is None or len(results) != len(live):
+                        raise RuntimeError(
+                            f"batch_exec returned "
+                            f"{0 if results is None else len(results)} "
+                            f"results for {len(live)} payloads"
+                        )
+                except BaseException as e:  # noqa: BLE001 — waiters re-raise
+                    self._attribute_error(e, lead.label, "dispatch.batch")
+                    for job in live:
+                        job.error = e
+                else:
+                    for job, result in zip(live, results):
+                        job.result = result
+        finally:
+            if sink is not None:
+                tracing.pop_stage_sink()
+                shared = sink.data
                 for job in live:
-                    job.error = e
-            else:
-                for job, result in zip(live, results):
-                    job.result = result
+                    taken = job.taken_at if job.taken_at is not None else now
+                    st = {"queue_wait": max(0.0, taken - job.enqueued_at),
+                          "batch_assembly": max(0.0, now - taken)}
+                    st.update(shared)
+                    job.stages = st
         for job in live:
             with job.lock:
                 job.done.set()
@@ -499,19 +558,33 @@ class DeviceDispatcher:
                 )
                 job.done.set()
                 return
-        with tracing.span("dispatch.run", label=job.label,
-                          internal=job.internal):
-            try:
-                faults.fire("dispatch.run", label=job.label)
-                if job.fn is not None:
-                    job.result = job.fn()
-                else:
-                    # batchable job running unbatched (max_batch=1):
-                    # a singleton group through the same exec callable
-                    job.result = job.batch_exec([job.payload])[0]
-            except BaseException as e:  # noqa: BLE001 — waiter re-raises
-                self._attribute_error(e, job.label, "dispatch.run")
-                job.error = e
+        origin = job.origin_span if isinstance(job.origin_span,
+                                               tracing.Span) else None
+        sink = (tracing.push_stage_sink()
+                if not job.internal and tracing.enabled() else None)
+        try:
+            with tracing.span("dispatch.run", parent=origin,
+                              label=job.label, internal=job.internal):
+                try:
+                    faults.fire("dispatch.run", label=job.label)
+                    with tracing.stage("exec"):
+                        if job.fn is not None:
+                            job.result = job.fn()
+                        else:
+                            # batchable job running unbatched
+                            # (max_batch=1): a singleton group through
+                            # the same exec callable
+                            job.result = job.batch_exec([job.payload])[0]
+                except BaseException as e:  # noqa: BLE001 — waiter re-raises
+                    self._attribute_error(e, job.label, "dispatch.run")
+                    job.error = e
+        finally:
+            if sink is not None:
+                tracing.pop_stage_sink()
+                taken = job.taken_at if job.taken_at is not None else now
+                st = {"queue_wait": max(0.0, taken - job.enqueued_at)}
+                st.update(sink.data)
+                job.stages = st
         with job.lock:
             job.done.set()
 

@@ -46,7 +46,7 @@ import urllib.error
 import urllib.request
 from hashlib import sha256
 
-from celestia_tpu import faults
+from celestia_tpu import faults, tracing
 from celestia_tpu.log import logger
 from celestia_tpu.telemetry import metrics
 
@@ -157,19 +157,35 @@ class Gateway:
                 self.send_header("Content-Length", str(len(body)))
                 if backend:
                     self.send_header("X-Gateway-Backend", backend)
+                trace_id = getattr(self, "_trace_id", None)
+                if trace_id is not None:
+                    self.send_header(tracing.TRACE_ID_HEADER, trace_id)
                 self.end_headers()
                 try:
                     self.wfile.write(body)
                 except (BrokenPipeError, ConnectionResetError):
                     pass  # client went away; nothing to salvage
 
+            def _begin_trace(self):
+                """Inbound trace context, or a gateway-minted one when
+                tracing is on — the gateway is the fleet's front door,
+                so every request that crosses it gets a trace id."""
+                raw = self.headers.get(tracing.TRACE_HEADER)
+                ctx = tracing.extract(raw) if raw else None
+                if ctx is None and tracing.enabled():
+                    ctx = tracing.mint()
+                self._trace_id = ctx.trace_id if ctx else None
+                return ctx
+
             def do_POST(self):
+                self._begin_trace()
                 doc = json.dumps({"error": "gateway is read-only",
                                   "status": 405}).encode()
                 self._reply(405, doc)
 
             def do_GET(self):
                 metrics.incr_counter("gateway_requests_total")
+                ctx = self._begin_trace()
                 try:
                     if self.path == "/status":
                         self._reply(200, gw._status_doc())
@@ -181,9 +197,13 @@ class Gateway:
                         status, doc = gw._readyz_doc()
                         self._reply(status, doc)
                         return
+                    if self.path.split("?")[0] == "/debug/flight":
+                        self._reply(200, gw._flight_doc())
+                        return
                     status, body, backend = gw.route(
                         self.path,
-                        deadline_ms=self.headers.get("X-Deadline-Ms"))
+                        deadline_ms=self.headers.get("X-Deadline-Ms"),
+                        ctx=ctx)
                     self._reply(status, body, backend=backend)
                 except Exception as e:  # noqa: BLE001 — a routing
                     # failure (no backends, armed error rule, every
@@ -251,25 +271,39 @@ class Gateway:
                 continue
         return path
 
-    def route(self, path: str, deadline_ms: str | None = None):
+    def route(self, path: str, deadline_ms: str | None = None,
+              ctx=None):
         """Route one GET: pick the key's ring owner, fetch, hedge to
         the next distinct ring position on 503/connection failure.
-        Returns (status, body, backend)."""
+        Returns (status, body, backend). ``ctx`` is the inbound (or
+        gateway-minted) TraceContext; the ``gateway.route`` span roots
+        the routing decision under it and every hedge attempt becomes
+        a ``gateway.hedge`` child carrying backend/attempt/outcome."""
         key = self._route_key(path)
         candidates = self.ring.owners(key)
-        faults.fire("gateway.route", key=key,
-                    candidates=len(candidates))
-        if not candidates:
-            raise RuntimeError("no backends on the ring")
-        return self.fetch_hedged(path, candidates,
-                                 deadline_ms=deadline_ms)
+        with tracing.span("gateway.route", key=key,
+                          candidates=len(candidates)) as sp:
+            if isinstance(sp, tracing.Span) and ctx is not None:
+                sp.trace_id = ctx.trace_id
+                sp.set(wire_parent=ctx.span_id)
+            faults.fire("gateway.route", key=key,
+                        candidates=len(candidates))
+            if not candidates:
+                raise RuntimeError("no backends on the ring")
+            return self.fetch_hedged(path, candidates,
+                                     deadline_ms=deadline_ms, ctx=ctx)
 
     def fetch_hedged(self, path: str, candidates: list[str],
-                     deadline_ms: str | None = None):
+                     deadline_ms: str | None = None, ctx=None):
         """Try candidates in order; hop on 503 (shed) or connection
         failure, pass every other status through as the backend's
         answer. The ring lock is NOT held here — candidates are a
-        snapshot."""
+        snapshot. Each attempt (including the first) opens a
+        ``gateway.hedge`` span whose WIRE id is injected as the
+        backend's ``X-Trace-Context`` parent, so the backend's
+        handler span parents under exactly the attempt that reached
+        it; with tracing off the inbound context passes through
+        untouched."""
         last_shed = None
         last_err: Exception | None = None
         for attempt, backend in enumerate(candidates):
@@ -277,28 +311,47 @@ class Gateway:
                 faults.fire("gateway.hedge", backend=backend,
                             attempt=attempt)
                 metrics.incr_counter("gateway_hedge_total")
-            req = urllib.request.Request(backend + path)
-            if deadline_ms:
-                req.add_header("X-Deadline-Ms", str(deadline_ms))
-            try:
-                with urllib.request.urlopen(
-                        req, timeout=self.timeout_s) as resp:
-                    return resp.status, resp.read(), backend
-            except urllib.error.HTTPError as e:
-                body = e.read()
-                if e.code == 503:
-                    # a shed is load placement gone wrong — exactly
-                    # what the hedge exists for
+            with tracing.span("gateway.hedge", backend=backend,
+                              attempt=attempt) as hsp:
+                header = None
+                if isinstance(hsp, tracing.Span):
+                    if ctx is not None:
+                        hsp.trace_id = ctx.trace_id
+                    if hsp.trace_id:
+                        header = tracing.header_value(
+                            hsp.trace_id, tracing.wire_span_id(hsp))
+                if header is None and ctx is not None:
+                    header = ctx.header_value()
+                req = urllib.request.Request(backend + path)
+                if deadline_ms:
+                    req.add_header("X-Deadline-Ms", str(deadline_ms))
+                if header:
+                    req.add_header(tracing.TRACE_HEADER, header)
+                try:
+                    with urllib.request.urlopen(
+                            req, timeout=self.timeout_s) as resp:
+                        hsp.set(outcome="served", status=resp.status)
+                        return resp.status, resp.read(), backend
+                except urllib.error.HTTPError as e:
+                    body = e.read()
+                    if e.code == 503:
+                        # a shed is load placement gone wrong — exactly
+                        # what the hedge exists for
+                        metrics.incr_counter(
+                            "gateway_backend_error_total",
+                            backend=backend)
+                        hsp.set(outcome="shed", status=e.code)
+                        last_shed = (e.code, body, backend)
+                        continue
+                    hsp.set(outcome="served", status=e.code)
+                    return e.code, body, backend  # backend's real answer
+                except (urllib.error.URLError, OSError,
+                        TimeoutError) as e:
                     metrics.incr_counter("gateway_backend_error_total",
                                          backend=backend)
-                    last_shed = (e.code, body, backend)
+                    hsp.set(outcome="connect_fail", error=str(e))
+                    last_err = e
                     continue
-                return e.code, body, backend  # backend's real answer
-            except (urllib.error.URLError, OSError, TimeoutError) as e:
-                metrics.incr_counter("gateway_backend_error_total",
-                                     backend=backend)
-                last_err = e
-                continue
         if last_shed is not None:
             return last_shed  # every candidate shed: surface the 503
         raise ConnectionError(
@@ -338,6 +391,38 @@ class Gateway:
                 "ring_backends": len(self.ring),
             },
             "backends": per,
+        }).encode()
+
+    def _flight_doc(self) -> bytes:
+        """Fleet flight view (ADR-022): the gateway's own flight ring
+        plus every backend's `/debug/flight`, merged and grouped by
+        trace id — the post-incident "which backends did this request
+        touch" answer without shipping trace files anywhere. Spans
+        with no trace id (tracing off, or internal work) are counted
+        but not shipped."""
+        per_source: dict[str, list[dict]] = {"gateway": tracing.flight()}
+        for backend in self.ring.backends():
+            _status, doc = self._backend_doc(backend, "/debug/flight")
+            spans = doc.get("spans") if isinstance(doc, dict) else None
+            per_source[backend] = spans if isinstance(spans, list) else []
+        by_trace: dict[str, list[dict]] = {}
+        untraced = 0
+        for source, spans in per_source.items():
+            for span in spans:
+                if not isinstance(span, dict):
+                    continue
+                tid = span.get("trace_id")
+                if not tid:
+                    untraced += 1
+                    continue
+                rec = dict(span)
+                rec["source"] = source
+                by_trace.setdefault(tid, []).append(rec)
+        return json.dumps({
+            "enabled": tracing.enabled(),
+            "sources": {s: len(v) for s, v in per_source.items()},
+            "traces": by_trace,
+            "untraced_spans": untraced,
         }).encode()
 
     def _readyz_doc(self):

@@ -732,17 +732,26 @@ class Node:
             if not valid:
                 return out
             rows_needed = sorted({coords[t][0] for t in valid})
-            if hasattr(eds, "rows_batch"):
-                rows = dict(zip(rows_needed, eds.rows_batch(rows_needed)))
-            elif hasattr(eds, "original_width"):
-                rows = {i: eds.row(i) for i in rows_needed}
-            else:
-                rows = {i: [bytes(eds[i, c]) for c in range(w)]
-                        for i in rows_needed}
-            docs = das_sample_docs(rows, [coords[t] for t in valid],
-                                   w // 2,
-                                   provers=self._row_provers(
-                                       height, eds, rows_needed))
+            # stage attribution (ADR-022): "device" covers the row
+            # fetch (transfers records its d2h share separately and
+            # stage() subtracts nested time, so the breakdown stays
+            # disjoint); "prove" covers prover seeding + NMT proving.
+            # Both are shared no-ops unless the dispatcher installed a
+            # stage sink, i.e. tracing is enabled.
+            with tracing.stage("device"):
+                if hasattr(eds, "rows_batch"):
+                    rows = dict(zip(rows_needed,
+                                    eds.rows_batch(rows_needed)))
+                elif hasattr(eds, "original_width"):
+                    rows = {i: eds.row(i) for i in rows_needed}
+                else:
+                    rows = {i: [bytes(eds[i, c]) for c in range(w)]
+                            for i in rows_needed}
+            with tracing.stage("prove"):
+                docs = das_sample_docs(rows, [coords[t] for t in valid],
+                                       w // 2,
+                                       provers=self._row_provers(
+                                           height, eds, rows_needed))
         for t, doc in zip(valid, docs):
             out[t] = doc
         return out

@@ -39,7 +39,7 @@ import threading
 import time
 import urllib.request
 
-from celestia_tpu import faults
+from celestia_tpu import faults, tracing
 from celestia_tpu.log import logger
 
 log = logger("prober")
@@ -69,15 +69,21 @@ class Prober:
         self.last: dict = {}  # newest cycle summary (served in /debug/slo)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._ctx = None  # current cycle's TraceContext (tracing on)
 
     # -- transport ----------------------------------------------------- #
 
     def _get(self, path: str):
         """One GET through the probe.request fault site. Raises on any
-        transport/HTTP/parse failure — the caller counts it."""
+        transport/HTTP/parse failure — the caller counts it. Carries
+        the cycle's ``X-Trace-Context`` when tracing is on, so every
+        fetch of one probe cycle lands in ONE fleet trace."""
         url = self.base_url + path
         faults.fire("probe.request", url=url)
-        with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+        req = urllib.request.Request(url)
+        if self._ctx is not None:
+            req.add_header(tracing.TRACE_HEADER, self._ctx.header_value())
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             return json.loads(resp.read())
 
     # -- one probe cycle ----------------------------------------------- #
@@ -87,6 +93,9 @@ class Prober:
         it). Returns the cycle summary; never raises."""
         summary = {"ok": False, "samples": 0, "sample_ok": 0,
                    "share_proofs": 0, "share_proof_ok": 0, "error": None}
+        self._ctx = tracing.mint() if tracing.enabled() else None
+        if self._ctx is not None:
+            summary["trace_id"] = self._ctx.trace_id
         try:
             status = self._get("/status")
             height = int(status.get("height", 0))
@@ -254,6 +263,17 @@ class Prober:
         self.metrics.incr_counter("probe_cycle_total")
         if summary["ok"]:
             self.metrics.incr_counter("probe_cycle_ok_total")
+        elif self._ctx is not None:
+            # zero-duration annotation: a failed cycle drops a pin in
+            # the trace timeline carrying ITS trace id, so "which
+            # request chain did the prober see break" is one flight/
+            # trace lookup instead of a log-to-metrics join
+            now = time.perf_counter()
+            tracing.emit("probe.fail", now, end=now,
+                         trace_id=self._ctx.trace_id,
+                         error=str(summary.get("error") or "probe failed"),
+                         samples=summary["samples"],
+                         sample_ok=summary["sample_ok"])
         total = self.metrics.get_counter("probe_sample_total")
         good = self.metrics.get_counter("probe_sample_ok_total")
         if total:

@@ -107,6 +107,14 @@ def _track(tracker: _InflightTracker | None):
     return tracker if tracker is not None else contextlib.nullcontext()
 
 
+def _server_timing(stages: dict) -> str:
+    """Server-Timing-style header value: ``stage;dur=ms`` entries."""
+    return ", ".join(
+        f"{name};dur={seconds * 1000.0:.3f}"
+        for name, seconds in stages.items()
+    )
+
+
 def _legacy_sample_work(node, h: int, i: int, j: int):
     """The pre-batching /sample body, kept for duck-typed nodes without
     `sample_batch`. Same document bytes as the batched path."""
@@ -145,14 +153,57 @@ def _handler_for(node: Node, dispatcher: DeviceDispatcher | None = None,
             sp = tracing.current()  # the rpc.request span, when tracing
             if sp is not None:
                 sp.set(status=status)
-            body = json.dumps(payload).encode()
+            sink = tracing.active_stage_sink()
+            if sink is not None:
+                t0 = time.perf_counter()
+                body = json.dumps(payload).encode()
+                sink.add("serialize", time.perf_counter() - t0)
+            else:
+                body = json.dumps(payload).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            # X-Trace-Id rides EVERY response — 503 sheds, 504
+            # deadlines, and JSON 400/404/500 error bodies included —
+            # so shed storms are correlatable from the client side
+            trace_id = getattr(self, "_trace_id", None)
+            if trace_id is not None:
+                self.send_header(tracing.TRACE_ID_HEADER, trace_id)
+            if sink is not None and sink.data:
+                self.send_header("Server-Timing",
+                                 _server_timing(sink.data))
+                for stage, seconds in sink.data.items():
+                    metrics.observe("rpc_stage_ms", seconds,
+                                    exemplar=trace_id, stage=stage)
+                if sp is not None:
+                    sp.set(**{f"stage_{stage}_ms":
+                              round(seconds * 1000.0, 3)
+                              for stage, seconds in sink.data.items()})
             for name, value in (headers or {}).items():
                 self.send_header(name, value)
             self.end_headers()
             self.wfile.write(body)
+
+        def _begin_trace(self, sp):
+            """Bind the request span into the caller's trace (ADR-022):
+            a valid inbound `X-Trace-Context` roots this span under the
+            caller's wire span; otherwise a fresh trace id is minted
+            when tracing is on. Malformed headers are counted
+            (`trace_context_invalid_total`) and ignored — never a 500.
+            Returns the per-request stage sink (None when tracing is
+            off, keeping the disabled path allocation-free)."""
+            raw = self.headers.get(tracing.TRACE_HEADER)
+            ctx = tracing.extract(raw) if raw is not None else None
+            if isinstance(sp, tracing.Span):
+                if ctx is not None:
+                    sp.trace_id = ctx.trace_id
+                    sp.set(wire_parent=ctx.span_id)
+                else:
+                    sp.trace_id = tracing.mint_trace_id()
+                self._trace_id = sp.trace_id
+                return tracing.push_stage_sink()
+            self._trace_id = ctx.trace_id if ctx is not None else None
+            return None
 
         def _deadline_s(self) -> float:
             """Server default deadline, CAPPED by the client's
@@ -228,8 +279,13 @@ def _handler_for(node: Node, dispatcher: DeviceDispatcher | None = None,
         def do_GET(self):
             with _track(tracker), \
                     tracing.span("rpc.request", method="GET",
-                                 path=self.path.split("?", 1)[0]):
-                self._route_get()
+                                 path=self.path.split("?", 1)[0]) as sp:
+                sink = self._begin_trace(sp)
+                try:
+                    self._route_get()
+                finally:
+                    if sink is not None:
+                        tracing.pop_stage_sink()
 
         def _route_get(self):
             parts = [p for p in self.path.split("/") if p]
@@ -241,6 +297,9 @@ def _handler_for(node: Node, dispatcher: DeviceDispatcher | None = None,
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain; version=0.0.4")
                     self.send_header("Content-Length", str(len(body)))
+                    trace_id = getattr(self, "_trace_id", None)
+                    if trace_id is not None:
+                        self.send_header(tracing.TRACE_ID_HEADER, trace_id)
                     self.end_headers()
                     self.wfile.write(body)
                 elif parts == ["debug", "flight"]:
@@ -904,8 +963,13 @@ def _handler_for(node: Node, dispatcher: DeviceDispatcher | None = None,
         def do_POST(self):
             with _track(tracker), \
                     tracing.span("rpc.request", method="POST",
-                                 path=self.path):
-                self._route_post()
+                                 path=self.path) as sp:
+                sink = self._begin_trace(sp)
+                try:
+                    self._route_post()
+                finally:
+                    if sink is not None:
+                        tracing.pop_stage_sink()
 
         def _route_post(self):
             from celestia_tpu import faults

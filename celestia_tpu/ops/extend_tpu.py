@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -309,6 +310,25 @@ def _jitted_roots_for_k(k: int):
     return run
 
 
+def _profile_fence(out, entry: str, dispatch_start: float,
+                   **attrs) -> None:
+    """Fenced device-time profiling (ADR-022, opt-in): when this
+    dispatch is profile-sampled, block until the result is ready and
+    emit a ``profile.fence`` span covering dispatch→ready — the REAL
+    device completion time the async dispatch queue hides from wall
+    spans. Off by default (``tracing.enable_profiling``): a fence
+    serializes the device stream, which costs exactly the
+    dispatch/fetch overlap the resident paths exist to keep."""
+    if not tracing.profile_sample():
+        return
+    try:
+        jax.block_until_ready(out)
+        tracing.emit("profile.fence", dispatch_start, entry=entry,
+                     fenced=True, **attrs)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def extend_roots_device(shares: np.ndarray):
     """Host deployment entry: (k,k,512) uint8 -> numpy (eds, row_roots,
     col_roots); the caller computes the DAH hash host-side (da module)."""
@@ -322,7 +342,9 @@ def extend_roots_device(shares: np.ndarray):
         # covers dispatch through the host fetch of all three outputs
         with tracing.span("extend.rs_nmt", backend="tpu", k=k,
                           fused="rs+nmt"):
+            t0 = time.perf_counter()
             eds, rows, cols = _jitted_roots_for_k(k)(dev)
+            _profile_fence(cols, "extend_roots_device", t0, k=k)
         # SDC model: the result tensor is damaged in flight (HBM upset,
         # bad D2H) — the audit below must catch what the flip injects
         flip = faults.fire("device.extend.output",
@@ -354,7 +376,9 @@ def extend_roots_device_resident(shares: np.ndarray):
             dev = jnp.asarray(shares)
         with tracing.span("extend.rs_nmt", backend="tpu", k=k,
                           fused="rs+nmt"):
+            t0 = time.perf_counter()
             eds, rows, cols = _jitted_roots_for_k(k)(dev)
+            _profile_fence(cols, "extend_roots_device_resident", t0, k=k)
         flip = faults.fire("device.extend.output",
                            entry="extend_roots_device_resident")
         if flip is not None:
@@ -385,7 +409,9 @@ def eds_roots_device(eds):
     k = int(eds.shape[0]) // 2
     with tracing.span("extend.nmt", backend="tpu", k=k,
                       entry="eds_roots_device"):
+        t0 = time.perf_counter()
         rows, cols = _jitted_eds_roots(k)(jnp.asarray(eds))
+        _profile_fence(cols, "eds_roots_device", t0, k=k)
         return np.asarray(rows), np.asarray(cols)
 
 
@@ -413,7 +439,9 @@ def eds_row_levels_device(eds) -> list[np.ndarray]:
     k = int(eds.shape[0]) // 2
     with tracing.span("extend.nmt_levels", backend="tpu", k=k,
                       entry="eds_row_levels_device"):
+        t0 = time.perf_counter()
         levels = _jitted_row_levels(k)(jnp.asarray(eds))
+        _profile_fence(levels[-1], "eds_row_levels_device", t0, k=k)
         return [np.asarray(lv) for lv in levels]
 
 
@@ -766,7 +794,9 @@ def roots_device(shares: np.ndarray):
             dev = jnp.asarray(shares)
         with tracing.span("extend.rs_nmt", backend="tpu", k=k,
                           fused="rs+nmt"):
+            t0 = time.perf_counter()
             rows, cols = _jitted_roots_noeds(k)(dev)
+            _profile_fence(cols, "roots_device", t0, k=k)
             return np.asarray(rows), np.asarray(cols)
 
 
@@ -792,7 +822,9 @@ def batched_roots_device(shares):
         chunk = _batch_chunk(k, b)
         if chunk >= b:
             stacked = shares if isinstance(shares, np.ndarray) else np.stack(shares)
+            t0 = time.perf_counter()
             rows, cols = _jitted_batched_roots(k)(jnp.asarray(stacked))
+            _profile_fence(cols, "batched_roots_device", t0, k=k, batch=b)
             return np.asarray(rows), np.asarray(cols)
         if chunk > 1:
             fn = _jitted_chunk_roots(k, chunk)
@@ -831,6 +863,8 @@ def extend_and_root_device(shares: np.ndarray):
             dev = jnp.asarray(shares)
         with tracing.span("extend.rs_nmt", backend="tpu", k=k,
                           fused="rs+nmt+dah"):
+            t0 = time.perf_counter()
             eds, rows, cols, dah = _jitted_for_k(k)(dev)
+            _profile_fence(dah, "extend_and_root_device", t0, k=k)
             return (np.asarray(eds), np.asarray(rows), np.asarray(cols),
                     np.asarray(dah))

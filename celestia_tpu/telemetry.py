@@ -92,6 +92,10 @@ class Registry:
         # the exposition needs the name/labels split back apart for
         # HELP/TYPE grouping, suffixing, and label escaping
         self._families: dict[str, tuple[str, tuple[tuple[str, str], ...]]] = {}
+        # rendered key -> (trace_id, value): the LAST exemplar per
+        # histogram key — fixed-size per key, so the bounded-memory
+        # contract of Histogram holds
+        self._exemplars: dict[str, tuple[str, float]] = {}
 
     def _register(self, key: str, name: str, labels: dict) -> None:
         if key not in self._families:
@@ -122,8 +126,11 @@ class Registry:
         with self._lock:
             return self.gauges.get(_key(name, labels))
 
-    def observe(self, name: str, value: float, **labels) -> None:
-        """Record one histogram observation (seconds)."""
+    def observe(self, name: str, value: float, exemplar: str | None = None,
+                **labels) -> None:
+        """Record one histogram observation (seconds). ``exemplar``
+        attaches a trace id to the observation (last one per key is
+        kept), linking the metric back to a concrete span."""
         key = _key(name, labels)
         with self._lock:
             self._register(key, name, labels)
@@ -131,6 +138,13 @@ class Registry:
             if hist is None:
                 hist = self.timings[key] = Histogram(self._buckets)
             hist.observe(value)
+            if exemplar is not None:
+                self._exemplars[key] = (exemplar, value)
+
+    def get_exemplar(self, name: str, **labels) -> tuple[str, float] | None:
+        """The last (trace_id, value) exemplar of a histogram key."""
+        with self._lock:
+            return self._exemplars.get(_key(name, labels))
 
     def measure_since(self, name: str, start: float, **labels) -> None:
         self.observe(name, time.perf_counter() - start, **labels)
@@ -215,6 +229,17 @@ class Registry:
                 )
                 lines.append(f"{name}_sum{_label_str(labels)} {hist.sum}")
                 lines.append(f"{name}_count{_label_str(labels)} {hist.count}")
+                ex = self._exemplars.get(_key(name[: -len("_seconds")],
+                                              dict(labels)))
+                if ex is not None:
+                    # comment lines other than HELP/TYPE are legal in the
+                    # v0.0.4 text format; OpenMetrics-style `# {...}`
+                    # exemplar suffixes are not, so exemplars ride as
+                    # their own comment line scrapers ignore
+                    lines.append(
+                        f"# EXEMPLAR {name}{_label_str(labels)} "
+                        f"trace_id={ex[0]} value={ex[1]}"
+                    )
 
     def reset(self) -> None:
         with self._lock:
@@ -222,6 +247,7 @@ class Registry:
             self.gauges.clear()
             self.timings.clear()
             self._families.clear()
+            self._exemplars.clear()
 
 
 class _Timer:

@@ -1,0 +1,171 @@
+"""Fleet trace assembly: merge per-process Chrome traces into ONE
+timeline (ADR-022).
+
+Every process (`bench.py --trace-out`, a gateway, each backend node)
+writes its OWN Chrome trace file on its OWN clock: span timestamps are
+``perf_counter`` readings shifted by a per-process epoch offset
+captured at import, so two processes' timelines disagree by however
+far apart their imports sampled the wall clock (plus drift). Loading
+three such files into Perfetto side by side shows three disjoint
+timelines — useless for "where did this hedged request spend its
+150 ms".
+
+This module stitches them. The clock handshake needs no extra
+protocol because trace propagation already embeds one: a gateway
+``gateway.hedge`` span records the wire span id it injected as
+``X-Trace-Context``, and the backend's ``rpc.request`` span records
+that same id as ``args.wire_parent``. Each matched pair is an
+NTP-style exchange — the hedge span brackets the backend span under
+symmetric network delay, so the midpoint difference estimates the
+backend clock's offset from the gateway clock. The MEDIAN over all
+matched pairs per file rejects outliers (a slow reply skews one pair,
+not the median), and every event in that file shifts by it.
+
+Pid collisions (a recycled OS pid across files) are remapped so
+Perfetto keeps the processes' tracks separate; `process_name`
+metadata events gain the source label. The merged document passes
+``tracing.validate_chrome_trace`` — the trace-smoke gate relies on
+that.
+
+CLI:  python -m celestia_tpu.tools.trace_merge --out merged.json \
+          gateway.json backend0.json backend1.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+
+from celestia_tpu.tracing import validate_chrome_trace
+
+# span names that carry an injected wire id (args.wire_span_id) on the
+# CALLER side of a clock handshake
+_CALLER_SPANS = ("gateway.hedge",)
+# span names that record the caller's wire id (args.wire_parent) on the
+# CALLEE side
+_CALLEE_SPANS = ("rpc.request",)
+
+
+def _events(doc: dict) -> list[dict]:
+    evs = doc.get("traceEvents")
+    return evs if isinstance(evs, list) else []
+
+
+def _mid(ev: dict) -> float:
+    return float(ev["ts"]) + float(ev.get("dur", 0.0)) / 2.0
+
+
+def _handshakes(doc: dict, *, side: str) -> dict[str, dict]:
+    """wire id -> span event for one side of the clock handshake."""
+    names = _CALLER_SPANS if side == "caller" else _CALLEE_SPANS
+    key = "wire_span_id" if side == "caller" else "wire_parent"
+    out: dict[str, dict] = {}
+    for ev in _events(doc):
+        if ev.get("ph") != "X" or ev.get("name") not in names:
+            continue
+        args = ev.get("args")
+        wire = args.get(key) if isinstance(args, dict) else None
+        if isinstance(wire, str) and wire:
+            out[wire] = ev
+    return out
+
+
+def clock_offsets(docs: list[dict]) -> list[float]:
+    """Per-file offset in µs to SUBTRACT from every timestamp, bringing
+    all files onto the caller (gateway) file's clock. A file with no
+    matched handshake keeps offset 0 — its epoch offset already
+    approximates wall clock, which is the best available anchor."""
+    callers = [_handshakes(d, side="caller") for d in docs]
+    callees = [_handshakes(d, side="callee") for d in docs]
+    offsets = [0.0] * len(docs)
+    for i, callee in enumerate(callees):
+        deltas: list[float] = []
+        for j, caller in enumerate(callers):
+            if i == j:
+                continue  # same process, same clock — nothing to learn
+            for wire, ev in callee.items():
+                mate = caller.get(wire)
+                if mate is not None:
+                    # midpoint of the callee's handler span minus the
+                    # midpoint of the caller's bracketing hedge span:
+                    # how far the callee's clock runs AHEAD
+                    deltas.append(_mid(ev) - _mid(mate))
+        if deltas:
+            offsets[i] = statistics.median(deltas)
+    return offsets
+
+
+def merge_traces(docs: list[dict],
+                 labels: list[str] | None = None) -> dict:
+    """Merge per-process Chrome trace documents into one, on the
+    caller file's clock, with colliding pids remapped. Returns the
+    merged document (validate with ``validate_chrome_trace``)."""
+    if labels is not None and len(labels) != len(docs):
+        raise ValueError("labels must match docs one-to-one")
+    offsets = clock_offsets(docs)
+    merged: list[dict] = []
+    used_pids: set[int] = set()
+    for i, doc in enumerate(docs):
+        label = labels[i] if labels else f"file{i}"
+        # one remap per (file, original pid): keeps a file's own
+        # threads together while separating a recycled OS pid
+        remap: dict[int, int] = {}
+        for ev in _events(doc):
+            ev = dict(ev)
+            pid = ev.get("pid")
+            if isinstance(pid, int):
+                if pid not in remap:
+                    new = pid
+                    while new in used_pids:
+                        new += 1_000_000
+                    remap[pid] = new
+                    used_pids.add(new)
+                ev["pid"] = remap[pid]
+            if ev.get("ph") == "X" and offsets[i]:
+                ev["ts"] = round(float(ev["ts"]) - offsets[i], 1)
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                args = dict(ev.get("args") or {})
+                args["name"] = f"{args.get('name', 'celestia_tpu')} [{label}]"
+                ev["args"] = args
+            merged.append(ev)
+    return {"traceEvents": merged, "displayTimeUnit": "ms"}
+
+
+def merge_files(out_path: str, in_paths: list[str]) -> dict:
+    docs = []
+    for p in in_paths:
+        with open(p) as f:
+            docs.append(json.load(f))
+    doc = merge_traces(docs, labels=list(in_paths))
+    problems = validate_chrome_trace(doc)
+    if problems:
+        raise ValueError(f"merged trace invalid: {problems[:5]}")
+    with open(out_path, "w") as f:
+        json.dump(doc, f)
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-process Chrome traces onto one clock")
+    ap.add_argument("inputs", nargs="+", help="per-process trace files")
+    ap.add_argument("--out", required=True, help="merged trace path")
+    args = ap.parse_args(argv)
+    doc = merge_files(args.out, args.inputs)
+    traces = {
+        ev.get("args", {}).get("trace_id")
+        for ev in doc["traceEvents"]
+        if isinstance(ev.get("args"), dict) and ev["args"].get("trace_id")
+    }
+    print(json.dumps({
+        "out": args.out,
+        "files": len(args.inputs),
+        "events": len(doc["traceEvents"]),
+        "trace_ids": len(traces),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -50,16 +50,94 @@ FLIGHT_CAPACITY = 256
 # land near wall-clock time in trace UIs
 _EPOCH_OFFSET = time.time() - time.perf_counter()
 
+# ---------------------------------------------------------------------- #
+# cross-process trace context (specs/observability.md, ADR-022)
+#
+# W3C-traceparent-style header: ``00-<trace_id>-<span_id>-<flags>`` where
+# trace_id is 32 lowercase hex (128-bit, minted once per request by the
+# client/prober/gateway), span_id is a 16-hex WIRE span id, and flags is
+# 2 hex. Local span ids are a per-process counter; the wire form prefixes
+# the low 32 bits of the pid so ids from different fleet processes never
+# collide in a merged trace: ``pid8hex + local_id8hex``.
+
+TRACE_HEADER = "X-Trace-Context"
+TRACE_ID_HEADER = "X-Trace-Id"
+
+
+class TraceContext:
+    """Parsed ``X-Trace-Context``: the caller's trace id and wire span id."""
+
+    __slots__ = ("trace_id", "span_id", "flags")
+
+    def __init__(self, trace_id: str, span_id: str, flags: int = 1):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.flags = flags
+
+    def header_value(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-{self.flags:02x}"
+
+    def __repr__(self) -> str:  # debugging aid only
+        return f"TraceContext({self.header_value()!r})"
+
+
+def mint_trace_id() -> str:
+    """Fresh 128-bit trace id (lowercase hex)."""
+    return os.urandom(16).hex()
+
+
+def wire_span_id(span_or_id) -> str:
+    """16-hex fleet-unique span id: pid low bits + local span id."""
+    local = span_or_id.span_id if isinstance(span_or_id, Span) else span_or_id
+    return f"{os.getpid() & 0xFFFFFFFF:08x}{(local or 0) & 0xFFFFFFFF:08x}"
+
+
+def mint(trace_id: str | None = None) -> TraceContext:
+    """Mint an outbound context (client/prober side). The span id is a
+    fresh wire id so backend spans have a well-formed remote parent even
+    when the caller doesn't open a local span."""
+    return TraceContext(trace_id or mint_trace_id(),
+                        wire_span_id(_tracer.new_id()))
+
+
+def header_value(trace_id: str, span_id: str, flags: int = 1) -> str:
+    return f"00-{trace_id}-{span_id}-{flags:02x}"
+
+
+def extract(raw: str | None) -> TraceContext | None:
+    """Parse an inbound ``X-Trace-Context`` header. Malformed values are
+    COUNTED (``trace_context_invalid_total``) and ignored — a bad header
+    must never fail the request."""
+    if raw is None:
+        return None
+    try:
+        version, trace_id, span_id, flags = raw.strip().split("-")
+        if (len(version) == 2 and len(trace_id) == 32 and len(span_id) == 16
+                and len(flags) == 2 and int(trace_id, 16) != 0):
+            int(version, 16)
+            int(span_id, 16)
+            return TraceContext(trace_id.lower(), span_id.lower(),
+                                int(flags, 16))
+    except ValueError:
+        pass
+    try:
+        from celestia_tpu.telemetry import metrics
+
+        metrics.incr_counter("trace_context_invalid_total")
+    except Exception:  # noqa: BLE001 — counting never breaks the request
+        pass
+    return None
+
 
 class Span:
     """One timed operation. Context manager; ``set()`` attaches
     attributes; finished spans are immutable records in the sinks."""
 
     __slots__ = ("name", "span_id", "parent_id", "tid", "start", "duration",
-                 "attrs", "status", "_fault_mark")
+                 "attrs", "status", "trace_id", "_fault_mark")
 
     def __init__(self, name: str, span_id: int, parent_id: int | None,
-                 attrs: dict):
+                 attrs: dict, trace_id: str | None = None):
         self.name = name
         self.span_id = span_id
         self.parent_id = parent_id
@@ -68,6 +146,7 @@ class Span:
         self.duration = 0.0
         self.attrs = attrs
         self.status = "ok"
+        self.trace_id = trace_id
         self._fault_mark = _fault_mark()
 
     def set(self, **attrs) -> "Span":
@@ -102,6 +181,8 @@ class Span:
             "dur_us": round(self.duration * 1e6, 1),
             "status": self.status,
         }
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
         if self.attrs:
             d["attrs"] = {k: _coerce(v) for k, v in self.attrs.items()}
         return d
@@ -114,6 +195,11 @@ class Span:
             args["parent_id"] = self.parent_id
         if self.status != "ok":
             args["status"] = self.status
+        if self.trace_id is not None:
+            # cross-process fields ride in args: the top-level Chrome
+            # event key set is pinned by the schema golden test
+            args["trace_id"] = self.trace_id
+            args["wire_span_id"] = wire_span_id(self)
         return {
             "name": self.name,
             "cat": self.name.split(".", 1)[0],
@@ -141,6 +227,7 @@ class _NoopSpan:
     __slots__ = ()
     span_id = None
     parent_id = None
+    trace_id = None
     name = ""
     attrs: dict = {}
 
@@ -292,6 +379,10 @@ def enabled() -> bool:
 def reset() -> None:
     """Test helper: drop all sinks and disable."""
     _tracer.reset()
+    disable_profiling()
+    sinks = getattr(_stage_local, "sinks", None)
+    if sinks:
+        sinks.clear()
 
 
 def span(name: str, parent: Span | None | object = ...,  # ... = implicit
@@ -307,8 +398,11 @@ def span(name: str, parent: Span | None | object = ...,  # ... = implicit
     if parent is ...:
         stack = _stack(create=False)
         parent = stack[-1] if stack else None
-    parent_id = parent.span_id if isinstance(parent, Span) else None
-    return Span(name, _tracer.new_id(), parent_id, attrs)
+    if isinstance(parent, Span):
+        parent_id, trace_id = parent.span_id, parent.trace_id
+    else:
+        parent_id = trace_id = None
+    return Span(name, _tracer.new_id(), parent_id, attrs, trace_id=trace_id)
 
 
 def current() -> Span | None:
@@ -318,17 +412,23 @@ def current() -> Span | None:
     return stack[-1] if stack else None
 
 
-def emit(name: str, start: float, end: float | None = None, **attrs) -> None:
+def emit(name: str, start: float, end: float | None = None,
+         trace_id: str | None = None, **attrs) -> None:
     """Record an already-timed operation as a finished span (``start``/
     ``end`` are perf_counter readings). Used by call sites that already
     measure themselves — e.g. ops/transfers reuses its counter timing as
-    the span, so the span and the transfer_ms metric cannot disagree."""
+    the span, so the span and the transfer_ms metric cannot disagree.
+    ``trace_id`` stamps the span into a cross-process trace (else it
+    inherits the calling thread's innermost open span's)."""
     if not _tracer.enabled:
         return
     stack = _stack(create=False)
     parent = stack[-1] if stack else None
+    if trace_id is None and parent is not None:
+        trace_id = parent.trace_id
     sp = Span(name, _tracer.new_id(),
-              parent.span_id if parent is not None else None, attrs)
+              parent.span_id if parent is not None else None, attrs,
+              trace_id=trace_id)
     sp.start = start
     sp.duration = (end if end is not None else time.perf_counter()) - start
     _capture_faults(sp)
@@ -342,6 +442,150 @@ def flight() -> list[dict]:
 
 def flight_capacity() -> int:
     return _tracer._flight.maxlen or 0
+
+
+# ---------------------------------------------------------------------- #
+# stage-level latency attribution (ADR-022)
+#
+# A STAGE SINK is a per-thread accumulator of named stage durations
+# (queue_wait / batch_assembly / device / d2h / prove / serialize / exec)
+# for one request. The RPC handler installs one on the request thread;
+# the dispatcher installs its own on the dispatcher thread around
+# batch_exec and hands each member job its share afterwards. ``stage()``
+# records SELF time: nested stage time recorded during the block is
+# subtracted, so the per-request breakdown is a disjoint decomposition
+# whose sum tracks the end-to-end span. Everything here is inert (one
+# thread-local getattr) unless a sink was explicitly installed, and
+# sinks are only installed when tracing is enabled — the disabled hot
+# path stays allocation-free.
+
+_stage_local = threading.local()
+
+
+class StageSink:
+    """Per-request stage accumulator. ``marked`` totals every second
+    added, letting ``stage()`` compute self time for nested stages."""
+
+    __slots__ = ("data", "marked")
+
+    def __init__(self):
+        self.data: dict[str, float] = {}
+        self.marked = 0.0
+
+    def add(self, name: str, seconds: float) -> None:
+        self.data[name] = self.data.get(name, 0.0) + seconds
+        self.marked += seconds
+
+
+class _StageTimer:
+    __slots__ = ("sink", "name", "start", "mark")
+
+    def __init__(self, sink: StageSink, name: str):
+        self.sink = sink
+        self.name = name
+
+    def __enter__(self) -> "_StageTimer":
+        self.mark = self.sink.marked
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *_exc) -> bool:
+        elapsed = time.perf_counter() - self.start
+        nested = self.sink.marked - self.mark
+        self.sink.add(self.name, max(0.0, elapsed - nested))
+        return False
+
+
+def push_stage_sink() -> StageSink:
+    """Install a fresh sink on the calling thread (stacked)."""
+    stack = getattr(_stage_local, "sinks", None)
+    if stack is None:
+        stack = _stage_local.sinks = []
+    sink = StageSink()
+    stack.append(sink)
+    return sink
+
+
+def pop_stage_sink() -> StageSink | None:
+    stack = getattr(_stage_local, "sinks", None)
+    if stack:
+        return stack.pop()
+    return None
+
+
+def active_stage_sink() -> StageSink | None:
+    stack = getattr(_stage_local, "sinks", None)
+    return stack[-1] if stack else None
+
+
+def stage(name: str):
+    """Time a stage into the active sink; shared no-op without one."""
+    sink = active_stage_sink()
+    return _NOOP if sink is None else _StageTimer(sink, name)
+
+
+def add_stage(name: str, seconds: float) -> None:
+    """Add pre-measured stage time (ops/transfers reuses its counter
+    timing, same convention as ``emit``)."""
+    sink = active_stage_sink()
+    if sink is not None:
+        sink.add(name, seconds)
+
+
+def merge_stages(stages: dict | None) -> None:
+    """Fold stages measured on another thread (the dispatcher) into the
+    calling thread's sink — the request thread calls this after its job
+    completes."""
+    if not stages:
+        return
+    sink = active_stage_sink()
+    if sink is not None:
+        for name, seconds in stages.items():
+            sink.add(name, seconds)
+
+
+# ---------------------------------------------------------------------- #
+# fenced device-time profiling (ADR-022)
+#
+# Async XLA dispatch returns before the device finishes, so wall spans
+# around jitted calls measure DISPATCH wall — honest for throughput,
+# a lie for device time. Profile mode brackets a 1-in-N sample of the
+# jitted extend/fused-hash/batched-read calls with block_until_ready()
+# fences and emits ``profile.fence`` spans carrying the fenced time.
+# OFF BY DEFAULT and opt-in only: a fence serializes the device stream,
+# which is exactly the overlap ADR-019's numbers depend on.
+
+_prof_lock = threading.Lock()
+_prof_every = 0          # 0 = profiling disabled
+_prof_counter = 0
+
+
+def enable_profiling(sample_every: int = 16) -> None:
+    """Fence 1 in ``sample_every`` jitted dispatches (opt-in)."""
+    global _prof_every, _prof_counter
+    with _prof_lock:
+        _prof_every = max(1, int(sample_every))
+        _prof_counter = 0
+
+
+def disable_profiling() -> None:
+    global _prof_every
+    with _prof_lock:
+        _prof_every = 0
+
+
+def profiling_enabled() -> bool:
+    return _prof_every > 0
+
+
+def profile_sample() -> bool:
+    """True when THIS dispatch should be fenced (counter-sampled)."""
+    if _prof_every == 0:
+        return False
+    global _prof_counter
+    with _prof_lock:
+        _prof_counter += 1
+        return _prof_counter % _prof_every == 0
 
 
 # ---------------------------------------------------------------------- #
