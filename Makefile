@@ -9,7 +9,7 @@ PY ?= python
 	scenario-smoke scenario-pfb-storm scenario-rolling-outage \
 	scenario-sdc-under-storm scenario-rejoin-under-load \
 	scenario-gateway-fleet scenarios \
-	kernel-smoke bench-fused analyze
+	kernel-smoke bench-fused analyze multichip-smoke multichip-bench
 
 # Static analysis gate (specs/analysis.md, ADR-020): AST-level
 # concurrency lint (lock ordering vs the specs/serving.md partial
@@ -218,6 +218,30 @@ scenario-gateway-fleet:
 scenarios: scenario-pfb-storm scenario-rolling-outage \
 	scenario-sdc-under-storm scenario-rejoin-under-load \
 	scenario-gateway-fleet
+
+# Multi-chip block-pipeline smoke gate (specs/parallel.md §Block
+# pipeline): stream blocks through the 3-deep H2D/compute/D2H pipeline
+# on a virtual 8-device mesh and gate host-oracle DAH byte-parity for
+# every retired block, device-seeded prover parity, per-stage overlap
+# (pipelined wall < sum of fenced serial stage walls), and graceful
+# mid-stream drain. CPU-only, crypto-free, <120 s warm.
+multichip-smoke:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) scripts/multichip_smoke.py
+
+# Scale-out throughput gate: 1 device vs a (1, 8) virtual host mesh
+# streaming the same block sequence through the pipeline in scrubbed
+# child processes. Gates DAH + device-seeded prover byte-parity across
+# phases and a no-collapse scaling floor. k=32 so per-block arithmetic
+# dominates the mesh's fixed dispatch/collective overhead (at k=8 that
+# overhead is most of the wall and the ratio says nothing); the fused
+# int8-psum program holds >= 0.7 even on the 1-core CI box — real
+# headroom needs chips. --ledger feeds the higher-is-better
+# multichip_blocks_per_sec series `make bench-gate` judges.
+multichip-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --multichip-pipeline \
+		--devices 8 --blocks 12 --k 32 \
+		--require-scaling 0.7 --ledger storm_ledger.json
 
 # The driver's multichip compile/execute check on a virtual CPU mesh.
 dryrun:

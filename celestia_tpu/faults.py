@@ -24,6 +24,7 @@ touching production code paths:
     store.read             block-store page read          (store/__init__.py)
     gateway.route          gateway ring routing decision  (node/gateway.py)
     gateway.hedge          gateway hedged retry hop       (node/gateway.py)
+    pipeline.block         block-pipeline admission       (node/pipeline.py)
 
 The dispatch trio drives overload drills deterministically: a ``delay``
 rule at ``dispatch.run`` stalls the single dispatcher thread, which

@@ -328,20 +328,23 @@ class DeviceDispatcher:
 
     # -- the internal lane (device sub-operations) --------------------- #
 
-    def run_device(self, fn):
+    def run_device(self, fn, label: str = "run_device"):
         """Execute `fn` on the dispatcher thread WITHOUT admission
         control — the funnel for device sub-operations of work the node
         already accepted (sliced serving reads via
-        `transfers.register_device_executor`, blob staging at CheckTx).
-        Runs inline when called from the dispatcher thread itself (no
-        self-deadlock) or when no dispatcher thread is running; falls
-        back to inline if the dispatcher cannot serve it within the
-        default deadline (the read must complete either way)."""
+        `transfers.register_device_executor`, blob staging at CheckTx,
+        the block pipeline's staged H2D/compute/D2H legs, node/
+        pipeline.py). `label` names the sub-operation in the
+        dispatch.run span and error attribution. Runs inline when
+        called from the dispatcher thread itself (no self-deadlock) or
+        when no dispatcher thread is running; falls back to inline if
+        the dispatcher cannot serve it within the default deadline (the
+        read must complete either way)."""
         thread = self._thread
         if thread is None or not thread.is_alive() or \
                 threading.current_thread() is thread:
             return fn()
-        job = _Job(fn, "run_device", None, internal=True)
+        job = _Job(fn, label, None, internal=True)
         with self._cv:
             if not self._running:
                 return fn()

@@ -498,6 +498,60 @@ class Node:
             log.info("eds persistence failed", height=height,
                      error=str(e))
 
+    # --- the multi-chip block pipeline (specs/parallel.md) ---
+
+    def extend_pipeline(self, k: int, depth: int = 3):
+        """A 3-deep H2D/compute/D2H block pipeline bound to this node
+        (node/pipeline.py): feed consecutive (height, shares) squares —
+        block replay, proposal bursts, catching-up streams — and each
+        retired block lands exactly where the inline retention path
+        puts it (paged serving cache, prover memo seeded from the
+        device level stack, DAH memo, durable store), with the three
+        legs of CONSECUTIVE blocks overlapped instead of serialized.
+        Device work rides the attached dispatcher's internal lane, so
+        the single-stream-owner rule (ADR-016) holds under load."""
+        from celestia_tpu.node.pipeline import BlockPipeline
+
+        def adopt(block):
+            with self._lock:
+                self._adopt_pipelined_block(block)
+
+        return BlockPipeline(k, dispatcher=self.dispatcher, depth=depth,
+                             on_block=adopt)
+
+    def _adopt_pipelined_block(self, block) -> None:
+        """Install one retired PipelinedBlock into the node's serving
+        state — the pipeline's equivalent of extend-retention plus
+        `_persist_block_eds`, sourced from the already-fetched outputs
+        (no recompute, no second device pass). Called under `_lock`."""
+        from celestia_tpu import da
+
+        dah = da.DataAvailabilityHeader(
+            [r.tobytes() for r in block.row_roots],
+            [c.tobytes() for c in block.col_roots],
+        )
+        self._dah_cache[block.height] = dah
+        if block.eds is not None:
+            try:
+                self._eds_cache.put(block.height, block.eds)
+            except Exception as e:  # noqa: BLE001 — retention is a cache
+                log.info("pipelined eds retention failed",
+                         height=block.height, error=str(e))
+        if block.levels is not None:
+            while len(self._prover_cache) >= self._PROVER_CACHE_HEIGHTS:
+                self._prover_cache.pop(next(iter(self._prover_cache)))
+            self._prover_cache[block.height] = (block.levels, {})
+        if self.store is not None and block.eds is not None:
+            try:
+                rpp = getattr(self._eds_cache, "rows_per_page", None) or 8
+                self.store.put_eds(
+                    block.height, block.eds, block.eds.shape[0] // 2,
+                    dah_doc=dah.to_json(), levels=block.levels,
+                    rows_per_page=rpp)
+            except Exception as e:  # noqa: BLE001 — persistence is a cache
+                log.info("pipelined eds persistence failed",
+                         height=block.height, error=str(e))
+
     # --- queries ---
 
     def status(self) -> dict:

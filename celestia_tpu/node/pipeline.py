@@ -1,0 +1,226 @@
+"""3-deep multi-chip block pipeline (specs/parallel.md §Block pipeline).
+
+A proposer (or catching-up replayer) streaming consecutive blocks spends
+its wall time in three legs with disjoint hardware: H2D staging (copy
+engines), the sharded extend+NMT program (compute), and D2H fetch of the
+roots/levels plus prover seeding (copy engines + host). Run serially,
+each block pays all three; this pipeline keeps every leg occupied —
+while block N−1's results stream back and its provers seed, block N is
+mid-compute and block N+1's shares are staging. The TPU-serving shape
+from the paper set (PAPERS.md, "Ragged Paged Attention"): the win at
+this layer comes from stage occupancy, not a faster kernel.
+
+Mechanics:
+
+- `feed(height, shares)` admits one block: the H2D leg stages the
+  square (row-sharded over the active mesh when one is configured —
+  `parallel.configure_mesh`), the compute leg dispatches the jitted
+  extend (`ops/extend_tpu.extend_root_levels_staged`, the mesh-routed
+  device-in/device-out entry whose FUSED sharded program emits roots
+  and the full prover level stack in one dispatch, hashing each NMT
+  leaf once). Both are ASYNC — jax dispatch returns
+  before the DMA/compute completes — so `feed` returns quickly until
+  the pipeline is `depth` blocks deep, at which point it retires the
+  OLDEST block with the blocking D2H/prove leg and returns it.
+- Device work funnels through the dispatcher's internal lane
+  (`DeviceDispatcher.run_device`, labelled per leg) when a dispatcher
+  is attached, preserving the ADR-016 single-stream-owner rule; with no
+  dispatcher the legs run inline (embedding, bench children).
+- Arenas are double-buffered by construction: each in-flight record
+  keeps its staged input arena alive exactly until retirement, and
+  `depth` bounds the set — with the default depth of 3, at most the
+  staging block's and the computing block's input arenas are live
+  (the retiring block's compute has already consumed its operand).
+- `begin_drain()` closes admission (`Shed("draining")`, the dispatcher
+  vocabulary); `drain()` retires everything in flight oldest-first and
+  returns the tail — the graceful mid-stream stop the smoke gate pins.
+
+Fault site: `pipeline.block` fires in `feed` before staging — an
+`error` rule sheds the block at the door, a `bitflip` rule damages the
+staged shares and must be caught by the ADR-015 audits downstream.
+
+Telemetry: `pipeline_blocks_total` counts retired blocks,
+`pipeline_fed_total` admitted ones, `pipeline_inflight` gauges the
+current depth, and each leg's wall lands in the `pipeline_stage`
+histogram plus a `pipeline.stage` span (stage=h2d|compute|d2h). The
+per-leg walls measure time spent IN the call — exactly the quantity
+overlap is supposed to shrink on the async legs.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+
+import numpy as np
+
+from celestia_tpu import faults, tracing
+from celestia_tpu.node.dispatch import Shed
+from celestia_tpu.telemetry import metrics
+
+
+class PipelinedBlock:
+    """One retired block: numpy roots + DAH, the (optionally fetched)
+    EDS bytes, and the device-computed row-tree level stack that seeds
+    proof.NmtRowProver.from_node_levels with zero host hashing."""
+
+    __slots__ = ("height", "eds", "row_roots", "col_roots", "dah",
+                 "levels")
+
+    def __init__(self, height, eds, row_roots, col_roots, dah, levels):
+        self.height = height
+        self.eds = eds
+        self.row_roots = row_roots
+        self.col_roots = col_roots
+        self.dah = dah
+        self.levels = levels
+
+
+class BlockPipeline:
+    DEFAULT_DEPTH = 3
+
+    def __init__(self, k: int, *, dispatcher=None,
+                 depth: int = DEFAULT_DEPTH, on_block=None,
+                 fetch_eds: bool = True, row_levels: bool = True):
+        self.k = int(k)
+        self.dispatcher = dispatcher
+        self.depth = max(1, int(depth))
+        self.on_block = on_block          # callable(PipelinedBlock)
+        self.fetch_eds = bool(fetch_eds)  # False: drop EDS bytes at retire
+        self.row_levels = bool(row_levels)
+        self._inflight: collections.deque = collections.deque()
+        self._draining = False
+        self._fed = 0
+        self._retired = 0
+        self._stage_wall = {"h2d": 0.0, "compute": 0.0, "d2h": 0.0}
+
+    # -- introspection -------------------------------------------------- #
+
+    @property
+    def inflight(self) -> int:
+        return len(self._inflight)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def stats(self) -> dict:
+        """Counters + per-leg wall seconds (in-call time; the async legs
+        shrink as overlap engages — the smoke gate compares their sum
+        against a fenced serial reference)."""
+        return {
+            "fed": self._fed,
+            "retired": self._retired,
+            "inflight": len(self._inflight),
+            "stage_wall_s": dict(self._stage_wall),
+        }
+
+    # -- device legs ---------------------------------------------------- #
+
+    def _run(self, fn, label: str):
+        d = self.dispatcher
+        if d is not None:
+            return d.run_device(fn, label=label)
+        return fn()
+
+    def _leg(self, stage: str, height, fn):
+        with tracing.span("pipeline.stage", stage=stage, height=height,
+                          k=self.k):
+            t0 = time.perf_counter()
+            out = self._run(fn, f"pipeline.{stage}")
+            elapsed = time.perf_counter() - t0
+        self._stage_wall[stage] += elapsed
+        try:
+            metrics.observe("pipeline_stage", elapsed, stage=stage)
+        except Exception:  # noqa: BLE001 — metrics never break the path
+            pass
+        return out
+
+    def _stage_h2d(self, shares: np.ndarray):
+        from celestia_tpu.ops import extend_tpu, transfers
+
+        mesh = extend_tpu._mesh_if_divisible(self.k)
+        if mesh is not None:
+            return transfers.device_put_sharded_rows(
+                shares, mesh, site="pipeline.h2d")
+        return transfers.device_put_chunked(shares, site="pipeline.h2d")
+
+    # -- admission / retirement ----------------------------------------- #
+
+    def feed(self, height, shares) -> PipelinedBlock | None:
+        """Admit one block; returns the block retired to make room once
+        the pipeline is `depth` deep, else None while it fills."""
+        if self._draining:
+            raise Shed("draining")
+        flip = faults.fire("pipeline.block", height=height)
+        shares = np.asarray(shares)
+        if flip is not None:
+            shares = flip(shares)
+        if shares.shape[0] != self.k:
+            raise ValueError(
+                f"pipeline built for k={self.k}, got k={shares.shape[0]}")
+        dev = self._leg("h2d", height, lambda: self._stage_h2d(shares))
+        from celestia_tpu.ops import extend_tpu
+
+        # one fused dispatch computes roots AND the prover level stack
+        # (extend_root_levels_staged); the level-less variant skips the
+        # tree outputs entirely
+        compute = (extend_tpu.extend_root_levels_staged if self.row_levels
+                   else extend_tpu.extend_and_root_staged)
+        outs = self._leg("compute", height, lambda: compute(dev))
+        # dev rides in the record: the arena stays alive until this
+        # block retires (double-buffering contract, module docstring)
+        self._inflight.append((height, dev, outs))
+        self._fed += 1
+        try:
+            metrics.incr_counter("pipeline_fed_total")
+            metrics.set_gauge("pipeline_inflight",
+                              float(len(self._inflight)))
+        except Exception:  # noqa: BLE001
+            pass
+        if len(self._inflight) >= self.depth:
+            return self._retire()
+        return None
+
+    def _retire(self) -> PipelinedBlock:
+        height, _dev, outs = self._inflight.popleft()
+        eds, rows, cols, dah = outs[:4]
+        dev_levels = outs[4] if self.row_levels else None
+
+        def fetch():
+            # pure D2H: the level stack came out of the fused compute
+            # dispatch, so retirement never launches device work
+            levels = ([np.asarray(lv) for lv in dev_levels]
+                      if dev_levels is not None else None)
+            eds_np = np.asarray(eds) if self.fetch_eds else None
+            return (eds_np, np.asarray(rows), np.asarray(cols),
+                    np.asarray(dah), levels)
+
+        eds_np, rows_np, cols_np, dah_np, levels = self._leg(
+            "d2h", height, fetch)
+        block = PipelinedBlock(height, eds_np, rows_np, cols_np, dah_np,
+                               levels)
+        self._retired += 1
+        try:
+            metrics.incr_counter("pipeline_blocks_total")
+            metrics.set_gauge("pipeline_inflight",
+                              float(len(self._inflight)))
+        except Exception:  # noqa: BLE001
+            pass
+        if self.on_block is not None:
+            self.on_block(block)
+        return block
+
+    def begin_drain(self) -> None:
+        """Close admission: subsequent `feed` calls raise
+        Shed("draining"); in-flight blocks still retire via `drain`."""
+        self._draining = True
+
+    def drain(self) -> list[PipelinedBlock]:
+        """Retire every in-flight block oldest-first and return them.
+        Admission stays closed; safe to call repeatedly."""
+        self.begin_drain()
+        out = []
+        while self._inflight:
+            out.append(self._retire())
+        return out

@@ -329,21 +329,149 @@ def _profile_fence(out, entry: str, dispatch_start: float,
         pass
 
 
+# ------------------------------------------------------------------ #
+# Production mesh routing (specs/parallel.md §Production routing): when
+# an operator configures a device mesh (parallel.configure_mesh), the
+# roots/levels host entries below route through the explicit-collective
+# row-sharded spelling in celestia_tpu/parallel. Row-block sharding
+# matches the NMT tree, so the sharded outputs are byte-identical to
+# the single-device programs — flipping the mesh on is purely a
+# placement decision. The state lives HERE because parallel imports
+# this module at import time; the sharded builders are fetched lazily
+# inside the jit caches to keep the import graph acyclic.
+
+_ACTIVE_MESH = None
+
+
+def set_active_mesh(mesh) -> None:
+    """Install (None clears) the process-wide mesh. Public entry:
+    parallel.configure_mesh. Drops the sharded jit caches — their
+    compiled programs bake in the mesh they were traced under."""
+    global _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+    _jitted_rowsharded.cache_clear()
+    _jitted_rowsharded_roots.cache_clear()
+    _jitted_rowsharded_levels.cache_clear()
+    _jitted_rowsharded_full.cache_clear()
+
+
+def active_mesh():
+    return _ACTIVE_MESH
+
+
+def _mesh_if_divisible(n_rows: int):
+    """The active mesh when the row-sharded spelling can place n_rows
+    rows on its 'sp' axis (exact division), else None — the caller
+    falls back to the single-device program, so a k that does not
+    divide the mesh degrades instead of erroring."""
+    m = _ACTIVE_MESH
+    if m is None or n_rows % m.shape["sp"]:
+        return None
+    return m
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_rowsharded(k: int):
+    from celestia_tpu import parallel
+
+    return parallel.extend_and_root_rowsharded(_ACTIVE_MESH, k)
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_rowsharded_roots(k: int):
+    """Roots-only sharded spelling: the EDS stays out of the jit
+    outputs (XLA drops the dead reassembly), matching roots_device's
+    no-EDS-materialization contract on the mesh path."""
+    from celestia_tpu import parallel
+
+    inner = parallel.extend_and_root_rowsharded(_ACTIVE_MESH, k)
+    return jax.jit(lambda s: inner(s)[1:])
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_rowsharded_levels(k: int):
+    from celestia_tpu import parallel
+
+    return parallel.eds_row_levels_rowsharded(_ACTIVE_MESH, k)
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_rowsharded_full(k: int):
+    from celestia_tpu import parallel
+
+    return parallel.extend_root_levels_rowsharded(_ACTIVE_MESH, k)
+
+
+def _stage_sharded(arr, mesh):
+    """H2D-stage a row-sharded operand: each row block lands directly
+    on its 'sp' shard instead of one device plus an in-program reshard.
+    Host arrays ride the telemetered transfer path; device-resident
+    inputs (levels over an extend output) reshard without a host
+    round-trip."""
+    if isinstance(arr, np.ndarray):
+        from celestia_tpu.ops import transfers
+
+        return transfers.device_put_sharded_rows(arr, mesh,
+                                                 site="extend.stage")
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.device_put(
+        arr, NamedSharding(mesh, PartitionSpec("sp", None, None))
+    )
+
+
+def extend_and_root_staged(dev):
+    """Device-in, device-out extend for the block pipeline
+    (node/pipeline.py): operands are already staged (possibly
+    mesh-sharded) and outputs stay device arrays so consecutive blocks
+    overlap on the async dispatch queue. Routed through the row-sharded
+    spelling when a mesh is active. Returns (eds, rows, cols, dah)."""
+    k = int(dev.shape[0])
+    mesh = _mesh_if_divisible(k)
+    if mesh is not None:
+        return _jitted_rowsharded(k)(dev)
+    return _jitted_for_k(k)(dev)
+
+
+def extend_root_levels_staged(dev):
+    """Device-in, device-out extend + roots + EVERY row-tree level for
+    the block pipeline's compute leg. On the mesh path this is ONE
+    sharded dispatch per block — the fused spelling hashes each NMT leaf
+    digest once and derives the level stack from the same leaf tensors
+    the root reductions consume (parallel.extend_root_levels_rowsharded)
+    — where the unfused pair (extend_and_root_staged +
+    eds_row_levels_device) pays two dispatches and a second full leaf
+    SHA pass. Falls back to the unfused single-device jits when no mesh
+    divides k. Returns (eds, rows, cols, dah, levels_tuple), all device
+    arrays, byte-identical to the unfused pair either way."""
+    k = int(dev.shape[0])
+    mesh = _mesh_if_divisible(k)
+    if mesh is not None:
+        return _jitted_rowsharded_full(k)(dev)
+    eds, rows, cols, dah = _jitted_for_k(k)(dev)
+    return eds, rows, cols, dah, tuple(_jitted_row_levels(k)(eds))
+
+
 def extend_roots_device(shares: np.ndarray):
     """Host deployment entry: (k,k,512) uint8 -> numpy (eds, row_roots,
     col_roots); the caller computes the DAH hash host-side (da module)."""
     k = int(shares.shape[0])
+    mesh = _mesh_if_divisible(k)
     with tracing.span("extend.device", backend="tpu", k=k,
                       entry="extend_roots_device"):
         faults.fire("device.extend", entry="extend_roots_device")
         with tracing.span("extend.stage", backend="tpu", k=k):
-            dev = jnp.asarray(shares)
+            dev = (_stage_sharded(shares, mesh) if mesh is not None
+                   else jnp.asarray(shares))
         # RS extend + NMT reduction are ONE fused XLA program; the span
         # covers dispatch through the host fetch of all three outputs
         with tracing.span("extend.rs_nmt", backend="tpu", k=k,
-                          fused="rs+nmt"):
+                          fused="rs+nmt", sharded=mesh is not None):
             t0 = time.perf_counter()
-            eds, rows, cols = _jitted_roots_for_k(k)(dev)
+            if mesh is not None:
+                eds, rows, cols, _dah = _jitted_rowsharded(k)(dev)
+            else:
+                eds, rows, cols = _jitted_roots_for_k(k)(dev)
             _profile_fence(cols, "extend_roots_device", t0, k=k)
         # SDC model: the result tensor is damaged in flight (HBM upset,
         # bad D2H) — the audit below must catch what the flip injects
@@ -369,15 +497,20 @@ def extend_roots_device_resident(shares: np.ndarray):
     handle directly (ops/repair_tpu.stage_resident_repair) with no
     host round-trip. ref: app/extend_block.go:14."""
     k = int(shares.shape[0])
+    mesh = _mesh_if_divisible(k)
     with tracing.span("extend.device", backend="tpu", k=k,
                       entry="extend_roots_device_resident"):
         faults.fire("device.extend", entry="extend_roots_device_resident")
         with tracing.span("extend.stage", backend="tpu", k=k):
-            dev = jnp.asarray(shares)
+            dev = (_stage_sharded(shares, mesh) if mesh is not None
+                   else jnp.asarray(shares))
         with tracing.span("extend.rs_nmt", backend="tpu", k=k,
-                          fused="rs+nmt"):
+                          fused="rs+nmt", sharded=mesh is not None):
             t0 = time.perf_counter()
-            eds, rows, cols = _jitted_roots_for_k(k)(dev)
+            if mesh is not None:
+                eds, rows, cols, _dah = _jitted_rowsharded(k)(dev)
+            else:
+                eds, rows, cols = _jitted_roots_for_k(k)(dev)
             _profile_fence(cols, "extend_roots_device_resident", t0, k=k)
         flip = faults.fire("device.extend.output",
                            entry="extend_roots_device_resident")
@@ -437,10 +570,16 @@ def eds_row_levels_device(eds) -> list[np.ndarray]:
     depth PR 7 left open). ~2·(2k)²·90 B crosses the interconnect —
     3 MB at k=64 — instead of the host paying O(w²) SHA per height."""
     k = int(eds.shape[0]) // 2
+    mesh = _mesh_if_divisible(2 * k)  # sp shards the 2k EDS rows here
     with tracing.span("extend.nmt_levels", backend="tpu", k=k,
-                      entry="eds_row_levels_device"):
+                      entry="eds_row_levels_device",
+                      sharded=mesh is not None):
         t0 = time.perf_counter()
-        levels = _jitted_row_levels(k)(jnp.asarray(eds))
+        if mesh is not None:
+            dev = _stage_sharded(eds, mesh)
+            levels = _jitted_rowsharded_levels(k)(dev)
+        else:
+            levels = _jitted_row_levels(k)(jnp.asarray(eds))
         _profile_fence(levels[-1], "eds_row_levels_device", t0, k=k)
         return [np.asarray(lv) for lv in levels]
 
@@ -787,15 +926,20 @@ def roots_device(shares: np.ndarray):
     """Host entry: (k,k,512) uint8 -> numpy (row_roots, col_roots),
     jit-cached, EDS never materialized as an output."""
     k = int(shares.shape[0])
+    mesh = _mesh_if_divisible(k)
     with tracing.span("extend.device", backend="tpu", k=k,
                       entry="roots_device"):
         faults.fire("device.extend", entry="roots_device")
         with tracing.span("extend.stage", backend="tpu", k=k):
-            dev = jnp.asarray(shares)
+            dev = (_stage_sharded(shares, mesh) if mesh is not None
+                   else jnp.asarray(shares))
         with tracing.span("extend.rs_nmt", backend="tpu", k=k,
-                          fused="rs+nmt"):
+                          fused="rs+nmt", sharded=mesh is not None):
             t0 = time.perf_counter()
-            rows, cols = _jitted_roots_noeds(k)(dev)
+            if mesh is not None:
+                rows, cols, _dah = _jitted_rowsharded_roots(k)(dev)
+            else:
+                rows, cols = _jitted_roots_noeds(k)(dev)
             _profile_fence(cols, "roots_device", t0, k=k)
             return np.asarray(rows), np.asarray(cols)
 
@@ -856,15 +1000,20 @@ def batched_roots_device(shares):
 def extend_and_root_device(shares: np.ndarray):
     """Host entry: (k,k,512) uint8 numpy -> numpy (eds, row_roots, col_roots, dah)."""
     k = int(shares.shape[0])
+    mesh = _mesh_if_divisible(k)
     with tracing.span("extend.device", backend="tpu", k=k,
                       entry="extend_and_root_device"):
         faults.fire("device.extend", entry="extend_and_root_device")
         with tracing.span("extend.stage", backend="tpu", k=k):
-            dev = jnp.asarray(shares)
+            dev = (_stage_sharded(shares, mesh) if mesh is not None
+                   else jnp.asarray(shares))
         with tracing.span("extend.rs_nmt", backend="tpu", k=k,
-                          fused="rs+nmt+dah"):
+                          fused="rs+nmt+dah", sharded=mesh is not None):
             t0 = time.perf_counter()
-            eds, rows, cols, dah = _jitted_for_k(k)(dev)
+            if mesh is not None:
+                eds, rows, cols, dah = _jitted_rowsharded(k)(dev)
+            else:
+                eds, rows, cols, dah = _jitted_for_k(k)(dev)
             _profile_fence(dah, "extend_and_root_device", t0, k=k)
             return (np.asarray(eds), np.asarray(rows), np.asarray(cols),
                     np.asarray(dah))

@@ -404,6 +404,33 @@ def device_put_chunked(arr: np.ndarray, device=None, *, site: str,
     return out
 
 
+def device_put_sharded_rows(arr: np.ndarray, mesh, *, site: str):
+    """Upload a host array row-sharded over the mesh's 'sp' axis: each
+    row block lands directly on its shard (NamedSharding placement), so
+    a mesh-routed extend (specs/parallel.md §Production routing) never
+    funnels the whole square through one device and then reshards
+    inside the program. One dispatch — the runtime drives the per-shard
+    DMAs — with the same telemetry, `transfer.chunk` fault passage, and
+    sampled CRC-32C sink verification as `device_put_chunked`."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    start = time.perf_counter()
+    sharding = NamedSharding(
+        mesh, PartitionSpec("sp", *([None] * (arr.ndim - 1)))
+    )
+    eng = integrity.get()
+    verify = eng.sample_chunks(1) if eng.enabled else ()
+    want = integrity.crc32c(arr) if 0 in verify else None
+    flip = faults.fire("transfer.chunk", transfer=site, direction="h2d",
+                       index=0)
+    out = jax.device_put(arr if flip is None else flip(arr), sharding)
+    if want is not None:
+        out = _verify_put_chunk(out, arr, want, site, 0, sharding)
+    _record(site, "h2d", arr.nbytes, start)
+    return out
+
+
 def _verify_put_chunk(part, pristine, want, site, idx, device):
     """Verify one uploaded chunk at the sink (device readback CRC vs
     the source CRC); retry the DMA once from the pristine source before

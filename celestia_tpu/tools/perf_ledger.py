@@ -90,7 +90,19 @@ TRACKED: dict[str, list[tuple[str | None, str]]] = {
     # from scenario_ledger.json; a breaching run judges as a regression
     # against the all-zero baseline.
     "scenario_slo_pass": [],
+    # scale-out: aggregate blocks/sec of the mesh phase of
+    # `bench.py --multichip-pipeline` (`make multichip-bench`,
+    # specs/parallel.md §Block pipeline) — the row-sharded 3-deep
+    # pipeline on the dp·sp virtual mesh. HIGHER is better (the only
+    # such series): a collapse here means sharding overhead ate the
+    # scale-out win. Folded from storm_ledger.json runs.
+    "multichip_blocks_per_sec": [],
 }
+
+# throughput series: the regression direction is inverted — the gate
+# trips when the newest point FALLS below the baseline beyond
+# threshold+band. Everything else in TRACKED is a wall (lower-better).
+HIGHER_IS_BETTER = {"multichip_blocks_per_sec"}
 
 DEFAULT_THRESHOLD = 1.5  # newest/baseline ratio that counts as regression
 DEFAULT_MIN_HISTORY = 3  # points before a metric gates
@@ -255,6 +267,11 @@ def load_ledger(root: str) -> dict[str, list[tuple[str, float]]]:
                 if isinstance(g, (int, float)):
                     ledger["gateway_ms_per_accepted_sample"].append(
                         (f"storm_ledger.json#{idx}", float(g)))
+                b = (run.get("multichip_blocks_per_sec")
+                     if isinstance(run, dict) else None)
+                if isinstance(b, (int, float)):
+                    ledger["multichip_blocks_per_sec"].append(
+                        (f"storm_ledger.json#{idx}", float(b)))
     # scenario ledger (`python -m celestia_tpu.scenarios --ledger`):
     # each run's breach count is one point of the scenario_slo_pass
     # series — the healthy trajectory is all zeros, so any breaching
@@ -281,8 +298,12 @@ def load_ledger(root: str) -> dict[str, list[tuple[str, float]]]:
 
 
 def judge(history: list[tuple[str, float]], threshold: float,
-          min_history: int) -> dict:
-    """Newest point vs the median±MAD baseline of its predecessors."""
+          min_history: int, higher_is_better: bool = False) -> dict:
+    """Newest point vs the median±MAD baseline of its predecessors.
+
+    ``ratio`` is always the BADNESS ratio (>1 means worse): newest ÷
+    baseline for walls, baseline ÷ newest for throughput series — so
+    the threshold and the rendered table read identically either way."""
     values = [v for _, v in history]
     n = len(values)
     if n < min_history:
@@ -296,8 +317,12 @@ def judge(history: list[tuple[str, float]], threshold: float,
     # zero-MAD series (best-of cache repeats identical values) still
     # tolerates measurement wiggle
     band = max(3 * 1.4826 * mad, 0.05 * baseline)
-    ratio = current / baseline if baseline else float("inf")
-    regressed = ratio > threshold and current > baseline + band
+    if higher_is_better:
+        ratio = baseline / current if current else float("inf")
+        regressed = ratio > threshold and current < baseline - band
+    else:
+        ratio = current / baseline if baseline else float("inf")
+        regressed = ratio > threshold and current > baseline + band
     return {
         "n": n, "gating": True, "regressed": regressed,
         "current": current, "current_label": current_label,
@@ -311,7 +336,8 @@ def check(root: str, threshold: float = DEFAULT_THRESHOLD,
     ledger = load_ledger(root)
     report = {}
     for metric, history in ledger.items():
-        report[metric] = judge(history, threshold, min_history)
+        report[metric] = judge(history, threshold, min_history,
+                               higher_is_better=metric in HIGHER_IS_BETTER)
         report[metric]["history"] = history
     report_ok = not any(r["regressed"] for r in report.values())
     return {"ok": report_ok, "threshold": threshold,

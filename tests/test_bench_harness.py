@@ -132,13 +132,24 @@ class TestRunConfig:
     def test_success_marks_measured(self, cache_path):
         configs, prov = {}, {}
         bench._run_config(configs, prov, None, "x", lambda: {"v": 1, "parity": True})
-        assert configs["x"] == {"v": 1, "parity": True}
+        # every measured entry carries the host stamp (cpus, n_devices) so
+        # cached numbers are attributable to the box that produced them
+        assert configs["x"]["v"] == 1 and configs["x"]["parity"] is True
+        import os
+
+        assert configs["x"]["cpus"] == os.cpu_count()
+        assert "n_devices" in configs["x"]
         assert prov["x"] == "measured"
-        # incremental persistence wrote the cache
-        assert json.loads(cache_path.read_text())["configs"]["x"] == {
-            "v": 1,
-            "parity": True,
-        }
+        # incremental persistence wrote the cache, stamp included
+        cached = json.loads(cache_path.read_text())["configs"]["x"]
+        assert cached == configs["x"]
+
+    def test_stamp_does_not_override_explicit_fields(self, cache_path):
+        configs, prov = {}, {}
+        bench._run_config(
+            configs, prov, None, "x", lambda: {"cpus": 99, "n_devices": 3})
+        assert configs["x"]["cpus"] == 99
+        assert configs["x"]["n_devices"] == 3
 
     def test_failure_substitutes_cached_with_flag(self, cache_path):
         cache = {"configs": {"x": {"v": 7}}}

@@ -595,6 +595,12 @@ class TestFaultSiteCoverage:
                     store.read_page(1, 0)
             finally:
                 shutil.rmtree(root, ignore_errors=True)
+        elif site == "pipeline.block":
+            from celestia_tpu.node.pipeline import BlockPipeline
+
+            pipe = BlockPipeline(2, depth=2)
+            pipe.feed(1, _square(2))
+            pipe.drain()
         elif site in ("gateway.route", "gateway.hedge"):
             from celestia_tpu.node.gateway import Gateway
 
@@ -630,6 +636,7 @@ class TestFaultSiteCoverage:
         "store.read",
         "gateway.route",
         "gateway.hedge",
+        "pipeline.block",
     ])
     def test_site_fires(self, site, net):
         with faults.inject(

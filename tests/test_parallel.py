@@ -14,6 +14,14 @@ def host_expected(sq):
     return eds, dah
 
 
+@pytest.fixture
+def no_mesh():
+    """Clear any process-wide mesh afterwards — routing state must never
+    leak between tests (it redirects every extend_tpu host entry)."""
+    yield
+    parallel.configure_mesh(None)
+
+
 class TestShardedExtend:
     @pytest.mark.slow  # multi-device compile-bound on 1 core; the
     # graft-entry dryrun keeps sharding covered in the fast tier
@@ -57,3 +65,164 @@ class TestShardedExtend:
         assert [r.tobytes() for r in np.asarray(rows)] == eds_h.row_roots()
         assert [c.tobytes() for c in np.asarray(cols)] == eds_h.col_roots()
         assert np.asarray(dah).tobytes() == dah_h.hash()
+
+
+class TestRowShardedParity:
+    """Tier-1 byte-parity of the production shard_map spellings. The
+    conftest pins an 8-device virtual CPU mesh for the whole suite, so
+    these run everywhere; the persistent compile cache keeps them fast
+    after the first cold round."""
+
+    # (2, 1, 2) is also the dp·sp < device_count case: a 2-device mesh
+    # carved out of the 8 the process sees
+    @pytest.mark.parametrize("k,dp,sp", [(2, 1, 2), (8, 1, 8), (32, 1, 8)])
+    def test_extend_parity(self, k, dp, sp):
+        import jax
+
+        if len(jax.devices()) < dp * sp:
+            pytest.skip(f"needs {dp * sp} devices")
+        mesh = parallel.make_mesh(dp=dp, sp=sp)
+        rng = np.random.default_rng(k)
+        sq = rand_square(rng, k)
+        fn = parallel.extend_and_root_rowsharded(mesh, k)
+        eds, rows, cols, dah = jax.block_until_ready(fn(sq))
+        eds_h, dah_h = host_expected(sq)
+        assert np.array_equal(np.asarray(eds), eds_h.data)
+        assert [r.tobytes() for r in np.asarray(rows)] == eds_h.row_roots()
+        assert [c.tobytes() for c in np.asarray(cols)] == eds_h.col_roots()
+        assert np.asarray(dah).tobytes() == dah_h.hash()
+
+    def test_row_levels_match_single_chip(self, no_mesh):
+        """The contiguous-rows levels spelling reassembles into exactly
+        the stack `eds_row_levels_device` produces — the provers it
+        seeds are byte-identical with zero host hashing."""
+        import jax
+
+        from celestia_tpu.ops import extend_tpu
+        from celestia_tpu.proof import NmtRowProver
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices")
+        k = 8
+        mesh = parallel.make_mesh(dp=1, sp=8)
+        rng = np.random.default_rng(3)
+        sq = rand_square(rng, k)
+        eds_h, _dah_h = host_expected(sq)
+        parallel.configure_mesh(None)  # reference = single-chip entry
+        want = extend_tpu.eds_row_levels_device(eds_h.data)
+        fn = parallel.eds_row_levels_rowsharded(mesh, k)
+        got = jax.block_until_ready(fn(eds_h.data))
+        assert len(got) == len(want)
+        for lvl_got, lvl_want in zip(got, want):
+            assert np.array_equal(np.asarray(lvl_got), lvl_want)
+        prover = NmtRowProver.from_node_levels(
+            [np.asarray(lvl)[0] for lvl in got])
+        assert prover.root() == eds_h.row_roots()[0]
+
+    def test_non_divisible_rows_rejected(self):
+        import jax
+
+        if len(jax.devices()) < 3:
+            pytest.skip("needs 3 devices")
+        mesh = parallel.make_mesh(dp=1, sp=3)
+        with pytest.raises(ValueError, match="not divisible"):
+            parallel.extend_and_root_rowsharded(mesh, 8)
+        with pytest.raises(ValueError, match="sp"):
+            parallel.eds_row_levels_rowsharded(mesh, 8)
+
+
+class TestMeshRouting:
+    """`parallel.configure_mesh` flips the extend_tpu host entries onto
+    the row-sharded spelling — a placement decision, never a bytes
+    decision (specs/parallel.md §Production routing)."""
+
+    def test_routed_entries_byte_identical(self, no_mesh):
+        import jax
+
+        from celestia_tpu.ops import extend_tpu
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices")
+        k = 8
+        rng = np.random.default_rng(5)
+        sq = rand_square(rng, k)
+        parallel.configure_mesh(None)
+        eds0, rows0, cols0 = extend_tpu.extend_roots_device(sq)
+        levels0 = extend_tpu.eds_row_levels_device(eds0)
+        parallel.configure_mesh(parallel.make_mesh(dp=1, sp=8))
+        eds1, rows1, cols1 = extend_tpu.extend_roots_device(sq)
+        levels1 = extend_tpu.eds_row_levels_device(eds1)
+        assert np.array_equal(eds0, eds1)
+        assert np.array_equal(rows0, rows1)
+        assert np.array_equal(cols0, cols1)
+        assert len(levels0) == len(levels1)
+        for a, b in zip(levels0, levels1):
+            assert np.array_equal(a, b)
+
+    def test_non_divisible_square_falls_back(self, no_mesh):
+        """A mesh whose sp does not divide the row count must not break
+        the entry — it silently takes the single-chip path."""
+        import jax
+
+        from celestia_tpu.ops import extend_tpu
+
+        if len(jax.devices()) < 3:
+            pytest.skip("needs 3 devices")
+        k = 8
+        rng = np.random.default_rng(7)
+        sq = rand_square(rng, k)
+        _eds_h, dah_h = host_expected(sq)
+        parallel.configure_mesh(parallel.make_mesh(dp=1, sp=3))
+        assert extend_tpu.active_mesh() is not None
+        assert extend_tpu._mesh_if_divisible(k) is None
+        _eds, _rows, _cols, dah = extend_tpu.extend_and_root_device(sq)
+        assert dah.tobytes() == dah_h.hash()
+
+
+class TestBlockPipeline:
+    """The 3-deep H2D/compute/D2H block stream (node/pipeline.py)."""
+
+    def test_stream_parity_and_drain(self, no_mesh):
+        import jax
+
+        from celestia_tpu.node.pipeline import BlockPipeline
+        from celestia_tpu.node.dispatch import Shed
+        from celestia_tpu.proof import NmtRowProver
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices")
+        parallel.configure_mesh(parallel.make_mesh(dp=1, sp=8))
+        k = 8
+        rng = np.random.default_rng(11)
+        squares = [rand_square(rng, k) for _ in range(5)]
+        adopted = []
+        pipe = BlockPipeline(k, depth=3, on_block=adopted.append)
+        retired = []
+        for h, sq in enumerate(squares):
+            out = pipe.feed(h, sq)
+            if out is not None:
+                retired.append(out)
+        assert pipe.inflight > 0  # overlap actually engaged
+        retired.extend(pipe.drain())
+        assert sorted(b.height for b in retired) == list(range(5))
+        assert [b.height for b in adopted] == [b.height for b in retired]
+        for b in sorted(retired, key=lambda b: b.height):
+            eds_h, dah_h = host_expected(squares[b.height])
+            assert np.array_equal(b.eds, eds_h.data)
+            assert b.dah.tobytes() == dah_h.hash()
+            prover = NmtRowProver.from_node_levels(
+                [lvl[0] for lvl in b.levels])
+            assert prover.root() == eds_h.row_roots()[0]
+        # admission is closed after drain; in-flight is empty
+        assert pipe.inflight == 0
+        with pytest.raises(Shed):
+            pipe.feed(9, squares[0])
+        stats = pipe.stats()
+        assert stats["fed"] == 5 and stats["retired"] == 5
+
+    def test_feed_rejects_wrong_square_size(self):
+        from celestia_tpu.node.pipeline import BlockPipeline
+
+        pipe = BlockPipeline(8)
+        with pytest.raises(ValueError, match="k=8"):
+            pipe.feed(1, np.zeros((4, 4, 512), dtype=np.uint8))
