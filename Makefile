@@ -5,6 +5,7 @@ PY ?= python
 
 .PHONY: test test-all test-slow chaos bench bench-transfers dryrun native \
 	trace-smoke bench-gate obs-smoke sdc-smoke storm-smoke storm-bench \
+	ragged-smoke \
 	store-smoke gateway-bench \
 	scenario-smoke scenario-pfb-storm scenario-rolling-outage \
 	scenario-sdc-under-storm scenario-rejoin-under-load \
@@ -121,6 +122,16 @@ sdc-smoke:
 # sample proof-verified. CPU-only, crypto-free, seconds.
 storm-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/storm_smoke.py
+
+# Ragged cross-height batching gate (specs/serving.md, ISSUE 14):
+# mixed-height mixed-k page-table gathers byte-identical to the
+# per-height path (one compiled program per page geometry), ragged
+# sample documents byte-identical + NMT-verified, and a concurrent
+# cross-height burst through the real RPC stack coalescing into a
+# single ("sample",) micro-batch that spans multiple heights. CPU-only,
+# crypto-free, seconds.
+ragged-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/ragged_smoke.py
 
 # Block-store durability drill (specs/store.md, ADR-021): persist a
 # chain into the CRC32C-guarded on-disk store through the real node,

@@ -1553,7 +1553,8 @@ def main_das_storm_lite(seconds: float = 3.0, threads: int = 8,
 def _das_storm_phase(label: str, *, seconds: float, threads: int, k: int,
                      heights: int, queue_capacity: int, deadline_ms: int,
                      batch_window_ms: float, max_batch: int,
-                     paged_budget: int | None, stall_ms: float):
+                     paged_budget: int | None, stall_ms: float,
+                     crowd: int | None = None, ragged: bool = True):
     """One measured storm phase behind a FRESH node + server: `threads`
     closed-loop light clients hammer `/sample` through the real RPC
     stack while a producer grows the chain and the synthetic prober
@@ -1566,7 +1567,14 @@ def _das_storm_phase(label: str, *, seconds: float, threads: int, k: int,
     storm-lite uses: one `delay` at `dispatch.run`, which fires once
     per device dispatch — per job unbatched, per micro-batch batched —
     so both phases pay the same fixed overhead per dispatch and the
-    measured win is exactly what batching amortizes."""
+    measured win is exactly what batching amortizes.
+
+    `crowd=N` switches the clients to the multi-height flash-crowd
+    pattern (ISSUE 14): uniform over the LAST N heights instead of
+    head-clustered — the workload that fragments a per-height batch
+    key into N tiny groups. `ragged=False` builds the server with the
+    per-height key (`ragged_batching=False`), the control arm the
+    ragged gather is measured against on the identical workload."""
     from celestia_tpu import faults
     from celestia_tpu.node.prober import Prober
     from celestia_tpu.node.rpc import RpcServer
@@ -1584,10 +1592,34 @@ def _das_storm_phase(label: str, *, seconds: float, threads: int, k: int,
     server = RpcServer(node, port=0, queue_capacity=queue_capacity,
                        default_deadline_s=deadline_ms / 1000.0,
                        batch_window_s=batch_window_ms / 1000.0,
-                       max_batch=max_batch)
+                       max_batch=max_batch, ragged_batching=ragged)
     server.start()
     base = f"http://127.0.0.1:{server.port}"
     w = 2 * k
+
+    if crowd:
+        # compile warmup: the ragged gather (like the same-height batch
+        # slicer) traces one XLA program per pow2 occupancy bucket, and
+        # each trace costs ~0.3 s on CPU. The head-clustered phases run
+        # first and warm the control arm's shapes, so a cold crowd
+        # phase would charge its compiles to the measured window.
+        # Warm both arms identically: the window then measures
+        # steady-state serving, which is what the gate compares.
+        top = node.latest_height()
+        hs = list(range(max(1, top - crowd + 1), top + 1))
+        n = 2
+        while n <= max(2, 2 * max_batch):
+            payloads = [(hs[t % len(hs)], (3 * t) % w, (5 * t) % w)
+                        for t in range(n)]
+            if ragged and hasattr(node, "sample_batch_ragged"):
+                node.sample_batch_ragged(payloads)
+            else:
+                by_h: dict[int, list] = {}
+                for h, i, j in payloads:
+                    by_h.setdefault(h, []).append((i, j))
+                for h, coords in by_h.items():
+                    node.sample_batch(h, coords)
+            n *= 2
 
     # metric deltas, so back-to-back phases in one process stay honest
     batches0 = metrics.get_counter("dispatch_batch_total")
@@ -1614,13 +1646,22 @@ def _das_storm_phase(label: str, *, seconds: float, threads: int, k: int,
     def client(seed):
         rng = _random.Random(seed)
         while not stop.is_set():
-            # cluster on the chain head (the DAS access pattern: light
-            # clients sample the newest block) — that density is what
-            # same-height micro-batching feeds on; 10% stragglers keep
-            # the paged cache churning across heights without diluting
-            # the batch key space into singleton groups
-            h = (node.latest_height() if rng.random() < 0.9
-                 else rng.randint(1, node.latest_height()))
+            if crowd:
+                # multi-height flash crowd (ISSUE 14): uniform over the
+                # last `crowd` heights — the realistic light-client
+                # pattern a per-height batch key fragments into `crowd`
+                # tiny groups and the ragged key answers in one
+                top = node.latest_height()
+                h = rng.randint(max(1, top - crowd + 1), top)
+            else:
+                # cluster on the chain head (the DAS access pattern:
+                # light clients sample the newest block) — that density
+                # is what same-height micro-batching feeds on; 10%
+                # stragglers keep the paged cache churning across
+                # heights without diluting the batch key space into
+                # singleton groups
+                h = (node.latest_height() if rng.random() < 0.9
+                     else rng.randint(1, node.latest_height()))
             i, j = rng.randrange(w), rng.randrange(w)
             t0 = time.perf_counter()
             try:
@@ -1709,7 +1750,13 @@ def _das_storm_phase(label: str, *, seconds: float, threads: int, k: int,
     return {
         "label": label,
         "seconds": round(elapsed, 2),
+        # config attribution (ISSUE 14 satellite): every storm entry
+        # names the batching shape it measured, like cpus/n_devices
+        # name the host shape
+        "batch_window_s": batch_window_ms / 1000.0,
         "max_batch": max_batch,
+        "crowd": crowd,
+        "ragged": ragged,
         "heights_produced": node.latest_height(),
         "requests_total": total,
         "counts": counts,
@@ -1755,10 +1802,18 @@ def main_das_storm(seconds: float = 4.0, threads: int = 32, k: int = 8,
     or — with --require-speedup X — when batched samples/sec fails to
     reach X times the unbatched phase.
 
+    Two further phases run the multi-height crowd workload (clients
+    uniform over the last 8 heights) against the per-height batch key
+    and the ragged ``("sample",)`` key (ISSUE 14): identical load,
+    identical per-dispatch stall — exit is nonzero unless ragged
+    samples/sec ≥ the same-height-only batcher.
+
     --ledger PATH appends the batched phase to the storm ledger (JSON,
     capped history) that `tools/perf_ledger.py` folds into `make
     bench-gate` as the lower-is-better `storm_ms_per_accepted_sample`
-    series."""
+    series — plus `ragged_ms_per_accepted_sample` from the crowd-ragged
+    phase, with `batch_window_s`/`max_batch` stamped for config
+    attribution."""
     from celestia_tpu.slo import SloEngine, default_objectives
     from celestia_tpu.telemetry import metrics
 
@@ -1775,6 +1830,30 @@ def main_das_storm(seconds: float = 4.0, threads: int = 32, k: int = 8,
     unbatched = _das_storm_phase("unbatched", max_batch=1, **common)
     batched = _das_storm_phase("batched", max_batch=max_batch, **common)
 
+    # multi-height crowd phases (ISSUE 14): the same mixed workload —
+    # clients uniform over the last N=8 heights — against the
+    # per-height batch key (control) and the ragged ("sample",) key.
+    # The per-dispatch stall is identical; the ragged win is one
+    # dispatch per group instead of one per height represented in it.
+    # The paged budget is floored at 2× the hot-window working set: a
+    # node serving a flash crowd provisions its device cache for the
+    # hot heights (the churn drill is the head-clustered phases
+    # above), and a budget smaller than ONE group's page span would
+    # measure fault-in thrash, not the batch-key shape under test.
+    crowd_n = 8
+    crowd_budget = paged_budget
+    if paged_budget is not None:
+        hot_set = crowd_n * (2 * k) * (2 * k) * 512
+        crowd_budget = max(paged_budget, 2 * hot_set)
+    crowd_common = dict(common, heights=max(heights, crowd_n),
+                        paged_budget=crowd_budget)
+    crowd_same = _das_storm_phase("crowd-same-height",
+                                  max_batch=max_batch, crowd=crowd_n,
+                                  ragged=False, **crowd_common)
+    crowd_ragged = _das_storm_phase("crowd-ragged",
+                                    max_batch=max_batch, crowd=crowd_n,
+                                    ragged=True, **crowd_common)
+
     slo = engine.evaluate()
     slo_by_name = {o["name"]: o["ok"] for o in slo["objectives"]}
     occ_hist = metrics.get_timing("dispatch_batch_occupancy")
@@ -1782,17 +1861,26 @@ def main_das_storm(seconds: float = 4.0, threads: int = 32, k: int = 8,
         round(batched["samples_per_sec"] / unbatched["samples_per_sec"], 2)
         if unbatched["samples_per_sec"] else None
     )
+    crowd_speedup = (
+        round(crowd_ragged["samples_per_sec"]
+              / crowd_same["samples_per_sec"], 2)
+        if crowd_same["samples_per_sec"] else None
+    )
     out = {
         "mode": "das-storm",
         "threads": threads,
         "k": k,
         "batch_window_ms": batch_window_ms,
+        "batch_window_s": batch_window_ms / 1000.0,
         "max_batch": max_batch,
         "paged_budget": paged_budget,
         "stall_ms": stall_ms,
         "unbatched": unbatched,
         "batched": batched,
+        "crowd_same_height": crowd_same,
+        "crowd_ragged": crowd_ragged,
         "speedup": speedup,
+        "crowd_speedup": crowd_speedup,
         "batch_occupancy_p50": (round(occ_hist.quantile(0.50), 1)
                                 if occ_hist else None),
         "batch_occupancy_p90": (round(occ_hist.quantile(0.90), 1)
@@ -1818,15 +1906,21 @@ def main_das_storm(seconds: float = 4.0, threads: int = 32, k: int = 8,
             except (OSError, ValueError):
                 pass  # unreadable ledger: start fresh rather than crash
         sps = batched["samples_per_sec"]
+        ragged_sps = crowd_ragged["samples_per_sec"]
         doc["runs"].append({
             "ts": time.time(),
             "threads": threads, "k": k, "seconds": seconds,
+            "batch_window_s": batch_window_ms / 1000.0,
             "max_batch": max_batch, "paged_budget": paged_budget,
             "stall_ms": stall_ms,
             "samples_per_sec": sps,
             "ms_per_accepted_sample": (round(1000.0 / sps, 4)
                                        if sps else None),
             "speedup_vs_unbatched": speedup,
+            "ragged_samples_per_sec": ragged_sps,
+            "ragged_ms_per_accepted_sample": (round(1000.0 / ragged_sps, 4)
+                                              if ragged_sps else None),
+            "crowd_speedup": crowd_speedup,
         })
         doc["runs"] = doc["runs"][-40:]  # capped history
         with open(ledger, "w") as f:
@@ -1835,7 +1929,7 @@ def main_das_storm(seconds: float = 4.0, threads: int = 32, k: int = 8,
               f"({len(doc['runs'])} runs)", file=sys.stderr)
 
     failures = []
-    for phase in (unbatched, batched):
+    for phase in (unbatched, batched, crowd_same, crowd_ragged):
         if phase["counts"]["500"]:
             failures.append(
                 f"{phase['counts']['500']} HTTP 500s ({phase['label']})")
@@ -1850,6 +1944,12 @@ def main_das_storm(seconds: float = 4.0, threads: int = 32, k: int = 8,
             speedup is None or speedup < require_speedup):
         failures.append(
             f"batched speedup {speedup} < required {require_speedup}")
+    if (crowd_same["samples_per_sec"]
+            and crowd_ragged["samples_per_sec"]
+            < crowd_same["samples_per_sec"]):
+        failures.append(
+            f"ragged crowd {crowd_ragged['samples_per_sec']} samples/s "
+            f"< same-height batcher {crowd_same['samples_per_sec']}")
     if failures:
         raise SystemExit("das-storm failed: " + "; ".join(failures))
 

@@ -532,6 +532,70 @@ class PagedEdsCache:
                 self._drop_pages_locked(height)
                 self._publish_locked()
 
+    def pages_batch(self, wants: list) -> list:
+        """Cross-height ragged row fetch (ISSUE 14): resolve each
+        ``(PagedEds, row)`` want against its instance's page table —
+        honoring per-instance ``rows_per_page``, which a store-loaded
+        height keeps from its persisted geometry — pin every referenced
+        page across heights in ONE pass, and answer the group with a
+        ragged gather (`ops.ragged.gather_rows`): one device dispatch
+        per page geometry instead of one per height.
+
+        Byte-identical to per-instance `PagedEds.rows_batch` calls,
+        row-memo and transfer accounting included; returns the rows (as
+        cell lists) aligned with ``wants``."""
+        out: list = [None] * len(wants)
+        misses: list[int] = []
+        for t, (paged, i) in enumerate(wants):
+            i = int(i)
+            if not (0 <= i < paged.width):
+                raise IndexError(
+                    f"row {i} out of range for width {paged.width}")
+            hit = paged._memo_get(i)
+            if hit is not None:
+                out[t] = hit
+            elif paged._host_full is not None:
+                out[t] = [paged._host_full[i, j].tobytes()
+                          for j in range(paged.width)]
+            else:
+                misses.append(t)
+        if misses:
+            from celestia_tpu.ops import ragged
+
+            # dedup identical (instance, row) wants — two jobs sampling
+            # the same coordinate share one descriptor
+            uniq: dict[tuple[int, int], list[int]] = {}
+            for t in misses:
+                paged, i = wants[t]
+                uniq.setdefault((id(paged), int(i)), []).append(t)
+            keys = list(uniq)
+            pinned: list[_Page] = []
+            dev_of: dict[int, object] = {}
+            try:
+                descs = []
+                for key in keys:
+                    paged, i = wants[uniq[key][0]]
+                    i = int(i)
+                    page = paged._page_for(i)
+                    dev = dev_of.get(id(page))
+                    if dev is None:
+                        dev = self._pin_resident(page)
+                        pinned.append(page)
+                        dev_of[id(page)] = dev
+                    descs.append((dev, i - page.row_lo, paged.width))
+                arrs = ragged.gather_rows(descs)
+            finally:
+                for page in pinned:
+                    self._unpin(page)
+            for key, arr in zip(keys, arrs):
+                members = uniq[key]
+                paged, i = wants[members[0]]
+                cells = [arr[t].tobytes() for t in range(paged.width)]
+                paged._memo_put(int(i), cells)
+                for t in members:
+                    out[t] = cells
+        return out
+
     def pin_count(self, height: int) -> int:
         with self._cond:
             pages = sum(p.pins for p in self._pages if p.height == height)
@@ -634,6 +698,9 @@ class PagedEdsCache:
                 f"(height={page.height} page={page.index})"
             )
             err.site = "cache.faultin"
+            # height attribution lets a cross-height ragged group heal
+            # only the poisoned member instead of every height it spans
+            err.height = page.height
             raise err
         dev = transfers.device_put_chunked(host, site="cache.faultin")
         # block until the upload lands so `busy` fences the whole

@@ -39,6 +39,7 @@ hedge latency; error rules a failover path that itself fails).
 from __future__ import annotations
 
 import bisect
+import collections
 import http.server
 import json
 import threading
@@ -138,11 +139,20 @@ class Gateway:
     gateway holds no block state and accepts no writes (POST → 405 —
     tx submission goes to a backend directly)."""
 
+    DAH_CACHE_CAP = 128  # heights; a DAH doc is ~a few KB
+
     def __init__(self, backends=(), host: str = "127.0.0.1",
                  port: int = 0, *, vnodes: int = DEFAULT_VNODES,
                  timeout_s: float = 10.0):
         self.ring = HashRing(backends, vnodes=vnodes)
         self.timeout_s = float(timeout_s)
+        # read-through LRU for /dah/<h> bodies: a committed height's
+        # DAH is immutable, so entries are NEVER invalidated — only
+        # LRU-evicted. `_dah_lock` is a leaf lock (specs/serving.md
+        # lock ordering): held for dict ops only, never across a fetch.
+        self._dah_cache: "collections.OrderedDict[int, bytes]" = \
+            collections.OrderedDict()
+        self._dah_lock = threading.Lock()
         gw = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -251,6 +261,18 @@ class Gateway:
     # -- routing -------------------------------------------------------- #
 
     @staticmethod
+    def _dah_height(path: str) -> int | None:
+        """The height of a cacheable ``/dah/<h>`` path, else None —
+        only the exact two-segment form is immutable-cacheable."""
+        parts = [p for p in path.split("?")[0].split("/") if p]
+        if len(parts) == 2 and parts[0] == "dah":
+            try:
+                return int(parts[1])
+            except ValueError:
+                return None
+        return None
+
+    @staticmethod
     def _route_key(path: str) -> str:
         """(height, row) routing key as "h:i". `/sample/<h>/<i>/<j>`
         keys on its own row; other height-addressed routes (`/dah/<h>`,
@@ -279,6 +301,16 @@ class Gateway:
         gateway-minted) TraceContext; the ``gateway.route`` span roots
         the routing decision under it and every hedge attempt becomes
         a ``gateway.hedge`` child carrying backend/attempt/outcome."""
+        dah_height = self._dah_height(path)
+        if dah_height is not None:
+            with self._dah_lock:
+                body = self._dah_cache.get(dah_height)
+                if body is not None:
+                    self._dah_cache.move_to_end(dah_height)
+            if body is not None:
+                metrics.incr_counter("gateway_dah_cache_hits_total")
+                return 200, body, "cache"
+            metrics.incr_counter("gateway_dah_cache_miss_total")
         key = self._route_key(path)
         candidates = self.ring.owners(key)
         with tracing.span("gateway.route", key=key,
@@ -290,8 +322,15 @@ class Gateway:
                         candidates=len(candidates))
             if not candidates:
                 raise RuntimeError("no backends on the ring")
-            return self.fetch_hedged(path, candidates,
-                                     deadline_ms=deadline_ms, ctx=ctx)
+            status, body, backend = self.fetch_hedged(
+                path, candidates, deadline_ms=deadline_ms, ctx=ctx)
+            if dah_height is not None and status == 200:
+                with self._dah_lock:
+                    self._dah_cache[dah_height] = body
+                    self._dah_cache.move_to_end(dah_height)
+                    while len(self._dah_cache) > self.DAH_CACHE_CAP:
+                        self._dah_cache.popitem(last=False)
+            return status, body, backend
 
     def fetch_hedged(self, path: str, candidates: list[str],
                      deadline_ms: str | None = None, ctx=None):

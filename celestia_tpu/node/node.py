@@ -718,6 +718,111 @@ class Node:
             self._prover_cache.pop(height, None)
             return self._sample_batch(height, coords)
 
+    def sample_batch_ragged(self, payloads) -> list:
+        """Answer a micro-batch of DAS samples ACROSS heights — the
+        `batch_exec` target of the widened ``("sample",)`` dispatcher
+        lane (ISSUE 14). Jobs are grouped per height with per-height
+        prover reuse (`_row_provers`); heights backed by the paged
+        cache contribute their distinct rows to ONE ragged page-table
+        gather (`PagedEdsCache.pages_batch`), so the whole mixed-height
+        group costs one device dispatch per page geometry instead of
+        one per height. Every returned document is byte-identical to
+        the per-height `sample_batch` path, sentinel semantics
+        included (None for an unknown block, "range" out of bounds).
+
+        The IntegrityError heal contract is per-height: a poisoned
+        fault-in invalidates only the attributed height (``err.height``,
+        stamped by the paged cache) and the whole group re-answers; a
+        second corruption of an already-healed height re-raises."""
+        from celestia_tpu import integrity
+
+        healed: set[int] = set()
+        while True:
+            try:
+                return self._sample_batch_ragged(payloads)
+            except integrity.IntegrityError as err:
+                if not hasattr(self._eds_cache, "invalidate"):
+                    raise
+                height = getattr(err, "height", None)
+                targets = [int(height)] if height is not None else \
+                    sorted({int(h) for h, _i, _j in payloads})
+                if any(h in healed for h in targets):
+                    raise
+                for h in targets:
+                    log.info("eds page corrupt; invalidating height",
+                             height=h)
+                    self._eds_cache.invalidate(h)
+                    self._prover_cache.pop(h, None)
+                    healed.add(h)
+
+    def _sample_batch_ragged(self, payloads) -> list:
+        from celestia_tpu.node import eds_cache
+        from celestia_tpu.ops import ragged
+        from celestia_tpu.proof import das_sample_docs
+
+        jobs = [(int(h), int(i), int(j)) for h, i, j in payloads]
+        by_height: dict[int, list[int]] = {}
+        for t, (h, _i, _j) in enumerate(jobs):
+            by_height.setdefault(h, []).append(t)
+        out: list = [None] * len(jobs)
+        with ragged.ragged_span(len(by_height), len(jobs)), \
+                contextlib.ExitStack() as borrows:
+            # borrow every height up front: the pins outlive both the
+            # gather and the prove stage, exactly like the per-height
+            # path's single borrow
+            plan: list = []            # (h, eds, w, valid, rows_needed)
+            wants: list = []           # (PagedEds, row) ragged gather feed
+            want_slot: dict = {}       # (h, row) -> index into wants
+            for h, ts in by_height.items():
+                eds = borrows.enter_context(self._borrow_eds(h))
+                if eds is None:
+                    continue  # out[t] stays None: unknown block
+                if hasattr(eds, "original_width"):
+                    w = eds.width
+                else:
+                    w = int(eds.shape[0])
+                for t in ts:
+                    out[t] = "range"
+                valid = [t for t in ts
+                         if 0 <= jobs[t][1] < w and 0 <= jobs[t][2] < w]
+                if not valid:
+                    continue
+                rows_needed = sorted({jobs[t][1] for t in valid})
+                plan.append((h, eds, w, valid, rows_needed))
+                if (isinstance(eds, eds_cache.PagedEds)
+                        and eds._cache is self._eds_cache
+                        and hasattr(self._eds_cache, "pages_batch")):
+                    for i in rows_needed:
+                        want_slot[(h, i)] = len(wants)
+                        wants.append((eds, i))
+            with tracing.stage("device"):
+                gathered = (self._eds_cache.pages_batch(wants)
+                            if wants else [])
+                rows_of: dict[int, dict] = {}
+                for h, eds, w, valid, rows_needed in plan:
+                    if (h, rows_needed[0]) in want_slot:
+                        rows = {i: gathered[want_slot[(h, i)]]
+                                for i in rows_needed}
+                    elif hasattr(eds, "rows_batch"):
+                        rows = dict(zip(rows_needed,
+                                        eds.rows_batch(rows_needed)))
+                    elif hasattr(eds, "original_width"):
+                        rows = {i: eds.row(i) for i in rows_needed}
+                    else:
+                        rows = {i: [bytes(eds[i, c]) for c in range(w)]
+                                for i in rows_needed}
+                    rows_of[h] = rows
+            with tracing.stage("prove"):
+                for h, eds, w, valid, rows_needed in plan:
+                    docs = das_sample_docs(
+                        rows_of[h],
+                        [(jobs[t][1], jobs[t][2]) for t in valid],
+                        w // 2,
+                        provers=self._row_provers(h, eds, rows_needed))
+                    for t, doc in zip(valid, docs):
+                        out[t] = doc
+        return out
+
     def _row_provers(self, height: int, eds, rows_needed) -> dict:
         """Per-height prover memo for `das_sample_docs` (ADR-019).
 

@@ -141,7 +141,8 @@ def _legacy_sample_work(node, h: int, i: int, j: int):
 
 
 def _handler_for(node: Node, dispatcher: DeviceDispatcher | None = None,
-                 tracker: _InflightTracker | None = None):
+                 tracker: _InflightTracker | None = None,
+                 ragged_batching: bool = True):
     class Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -230,21 +231,36 @@ def _handler_for(node: Node, dispatcher: DeviceDispatcher | None = None,
                                      label=label)
 
         def _dispatch_sample(self, h: int, i: int, j: int):
-            """The /sample body, continuous-batched (ADR-017): the
-            request submits its coordinate with a per-height batch key,
-            and the dispatcher coalesces concurrent same-height samples
-            into ONE `node.sample_batch` call — one vmapped row read +
-            one hash pass per distinct row instead of per request. Each
-            waiter still carries its own deadline and gets its own
-            document, byte-identical to the unbatched path. Nodes
-            without `sample_batch` (duck-typed embedders) keep the
-            legacy one-shot route body."""
+            """The /sample body, continuous-batched (ADR-017) and
+            ragged across heights (ISSUE 14): with a ragged-capable
+            node, EVERY concurrent /sample coalesces under the single
+            ``("sample",)`` key — the dispatcher hands the whole
+            mixed-height group to `node.sample_batch_ragged`, which
+            answers it with one page-table gather per page geometry.
+            Each waiter still carries its own deadline/abandon contract
+            and gets its own document, byte-identical to the per-height
+            path. Nodes without `sample_batch_ragged` (or servers built
+            with ``ragged_batching=False``, the bench's control arm)
+            keep the per-height ``("sample", h)`` key; nodes without
+            `sample_batch` keep the legacy one-shot route body."""
             sample_batch = getattr(node, "sample_batch", None)
             if sample_batch is None:
                 return self._dispatch(
                     lambda: _legacy_sample_work(node, h, i, j), "sample")
+            ragged_exec = (getattr(node, "sample_batch_ragged", None)
+                           if ragged_batching else None)
             if dispatcher is None:
+                if ragged_exec is not None:
+                    return ragged_exec([(h, i, j)])[0]
                 return sample_batch(h, [(i, j)])[0]
+            if ragged_exec is not None:
+                return dispatcher.submit(
+                    deadline_s=self._deadline_s(),
+                    label="sample",
+                    batch_key=("sample",),
+                    batch_exec=ragged_exec,
+                    payload=(h, i, j),
+                )
             return dispatcher.submit(
                 deadline_s=self._deadline_s(),
                 label="sample",
@@ -1118,12 +1134,14 @@ class RpcServer:
                  queue_capacity: int | None = None,
                  default_deadline_s: float | None = None,
                  batch_window_s: float | None = None,
-                 max_batch: int | None = None):
+                 max_batch: int | None = None,
+                 ragged_batching: bool = True):
         self.node = node
         self.dispatcher = dispatcher or DeviceDispatcher(
             capacity=queue_capacity, default_deadline_s=default_deadline_s,
             batch_window_s=batch_window_s, max_batch=max_batch,
         )
+        self.ragged_batching = bool(ragged_batching)
         # readiness (slo.readiness not_overloaded) and node-internal
         # device funneling discover the dispatcher through the node
         node.dispatcher = self.dispatcher
@@ -1139,7 +1157,9 @@ class RpcServer:
             request_queue_size = 128
 
         self.server = _Server(
-            (host, port), _handler_for(node, self.dispatcher, self._tracker)
+            (host, port),
+            _handler_for(node, self.dispatcher, self._tracker,
+                         ragged_batching=self.ragged_batching),
         )
         self.port = self.server.server_address[1]
         self._thread: threading.Thread | None = None

@@ -292,6 +292,67 @@ class RpcChaosNode(ChaosNode):
             out[t] = doc
         return out
 
+    def sample_batch_ragged(self, payloads) -> list:
+        """The ragged cross-height sample body (mirrors
+        Node.sample_batch_ragged for the widened ``("sample",)``
+        dispatcher key): one exec answers the whole mixed-height group.
+        Paged heights resolve every row the group needs through ONE
+        `PagedEdsCache.pages_batch` gather — each page pinned and
+        faulted at most once per group, one device dispatch per page
+        geometry — instead of per-row reads that thrash a tight budget
+        when the group spans heights. Chaos subclasses that tamper via
+        `block_row` (and non-paged heights) keep the per-height
+        `sample_batch` delegation so the lie stays identical. Documents
+        are byte-identical to per-height calls either way."""
+        from celestia_tpu.ops import ragged
+        from celestia_tpu.proof import das_sample_docs
+
+        jobs = [(int(h), int(i), int(j)) for h, i, j in payloads]
+        by_height: dict[int, list[int]] = {}
+        for t, (h, _i, _j) in enumerate(jobs):
+            by_height.setdefault(h, []).append(t)
+        out: list = [None] * len(jobs)
+        cache = getattr(self, "_eds_cache", None)
+        gather_ok = (
+            cache is not None and hasattr(cache, "pages_batch")
+            and type(self).block_row is RpcChaosNode.block_row
+        )
+        with ragged.ragged_span(len(by_height), len(jobs)):
+            plan = []  # (h, w, valid ts, rows_needed)
+            wants: list = []
+            want_slot: dict[tuple[int, int], int] = {}
+            for h, ts in by_height.items():
+                eds = self._eds_for(h) if gather_ok else None
+                paged = (eds if getattr(eds, "_cache", None) is cache
+                         else None)
+                if paged is None:
+                    docs = self.sample_batch(
+                        h, [(jobs[t][1], jobs[t][2]) for t in ts])
+                    for t, doc in zip(ts, docs):
+                        out[t] = doc
+                    continue
+                w = paged.width
+                for t in ts:
+                    out[t] = "range"
+                valid = [t for t in ts
+                         if 0 <= jobs[t][1] < w and 0 <= jobs[t][2] < w]
+                rows_needed = sorted({jobs[t][1] for t in valid})
+                for i in rows_needed:
+                    want_slot[(h, i)] = len(wants)
+                    wants.append((paged, i))
+                if valid:
+                    plan.append((h, w, valid, rows_needed))
+            gathered = cache.pages_batch(wants) if wants else []
+            for h, w, valid, rows_needed in plan:
+                rows = {i: gathered[want_slot[(h, i)]]
+                        for i in rows_needed}
+                docs = das_sample_docs(
+                    rows, [(jobs[t][1], jobs[t][2]) for t in valid],
+                    w // 2)
+                for t, doc in zip(valid, docs):
+                    out[t] = doc
+        return out
+
     def get_block(self, height: int):
         return None  # no block bodies: body routes answer 404
 

@@ -79,6 +79,12 @@ TRACKED: dict[str, list[tuple[str | None, str]]] = {
     # (`make storm-bench`). Not extracted from BENCH rounds — the
     # loader folds it in from storm_ledger.json, hence no paths here.
     "storm_ms_per_accepted_sample": [],
+    # ragged serving (ISSUE 14): per-accepted-sample wall of the
+    # crowd-ragged das-storm phase — the multi-height flash crowd
+    # answered through the widened ("sample",) key + page-table gather.
+    # Folded from storm_ledger.json runs that carry the ragged series
+    # key.
+    "ragged_ms_per_accepted_sample": [],
     # horizontal serving: per-accepted-sample wall of the fleet phase
     # of `bench.py --gateway-fleet` (`make gateway-bench`, ADR-021) —
     # N backends behind the consistent-hash gateway, every accepted
@@ -267,6 +273,11 @@ def load_ledger(root: str) -> dict[str, list[tuple[str, float]]]:
                 if isinstance(g, (int, float)):
                     ledger["gateway_ms_per_accepted_sample"].append(
                         (f"storm_ledger.json#{idx}", float(g)))
+                r = (run.get("ragged_ms_per_accepted_sample")
+                     if isinstance(run, dict) else None)
+                if isinstance(r, (int, float)):
+                    ledger["ragged_ms_per_accepted_sample"].append(
+                        (f"storm_ledger.json#{idx}", float(r)))
                 b = (run.get("multichip_blocks_per_sec")
                      if isinstance(run, dict) else None)
                 if isinstance(b, (int, float)):

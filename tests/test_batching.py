@@ -12,11 +12,18 @@ Four surfaces, bottom-up:
    handler path, proofs verifying against the committed DAH;
 4. the paged device cache — demote→fault-in round trips preserve
    bytes, concurrent churn under a one-page budget never sees a torn
-   page, and an armed `cache.faultin` bitflip is DETECTED, not served.
+   page, and an armed `cache.faultin` bitflip is DETECTED, not served;
+5. ragged cross-height batching (ISSUE 14) — mixed-height/mixed-k
+   groups off the page table: byte AND transfer-counter parity with
+   the per-height legacy path, per-geometry jit cache entries (store-
+   restored page extents included), deadline expiry inside a ragged
+   group counted once, and a poisoned fault-in healing only the
+   attributed height.
 """
 
 import random
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -372,3 +379,274 @@ class TestPagedEdsCache:
         cache.invalidate(1)
         assert 1 not in cache
         assert cache.stats()["pages"] == 0
+
+
+def _d2h(site: str) -> float:
+    return metrics.get_counter("transfer_bytes", site=site,
+                               direction="d2h")
+
+
+class TestRaggedCrossHeight:
+    """ISSUE 14: ragged cross-height sample batching off the page
+    table — the widened ``("sample",)`` group answered by one gather."""
+
+    HEIGHT_KS = ((1, 2), (2, 8), (3, 32))
+
+    def _mixed_cache(self, rows_per_page=4, budget=1 << 30):
+        cache = PagedEdsCache(rows_per_page=rows_per_page,
+                              device_byte_budget=budget)
+        oracles = {}
+        for h, k in self.HEIGHT_KS:
+            eds, dev = _paged_square(k, h)
+            oracles[h] = eds
+            cache.put(h, dev)
+        return cache, oracles
+
+    def _wants_for(self, cache, oracles):
+        """Mixed-height, mixed-k rows interleaved in one group, with a
+        duplicate (same height+row twice) that must share a fetch."""
+        wants, legacy = [], {}
+        for h, eds in oracles.items():
+            w = eds.data.shape[0]
+            rows = [0, w - 1, 1, 0]  # dup row 0
+            legacy[h] = rows
+            paged = cache.get(h)
+            for i in rows:
+                wants.append((paged, i))
+        return wants, legacy
+
+    def test_pages_batch_mixed_k_byte_and_counter_parity(self):
+        # two caches in identical fresh state: one answers the group
+        # via the ragged gather, the other via per-height rows_batch
+        cache_r, oracles = self._mixed_cache()
+        cache_l, _ = self._mixed_cache()
+        wants, legacy_rows = self._wants_for(cache_r, oracles)
+
+        ragged0 = _d2h("eds.ragged")
+        got = cache_r.pages_batch(wants)
+        ragged_bytes = _d2h("eds.ragged") - ragged0
+
+        legacy0 = _d2h("eds.rows_batch") + _d2h("eds.row")
+        legacy = {h: cache_l.get(h).rows_batch(rows)
+                  for h, rows in legacy_rows.items()}
+        legacy_bytes = (_d2h("eds.rows_batch") + _d2h("eds.row")
+                        - legacy0)
+
+        t = 0
+        for h, rows in legacy_rows.items():
+            for i, want_cells in zip(rows, legacy[h]):
+                assert got[t] == want_cells == oracles[h].row(i)
+                t += 1
+        assert t == len(wants)
+        # the ragged gather moves EXACTLY the bytes the per-height
+        # batched reads would: unique rows only, duplicates deduped,
+        # each at its own height's width
+        assert ragged_bytes == legacy_bytes > 0
+
+    def test_pages_batch_rejects_out_of_range_row(self):
+        cache, oracles = self._mixed_cache()
+        paged = cache.get(1)
+        with pytest.raises(IndexError):
+            cache.pages_batch([(paged, paged.width)])
+
+    def _mixed_k_node(self):
+        from celestia_tpu.testutil.chaosnet import RpcChaosNode
+
+        node = RpcChaosNode(heights=1, k=2, paged_budget_bytes=1 << 30,
+                            rows_per_page=4)
+        for _h, k in self.HEIGHT_KS[1:]:
+            node.k = k  # grow() extends with the node's current k
+            node.grow()
+        return node
+
+    def test_ragged_sample_batch_mixed_k_parity_and_proofs(self):
+        from celestia_tpu.da import erasured_leaf_namespace
+        from celestia_tpu.ops import ragged  # noqa: F401 — counters
+        from celestia_tpu.proof import NmtRangeProof
+
+        node = self._mixed_k_node()
+        heights = [h for h, _k in self.HEIGHT_KS]
+        payloads = []
+        for h in heights:
+            w = node.block_width(h)
+            payloads += [(h, 0, 0), (h, w - 1, w // 2), (h, 0, 0),
+                         (h, w, 0)]  # dup + out-of-range sentinel
+        # interleave so scatter-back must honor submission positions
+        payloads = payloads[::3] + payloads[1::3] + payloads[2::3]
+
+        batches0 = metrics.get_counter("dispatch_ragged_batch_total")
+        jobs0 = metrics.get_counter("dispatch_ragged_jobs_total")
+        docs = node.sample_batch_ragged(payloads)
+        assert (metrics.get_counter("dispatch_ragged_batch_total")
+                - batches0) == 1.0
+        assert (metrics.get_counter("dispatch_ragged_jobs_total")
+                - jobs0) == float(len(payloads))
+
+        by_height = {h: [(i, j) for hh, i, j in payloads if hh == h]
+                     for h in heights}
+        legacy = {h: node.sample_batch(h, coords)
+                  for h, coords in by_height.items()}
+        cursor = {h: 0 for h in heights}
+        verified = 0
+        for (h, i, j), doc in zip(payloads, docs):
+            want = legacy[h][cursor[h]]
+            cursor[h] += 1
+            assert doc == want
+            if not isinstance(doc, dict):
+                assert doc == "range"
+                continue
+            w = node.block_width(h)
+            share = bytes.fromhex(doc["share"])
+            p = doc["proof"]
+            proof = NmtRangeProof(
+                start=p["start"], end=p["end"],
+                nodes=[bytes.fromhex(x) for x in p["nodes"]],
+                tree_size=p["tree_size"],
+            )
+            ns = erasured_leaf_namespace(i, j, share, w // 2)
+            proof.verify_inclusion(
+                node.block_dah(h).row_roots[i], [ns], [share])
+            verified += 1
+        assert verified == 3 * len(heights)
+
+    def test_deadline_expired_member_dropped_counted_once(self):
+        from celestia_tpu.testutil.chaosnet import RpcChaosNode
+
+        node = RpcChaosNode(heights=2, k=2)
+        reg = Registry()
+        d = DeviceDispatcher(registry=reg, max_batch=8,
+                             batch_window_s=0.01)
+        d.start()
+        seen: list[list] = []
+
+        def exec_ragged(payloads):
+            seen.append(list(payloads))
+            return node.sample_batch_ragged(payloads)
+
+        release = threading.Event()
+        started = threading.Event()
+
+        def stall():
+            started.set()
+            release.wait(5.0)
+
+        stall_thread = threading.Thread(
+            target=lambda: d.submit(stall, label="stall"), daemon=True)
+        stall_thread.start()
+        assert started.wait(2.0)  # the lane is now occupied
+
+        outcomes: dict[str, object] = {}
+
+        def member(name, payload, deadline_s):
+            try:
+                outcomes[name] = d.submit(
+                    batch_key=("sample",), batch_exec=exec_ragged,
+                    payload=payload, deadline_s=deadline_s,
+                    label="sample")
+            except BaseException as e:  # noqa: BLE001
+                outcomes[name] = e
+
+        doomed = threading.Thread(
+            target=member, args=("doomed", (1, 0, 0), 0.05))
+        survivor = threading.Thread(
+            target=member, args=("survivor", (2, 0, 1), 30.0))
+        doomed.start()
+        survivor.start()
+        time.sleep(0.3)  # let the doomed member's deadline lapse
+        release.set()
+        doomed.join(10.0)
+        survivor.join(10.0)
+        stall_thread.join(10.0)
+        d.drain()
+
+        assert isinstance(outcomes["doomed"], DeadlineExceeded)
+        assert outcomes["survivor"] == node.sample_batch(2, [(0, 1)])[0]
+        # the expired member never reached the exec, and was shed
+        # from the ragged group exactly once
+        assert [p for batch in seen for p in batch] == [(2, 0, 1)]
+        assert reg.get_counter("rpc_shed_total", reason="deadline") == 1.0
+
+    def test_armed_faultin_bitflip_in_ragged_gather_heals(self):
+        # one-page budget over three heights: every non-resident page
+        # the group touches must fault in, so the armed strike lands
+        # inside the ragged gather
+        oracles, cache = {}, None
+        for h in (1, 2, 3):
+            eds, dev = _paged_square(4, h)
+            if cache is None:
+                page_bytes = 2 * eds.data.shape[1] * eds.data.shape[2]
+                cache = PagedEdsCache(rows_per_page=2,
+                                      device_byte_budget=page_bytes,
+                                      max_heights=3)
+            oracles[h] = eds
+            cache.put(h, dev)
+
+        def wants_for_all():
+            wants = []
+            for h, eds in oracles.items():
+                paged = cache.get(h)
+                for i in range(eds.data.shape[0]):
+                    wants.append((h, paged, i))
+            return wants
+
+        with faults.inject(
+            faults.rule("cache.faultin", "bitflip", times=1), seed=5,
+        ):
+            with pytest.raises(IntegrityError) as exc:
+                cache.pages_batch(
+                    [(p, i) for _h, p, i in wants_for_all()])
+        err = exc.value
+        assert err.site == "cache.faultin"
+        # height attribution (ISSUE 14): the heal loop invalidates only
+        # the poisoned member's height, not every height in the group
+        poisoned = getattr(err, "height", None)
+        assert poisoned in oracles
+        assert cache.stats()["page_corrupt"] >= 1
+
+        # the heal path Node.sample_batch_ragged runs: drop the
+        # attributed height, re-adopt it, retry the same group
+        cache.invalidate(poisoned)
+        assert poisoned not in cache
+        eds, dev = _paged_square(4, poisoned)
+        cache.put(poisoned, dev)
+        got = cache.pages_batch(
+            [(p, i) for _h, p, i in wants_for_all()])
+        for (h, _p, i), cells in zip(wants_for_all(), got):
+            assert cells == oracles[h].row(i)
+
+    def test_store_restored_geometry_gets_own_jit_entry(self, tmp_path):
+        """Satellite: the gather's jit cache keys on the page row
+        extent — a store-restored height keeping a persisted
+        rows_per_page narrower than the cache default compiles its own
+        program instead of colliding with live pages."""
+        from celestia_tpu.ops import ragged
+        from celestia_tpu.store import BlockStore
+
+        store = BlockStore(tmp_path)
+        eds1, _ = _paged_square(4, 1)
+        dah1 = da.new_data_availability_header(eds1)
+        store.put_eds(1, np.asarray(eds1.data), eds1.original_width,
+                      dah_doc=dah1.to_json(), rows_per_page=2)
+        store.reindex()
+
+        cache = PagedEdsCache(rows_per_page=8,
+                              device_byte_budget=1 << 30, store=store)
+        restored = cache.load_from_store(1)
+        assert restored.rows_per_page == 2  # persisted geometry kept
+        eds2, dev2 = _paged_square(4, 2)
+        cache.put(2, dev2)
+        live = cache.get(2)
+        assert live.rows_per_page == 8
+
+        ragged._jitted_gather.cache_clear()
+        w = eds1.data.shape[0]
+        wants = [(restored, 0), (live, 0), (restored, w - 1),
+                 (live, w - 1), (restored, 0)]
+        got = cache.pages_batch(wants)
+        assert got[0] == got[4] == eds1.row(0)
+        assert got[1] == eds2.row(0)
+        assert got[2] == eds1.row(w - 1)
+        assert got[3] == eds2.row(w - 1)
+        # 2-row store pages and 8-row live pages are distinct
+        # geometries: one compiled gather each, nothing more
+        assert ragged._jitted_gather.cache_info().currsize == 2
