@@ -10,7 +10,7 @@ PY ?= python
 	scenario-smoke scenario-pfb-storm scenario-rolling-outage \
 	scenario-sdc-under-storm scenario-rejoin-under-load \
 	scenario-gateway-fleet scenarios \
-	kernel-smoke bench-fused analyze multichip-smoke multichip-bench
+	kernel-smoke bench-fused analyze san multichip-smoke multichip-bench
 
 # Static analysis gate (specs/analysis.md, ADR-020): AST-level
 # concurrency lint (lock ordering vs the specs/serving.md partial
@@ -22,8 +22,18 @@ PY ?= python
 # only on NEW findings (config/lint_baseline.json + inline
 # `# lint: allow(...)` waivers, every one with a written reason).
 analyze:
-	JAX_PLATFORMS=cpu $(PY) -m celestia_tpu.tools.analysis \
-		--json lint_report.json
+	JAX_PLATFORMS=cpu $(PY) -m celestia_tpu.tools.analysis
+
+# Runtime sanitizer gate (celestia-san, specs/analysis.md §Runtime
+# sanitizer): lock-order & device-boundary hammer over the whole
+# serving lock surface, run twice on one seed (zero new T-findings +
+# run-to-run determinism), cross-validated against celestia-lint
+# (every static C001/C002/C003 site must be runtime-instrumentable;
+# a statically waived hazard that fires live fails), then the
+# lock-heavy tier-1 subset under `pytest --san`. CPU-only,
+# crypto-free, <120 s budget enforced by the script itself.
+san:
+	JAX_PLATFORMS=cpu $(PY) scripts/san_smoke.py
 
 # Fast developer loop: the default tier skips the slow multi-process
 # suites (devnet, gRPC, multihost, network, race storms). Two FRESH
@@ -37,8 +47,10 @@ JIT_A = tests/test_extend_tpu.py tests/test_nmt_semantics.py \
 JIT_B = tests/test_device_resident.py tests/test_blob_pool.py \
 	tests/test_parallel.py tests/test_graft_entry.py
 JIT_HEAVY = $(JIT_A) $(JIT_B)
-# analyze first: the static gate costs ~3 s and fails fast on lint
-test: analyze
+# analyze first: the static gate costs ~3 s and fails fast on lint;
+# san next: the runtime sanitizer gate is ~30 s and catches what the
+# AST cannot (observed inversions, spec drift) before the long tiers
+test: analyze san
 	$(PY) -m pytest $(JIT_HEAVY) -q
 	$(PY) -m pytest tests/ -q $(addprefix --ignore=,$(JIT_HEAVY))
 

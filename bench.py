@@ -2491,6 +2491,18 @@ if __name__ == "__main__":
         sys.exit(perf_ledger.main(
             [a for a in sys.argv[1:] if a != "--check-regressions"]
         ))
+    # --san rides along with any bench mode: wrap the run in a
+    # celestia-san Session (specs/analysis.md, "Runtime sanitizer") and
+    # fail the bench on any new T-finding observed under real load —
+    # the storm/pipeline arms are the heaviest concurrent exercise the
+    # repo has, exactly where a latent inversion would surface
+    _san = None
+    if "--san" in sys.argv:
+        sys.argv.remove("--san")
+        from celestia_tpu.tools import sanitizer as _sanitizer
+
+        _san = _sanitizer.Session()
+        _sanitizer.activate(_san)
     # --trace-out PATH rides along the same way
     _trace_path = None
     if "--trace-out" in sys.argv:
@@ -2604,6 +2616,8 @@ if __name__ == "__main__":
         else:
             main()
     finally:
+        if _san is not None:
+            _sanitizer.deactivate(_san)
         if _rec is not None:
             _rec.stop()
             _rec.write(_trace_path)
@@ -2611,3 +2625,19 @@ if __name__ == "__main__":
                 f"trace written: {_trace_path} ({len(_rec.spans)} spans)",
                 file=sys.stderr,
             )
+    if _san is not None:
+        import pathlib as _pathlib
+
+        _srep = _sanitizer.finalize(
+            _san, _pathlib.Path(__file__).resolve().parent,
+            coverage=False)
+        if _srep.new_findings:
+            print(
+                f"celestia-san: {len(_srep.new_findings)} new runtime "
+                "finding(s) under bench load:", file=sys.stderr)
+            for _f in _srep.new_findings:
+                print(f"  {_f.render()}", file=sys.stderr)
+            sys.exit(1)
+        print(
+            f"celestia-san: clean ({len(_srep.tokens)} tokens, "
+            f"{len(_srep.edges)} edges observed)", file=sys.stderr)

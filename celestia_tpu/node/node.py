@@ -346,6 +346,7 @@ class Node:
 
     def produce_block(self, block_time: float | None = None) -> Block:
         with self._lock:
+            # lint: allow(C002,C003) reason=block application is atomic under the node RLock by design: the extend/commit runs inside the apply window so readers never see a half-applied height (same tradeoff the C005 baseline documents)
             return self._produce_block_locked(block_time)
 
     def _produce_block_locked(self, block_time: float | None) -> Block:
@@ -378,6 +379,7 @@ class Node:
             proposal = ProposalBlockData(
                 txs=list(txs), square_size=square_size, hash=data_hash
             )
+            # lint: allow(C002,C003) reason=external block application is atomic under the node RLock by design (two concurrent commit deliveries must not stack); the extend runs inside the apply window
             return self._apply_block_locked(
                 proposal, block_time, own=False, evidence=evidence
             )

@@ -31,6 +31,10 @@ def main(argv: list[str] | None = None) -> int:
                         "scenario ledger (read by make bench-gate)")
     p.add_argument("--quiet", action="store_true",
                    help="suppress the report summary on stdout")
+    p.add_argument("--san", action="store_true",
+                   help="run under the celestia-san runtime sanitizer "
+                        "(specs/analysis.md) and fail on any new "
+                        "T-finding observed during the scenario")
     args = p.parse_args(argv)
 
     if args.list:
@@ -44,10 +48,36 @@ def main(argv: list[str] | None = None) -> int:
     except KeyError as e:
         p.error(str(e))
 
-    report = run_scenario(scenario, seed=args.seed,
-                          duration_scale=args.duration_scale,
-                          report_path=args.report,
-                          ledger_path=args.ledger)
+    # the scenario world itself (scenarios/) is outside sanitizer
+    # scope; the serving stack it drives is inside — a new T-finding
+    # under a production-emulation timeline fails the run
+    san_session = None
+    if args.san:
+        from celestia_tpu.tools import sanitizer
+
+        san_session = sanitizer.Session()
+        sanitizer.activate(san_session)
+    try:
+        report = run_scenario(scenario, seed=args.seed,
+                              duration_scale=args.duration_scale,
+                              report_path=args.report,
+                              ledger_path=args.ledger)
+    finally:
+        if san_session is not None:
+            sanitizer.deactivate(san_session)
+    if san_session is not None:
+        import pathlib
+
+        root = pathlib.Path(__file__).resolve().parents[2]
+        srep = sanitizer.finalize(san_session, root, coverage=False)
+        if srep.new_findings:
+            print(f"celestia-san: {len(srep.new_findings)} new runtime "
+                  "finding(s) during the scenario:", file=sys.stderr)
+            for f in srep.new_findings:
+                print(f"  {f.render()}", file=sys.stderr)
+            return 1
+        print(f"celestia-san: clean ({len(srep.tokens)} tokens, "
+              f"{len(srep.edges)} edges observed)", file=sys.stderr)
     if not args.quiet:
         _summarize(report)
     return 0 if report["scenario_slo_pass"] else 1

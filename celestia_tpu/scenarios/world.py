@@ -329,6 +329,7 @@ class ScenarioWorld:
                 self.node.grow()
                 self.produced["blocks"] += 1
                 return h
+            # lint: allow(C002,C003) reason=the scenario world serializes block production on purpose (one producer thread, chaos harness not serving stack); the same design is waived at the direct device_put_chunked site below
             return self._produce_block_device(h)
 
     def _produce_block_device(self, h: int) -> int:

@@ -33,6 +33,10 @@ class Report:
     new_findings: list[Finding]      # what fails the gate
     waived: int
     baselined: int
+    # baseline entries whose fingerprint no longer matches ANY raw
+    # finding — "harmless but misleading" (specs/analysis.md); CI gates
+    # on them via --prune-baseline
+    stale_baseline: list[dict] = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> dict:
         by_rule: dict[str, int] = {}
@@ -45,6 +49,7 @@ class Report:
             "new_by_rule": dict(sorted(by_rule.items())),
             "waived": self.waived,
             "baselined": self.baselined,
+            "stale_baseline": self.stale_baseline,
         }
 
 
@@ -76,9 +81,14 @@ def run_analysis(root: pathlib.Path | str,
         if p.exists():
             entries = load_baseline(p)
     new = apply_baseline(after_waivers, entries)
+    raw_fps = {f.fingerprint() for f in findings}
+    stale = [e for e in entries
+             if (e["rule"], e["path"], e["symbol"], e["match"])
+             not in raw_fps]
     return Report(
         all_findings=findings,
         new_findings=new,
         waived=len(findings) - len(after_waivers),
         baselined=len(after_waivers) - len(new),
+        stale_baseline=stale,
     )

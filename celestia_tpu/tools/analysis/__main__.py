@@ -24,8 +24,15 @@ def main(argv: list[str] | None = None) -> int:
                     help="repo root (default: cwd)")
     ap.add_argument("--baseline", default="config/lint_baseline.json",
                     help="committed baseline; pass '' to disable")
-    ap.add_argument("--json", dest="json_out", default=None,
-                    help="write the machine-readable report here")
+    ap.add_argument("--json", dest="json_out", default="lint_report.json",
+                    help="write the machine-readable report here "
+                         "(default: lint_report.json, gitignored; pass "
+                         "'' to disable)")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="fail (exit 1) when the committed baseline "
+                         "carries entries whose fingerprint no longer "
+                         "matches any finding — stale entries are "
+                         "'harmless but misleading'; prune them")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
     args = ap.parse_args(argv)
@@ -56,11 +63,21 @@ def main(argv: list[str] | None = None) -> int:
 
     for f in report.new_findings:
         print(f.render())
+    stale_fail = False
+    if report.stale_baseline:
+        for e in report.stale_baseline:
+            print(f"stale baseline entry: {e['rule']} {e['path']} "
+                  f"[{e['symbol']}] {e['match']} — no finding matches "
+                  "this fingerprint any more; prune it",
+                  file=sys.stderr)
+        stale_fail = args.prune_baseline
     suffix = (f"({len(report.all_findings)} raw, {report.waived} waived, "
               f"{report.baselined} baselined, {elapsed:.1f}s)")
-    if report.new_findings:
-        print(f"celestia-lint: {len(report.new_findings)} new finding(s) "
-              f"{suffix}", file=sys.stderr)
+    if report.new_findings or stale_fail:
+        n = len(report.new_findings)
+        what = (f"{n} new finding(s)" if n else
+                f"{len(report.stale_baseline)} stale baseline entrie(s)")
+        print(f"celestia-lint: {what} {suffix}", file=sys.stderr)
         return 1
     print(f"celestia-lint: clean {suffix}")
     return 0

@@ -24,6 +24,17 @@ from call sites holding lock L (the `_locked` helper convention, e.g.
 `_apply_block_locked`) are analyzed with L pre-held — a fixpoint over
 the intra-class call graph, so the rules neither miss races inside
 helpers nor flag helper bodies that in fact always run locked.
+
+C002/C003 additionally see THROUGH calls: a cross-module call graph
+(imports, `from x import f` aliases, `self._method`, and the
+`run_device`/`submit`/device-executor indirection, including lambda
+arguments) propagates transfer/blocking/fire effects to call sites, so
+`with lock: helper()` is flagged when `helper` transitively reaches a
+device transfer. The probe boundary functions (`faults.fire`, the
+`transfers.*` entry points) are treated as opaque effects — their own
+bodies are not re-expanded, which keeps the effect identity aligned
+with what the runtime sanitizer (tools/sanitizer) can observe.
+Indirect findings carry `:via:<callee>` in the match token.
 """
 
 from __future__ import annotations
@@ -58,6 +69,244 @@ _TELEMETRY_METHODS = {"incr_counter", "set_gauge", "observe", "measure",
 _MUTATORS = {"append", "appendleft", "extend", "extendleft", "add",
              "remove", "discard", "pop", "popleft", "popitem", "clear",
              "insert", "update", "setdefault", "sort"}
+
+
+# calls that hand a callable to another thread (the dispatcher lane /
+# device executor); their callable arguments' effects belong to the
+# call site — the caller blocks on the result, so a held lock is held
+# across whatever the callable does
+_EXECUTOR_TAILS = {"run_device", "submit"}
+
+
+class _EffectIndex:
+    """Project-wide (relpath, qualname) -> transitive effect sets.
+
+    Effects are ("transfer", tail) / ("blocking", tail) / ("fire",
+    "fire"). Built per function from direct calls, then closed over a
+    resolvable call graph: imported-module attribute calls, `from x
+    import f` function aliases, bare module-local calls, `self._m`
+    intra-class calls, and executor indirection (`run_device(fn)`,
+    `submit(fn)`, `executor(lambda: ...)` where `executor` came from
+    `_device_executor()`). Functions named like a probe boundary
+    (`fire`, the _TRANSFER_TAILS) are opaque: they ARE their effect."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.rel_by_short: dict[str, str | None] = {}
+        for mod in project.modules:
+            if mod.name in self.rel_by_short:
+                self.rel_by_short[mod.name] = None  # ambiguous
+            else:
+                self.rel_by_short[mod.name] = mod.relpath
+        self.funcs: dict[tuple[str, str], ast.AST] = {}
+        self.mod_aliases: dict[str, dict[str, str]] = {}
+        self.func_aliases: dict[str, dict[str, tuple[str, str]]] = {}
+        for mod in project.modules:
+            self._index_imports(mod)
+            for node in mod.tree.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    self.funcs[(mod.relpath, node.name)] = node
+                elif isinstance(node, ast.ClassDef):
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            self.funcs[
+                                (mod.relpath, f"{node.name}.{sub.name}")
+                            ] = sub
+        self.direct: dict[tuple, set] = {}
+        self.calls: dict[tuple, set] = {}
+        for (rel, qual), func in self.funcs.items():
+            mod = next(m for m in project.modules if m.relpath == rel)
+            cls = qual.split(".", 1)[0] if "." in qual else None
+            tail = qual.rsplit(".", 1)[-1]
+            if tail == "fire" and mod.name == "faults":
+                self.direct[(rel, qual)] = {("fire", "fire")}
+                self.calls[(rel, qual)] = set()
+                continue
+            if tail in _TRANSFER_TAILS:
+                self.direct[(rel, qual)] = {("transfer", tail)}
+                self.calls[(rel, qual)] = set()
+                continue
+            eff, calls = self._scan_body(mod, cls, func)
+            self.direct[(rel, qual)] = eff
+            self.calls[(rel, qual)] = calls
+        # fixpoint closure
+        self.trans = {k: set(v) for k, v in self.direct.items()}
+        for _ in range(len(self.funcs)):
+            changed = False
+            for k, callees in self.calls.items():
+                cur = self.trans[k]
+                before = len(cur)
+                for c in callees:
+                    cur |= self.trans.get(c, set())
+                if len(cur) != before:
+                    changed = True
+            if not changed:
+                break
+
+    # -- import maps -----------------------------------------------------
+    def _index_imports(self, mod: Module) -> None:
+        mods: dict[str, str] = {}
+        funcs: dict[str, tuple[str, str]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    short = a.name.rsplit(".", 1)[-1]
+                    mods[a.asname or short] = short
+            elif isinstance(node, ast.ImportFrom):
+                src_short = (node.module or "").rsplit(".", 1)[-1]
+                for a in node.names:
+                    if a.name in self.rel_by_short:
+                        mods[a.asname or a.name] = a.name
+                    elif src_short:
+                        funcs[a.asname or a.name] = (src_short, a.name)
+        self.mod_aliases[mod.relpath] = mods
+        self.func_aliases[mod.relpath] = funcs
+
+    # -- per-function direct effects -------------------------------------
+    @staticmethod
+    def _walk_own(func: ast.AST):
+        """Walk a function body, skipping nested defs and lambdas."""
+        stack = list(getattr(func, "body", []))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _scan_body(self, mod: Module, cls: str | None,
+                   func: ast.AST) -> tuple[set, set]:
+        effects: set = set()
+        calls: set = set()
+        executor_locals: set[str] = set()
+        for node in self._walk_own(func):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                vname = dotted(node.value.func) or ""
+                if vname.rsplit(".", 1)[-1] == "_device_executor":
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            executor_locals.add(tgt.id)
+        for node in self._walk_own(func):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func) or ""
+            tail = name.rsplit(".", 1)[-1]
+            if tail in _TRANSFER_TAILS:
+                effects.add(("transfer", tail))
+            elif name in _BLOCKING:
+                effects.add(("blocking", tail))
+            if tail == "fire" and (name.startswith("faults.")
+                                   or name == "fire"):
+                effects.add(("fire", "fire"))
+            key = self.resolve_call(mod, cls, node.func)
+            if key is not None:
+                calls.add(key)
+            for arg in self._callable_args(node, executor_locals):
+                if isinstance(arg, ast.Lambda):
+                    e2, c2 = self._scan_lambda(mod, cls, arg)
+                    effects |= e2
+                    calls |= c2
+                else:
+                    key = self.resolve_call(mod, cls, arg)
+                    if key is not None:
+                        calls.add(key)
+        return effects, calls
+
+    def _scan_lambda(self, mod: Module, cls: str | None,
+                     lam: ast.Lambda) -> tuple[set, set]:
+        effects: set = set()
+        calls: set = set()
+        for node in ast.walk(lam.body):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func) or ""
+            tail = name.rsplit(".", 1)[-1]
+            if tail in _TRANSFER_TAILS:
+                effects.add(("transfer", tail))
+            elif name in _BLOCKING:
+                effects.add(("blocking", tail))
+            if tail == "fire" and (name.startswith("faults.")
+                                   or name == "fire"):
+                effects.add(("fire", "fire"))
+            key = self.resolve_call(mod, cls, node.func)
+            if key is not None:
+                calls.add(key)
+        return effects, calls
+
+    def _callable_args(self, call: ast.Call,
+                       executor_locals: set[str]):
+        """Callable arguments handed across the executor boundary."""
+        name = dotted(call.func) or ""
+        tail = name.rsplit(".", 1)[-1]
+        is_exec = tail in _EXECUTOR_TAILS or (
+            isinstance(call.func, ast.Name)
+            and call.func.id in executor_locals)
+        if not is_exec:
+            return
+        for arg in call.args[:1]:
+            yield arg
+        for kw in call.keywords:
+            if kw.arg in ("fn", "batch_exec"):
+                yield kw.value
+
+    # -- call resolution -------------------------------------------------
+    def resolve_call(self, mod: Module, cls: str | None,
+                     funcexpr: ast.AST) -> tuple[str, str] | None:
+        name = dotted(funcexpr)
+        if not name:
+            return None
+        parts = name.split(".")
+        tail = parts[-1]
+        if len(parts) == 1:
+            fa = self.func_aliases.get(mod.relpath, {}).get(tail)
+            if fa is not None:
+                short, fn = fa
+                rel = self.rel_by_short.get(short)
+                if rel and (rel, fn) in self.funcs:
+                    return (rel, fn)
+            if (mod.relpath, tail) in self.funcs:
+                return (mod.relpath, tail)
+            return None
+        base = parts[-2]
+        if base == "self" and cls is not None and len(parts) == 2:
+            key = (mod.relpath, f"{cls}.{tail}")
+            return key if key in self.funcs else None
+        short = self.mod_aliases.get(mod.relpath, {}).get(base)
+        if short is not None:
+            rel = self.rel_by_short.get(short)
+            if rel and (rel, tail) in self.funcs:
+                return (rel, tail)
+        return None
+
+    def call_site_effects(self, mod: Module, cls: str | None,
+                          call: ast.Call,
+                          executor_locals: set[str]) -> list[tuple]:
+        """-> [(kind, tail, via)] reachable from this call site."""
+        out: list[tuple] = []
+        key = self.resolve_call(mod, cls, call.func)
+        if key is not None:
+            via = key[1].rsplit(".", 1)[-1]
+            for kind, tail in sorted(self.trans.get(key, ())):
+                out.append((kind, tail, via))
+        for arg in self._callable_args(call, executor_locals):
+            if isinstance(arg, ast.Lambda):
+                eff, calls = self._scan_lambda(mod, cls, arg)
+                closed = set(eff)
+                for c in calls:
+                    closed |= self.trans.get(c, set())
+                for kind, tail in sorted(closed):
+                    out.append((kind, tail, "<lambda>"))
+            else:
+                akey = self.resolve_call(mod, cls, arg)
+                if akey is not None:
+                    via = akey[1].rsplit(".", 1)[-1]
+                    for kind, tail in sorted(self.trans.get(akey, ())):
+                        out.append((kind, tail, via))
+        return out
 
 
 @dataclasses.dataclass
@@ -157,12 +406,21 @@ class _FuncScan:
         self.cls = cls
         self.symbol = symbol
         self.record = record   # False on pass 1 (call-site collection)
+        self.base_held = frozenset(base_held)
         self.local_conds: set[str] = set()
+        self.executor_locals: set[str] = set()
         for sub in ast.walk(func):
             if isinstance(sub, ast.Assign) and _ctor_kind(sub.value) == "cond":
                 for tgt in sub.targets:
                     if isinstance(tgt, ast.Name):
                         self.local_conds.add(tgt.id)
+            if isinstance(sub, ast.Assign) \
+                    and isinstance(sub.value, ast.Call):
+                vname = dotted(sub.value.func) or ""
+                if vname.rsplit(".", 1)[-1] == "_device_executor":
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.executor_locals.add(tgt.id)
         body = getattr(func, "body", [])
         self.visit_block(body, base_held, 0)
 
@@ -346,14 +604,49 @@ class _FuncScan:
                         "with a busy flag instead)",
             ))
         # C003: fault sites under a lock
-        if tail == "fire" and (name.startswith("faults.")
-                               or name == "fire"):
+        direct_fire = tail == "fire" and (name.startswith("faults.")
+                                          or name == "fire")
+        if direct_fire:
             self.a.findings.append(Finding(
                 rule="C003", path=self.mod.relpath, line=call.lineno,
                 symbol=self.symbol, match=f"{held[-1]}:fire",
                 message=f"faults.fire() while holding {held[-1]} — an "
                         "injected delay would convoy every waiter",
             ))
+        # indirect effects: the cross-module call graph sees transfers/
+        # blocking/fire reached through helpers, run_device and the
+        # device-executor indirection (lambda args included). Reported
+        # at the frame that ACQUIRED the lock — a helper running with
+        # the lock pre-held (locked-helper fixpoint) stays quiet so a
+        # five-deep call chain yields one finding, not five
+        if held[-1] in self.base_held:
+            return
+        direct_block = tail in _TRANSFER_TAILS or name in _BLOCKING
+        for kind, etail, via in self.a.effects.call_site_effects(
+                self.mod, self.cls, call, self.executor_locals):
+            if kind == "fire":
+                if direct_fire:
+                    continue
+                self.a.note_indirect(Finding(
+                    rule="C003", path=self.mod.relpath,
+                    line=call.lineno, symbol=self.symbol,
+                    match=f"{held[-1]}:fire:via:{via}",
+                    message=f"call reaches faults.fire() through "
+                            f"{via}() while holding {held[-1]} — an "
+                            "injected delay would convoy every waiter",
+                ))
+            else:
+                if direct_block:
+                    continue
+                self.a.note_indirect(Finding(
+                    rule="C002", path=self.mod.relpath,
+                    line=call.lineno, symbol=self.symbol,
+                    match=f"{held[-1]}:{etail}:via:{via}",
+                    message=f"call reaches {etail}() through {via}() "
+                            f"while holding {held[-1]} — run transfers/"
+                            "blocking work unlocked (fence with a busy "
+                            "flag instead)",
+                ))
         # implied leaf-lock edges for the C001 graph
         base_name = name.rsplit(".", 2)
         if tail in _TELEMETRY_METHODS and ("metrics" in base_name[0]
@@ -372,6 +665,7 @@ class _FuncScan:
 class ConcurrencyPass:
     def __init__(self, project: Project):
         self.project = project
+        self.effects = _EffectIndex(project)
         self.locks, self.attr_owners = _collect_locks(project)
         self._kinds: dict[str, str] = {}
         for classes in self.locks.values():
@@ -383,6 +677,11 @@ class ConcurrencyPass:
                         self._kinds[info.attr] = info.kind
         self.edges: list[_Edge] = []
         self.findings: list[Finding] = []
+        # indirect (":via:") findings, deduped by fingerprint; folded
+        # into findings at the end of run() unless a DIRECT finding
+        # already covers the same lock/tail (the helper was analyzed
+        # with the lock pre-held and flagged at the inner line)
+        self.indirect: dict[tuple, Finding] = {}
         # (module, class, callee) -> list of held tuples at call sites,
         # tagged with the calling method name
         self.call_sites: dict[tuple, list[tuple[str, tuple]]] = {}
@@ -392,6 +691,9 @@ class ConcurrencyPass:
 
     def kind_of(self, attr: str) -> str:
         return self._kinds.get(attr, "lock")
+
+    def note_indirect(self, f: Finding) -> None:
+        self.indirect.setdefault(f.fingerprint(), f)
 
     def note_call_site(self, modname: str, cls: str, caller_sym: str,
                        callee: str, held: tuple) -> None:
@@ -481,6 +783,13 @@ class ConcurrencyPass:
                 if isinstance(node, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
                     _FuncScan(self, mod, None, node, node.name, (), True)
+        direct_cover = {(f.rule, tuple(f.match.split(":")[:2]))
+                        for f in self.findings
+                        if f.rule in ("C002", "C003")}
+        for fp, f in sorted(self.indirect.items()):
+            key = (f.rule, tuple(f.match.split(":")[:2]))
+            if key not in direct_cover:
+                self.findings.append(f)
         self._check_order()
         self._check_unguarded()
         return self.findings

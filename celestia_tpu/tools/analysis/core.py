@@ -39,11 +39,25 @@ RULES = {
     "D102": "wall-clock / RNG call in a DAH-critical module",
     "D103": "float dtype in a byte-level encoding path",
     "D104": "host/device drift hazard inside a jitted function",
+    "D105": "lru_cache on a function whose parameters can receive "
+            "arrays/unhashables in a DAH-critical module",
     "R201": "fault-site registry drift (code vs spec vs coverage test)",
     "R202": "telemetry metric written but undocumented in specs",
     "R203": "tracing span emitted but undocumented in specs",
     "R204": "SLO objective references a metric nothing writes",
     "S001": "lint waiver without a reason string",
+    # T-rules are emitted by the RUNTIME sanitizer (tools/sanitizer),
+    # in this same Finding shape so waivers/baseline apply unchanged
+    "T001": "observed lock-order cycle or edge violating the declared "
+            "partial order (runtime)",
+    "T002": "lock actually held across a device transfer / faults.fire "
+            "(runtime)",
+    "T003": "Condition.wait exercised outside a while predicate loop "
+            "(runtime)",
+    "T004": "observed acquisition edge absent from the declared partial "
+            "order (spec completeness, runtime)",
+    "T005": "declared lock instantiated but never exercised by the "
+            "sanitized run (contract-coverage drift, runtime)",
 }
 
 

@@ -16,19 +16,28 @@ whose output feeds the DAH:
         traced parameter silently falls back to host semantics, and a
         Python `if` on a non-static parameter burns the branch into the
         compiled program for every subsequent call
+  D105  `functools.lru_cache`/`cache` on a function whose parameters
+        can receive arrays or other unhashables — a geometry key done
+        wrong (`_jitted_gather(page_shape)` but with the page itself)
+        is a TypeError at height N or a silent retrace per call; cache
+        keys must be hashable scalars (int/bool/bytes/str/tuple)
 """
 
 from __future__ import annotations
 
 import ast
+import re
 
 from celestia_tpu.tools.analysis.core import (
     Finding, Module, Project, dotted, enclosing_symbol,
 )
 
-# module short-names whose bytes feed the DataAvailabilityHeader
+# module short-names whose bytes feed the DataAvailabilityHeader —
+# ragged (cross-height sample batching), pipeline (block apply legs)
+# and parallel (row-sharded extend) joined the DAH-critical set after
+# ADR-020 first scoped this list
 DAH_MODULES = {"shares", "square", "da", "proof", "extend_tpu",
-               "rs_pallas"}
+               "rs_pallas", "ragged", "pipeline", "parallel"}
 
 _WALLCLOCK = {"time.time", "time.time_ns", "datetime.now",
               "datetime.utcnow", "datetime.datetime.now"}
@@ -36,6 +45,17 @@ _RNG_PREFIXES = ("random.", "np.random.", "numpy.random.",
                  "jax.random.", "secrets.")
 _RNG_BARE = {"urandom", "getrandbits", "randbytes"}
 _FLOAT_DTYPES = {"float16", "float32", "float64", "bfloat16", "float"}
+
+# D105: lru_cache parameter hygiene. Annotations whose tail names an
+# unhashable (or an array type), and array-ish parameter names for the
+# un-annotated case.
+_CACHE_DECOS = {"lru_cache", "cache"}
+_UNHASHABLE_ANN = {"ndarray", "Array", "ArrayLike", "DeviceArray",
+                   "list", "List", "dict", "Dict", "set", "Set",
+                   "bytearray", "deque"}
+_ARRAYISH_NAME = re.compile(
+    r"(?:^|_)(arr|array|data|shares?|square|eds|page|pages|buf|buffer|"
+    r"mat|rows?|cols?|cells?|payloads?|blobs?|chunks?)(?:_|$)")
 
 
 def _is_dah_module(mod: Module) -> bool:
@@ -155,12 +175,66 @@ def _scan_module(mod: Module) -> list[Finding]:
         # D104: hazards inside jitted functions
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             jitted, static = _jit_static_names(node)
-            if not jitted:
-                continue
-            params = {a.arg for a in node.args.args
-                      + node.args.posonlyargs + node.args.kwonlyargs}
-            traced = params - static - {"self"}
-            findings.extend(_scan_jitted(mod, node, traced))
+            if jitted:
+                params = {a.arg for a in node.args.args
+                          + node.args.posonlyargs + node.args.kwonlyargs}
+                traced = params - static - {"self"}
+                findings.extend(_scan_jitted(mod, node, traced))
+            # D105: lru_cache keyed by something unhashable
+            if _is_cached(node):
+                findings.extend(_scan_cached(mod, node))
+    return findings
+
+
+def _is_cached(func: ast.AST) -> bool:
+    for dec in getattr(func, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted(target) or ""
+        if name.rsplit(".", 1)[-1] in _CACHE_DECOS:
+            return True
+    return False
+
+
+def _ann_tail(ann: ast.AST) -> str | None:
+    """'np.ndarray' -> 'ndarray'; 'list[int]' -> 'list'."""
+    if isinstance(ann, ast.Subscript):
+        ann = ann.value
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.rsplit(".", 1)[-1].split("[", 1)[0]
+    name = dotted(ann)
+    if name:
+        return name.rsplit(".", 1)[-1]
+    return None
+
+
+def _scan_cached(mod: Module, func: ast.AST) -> list[Finding]:
+    findings: list[Finding] = []
+    symbol = enclosing_symbol(mod.tree, func)
+    if symbol == "<module>":
+        symbol = func.name
+    args = (func.args.posonlyargs + func.args.args
+            + func.args.kwonlyargs)
+    for a in args:
+        if a.arg == "self":
+            continue
+        tail = _ann_tail(a.annotation) if a.annotation is not None \
+            else None
+        unhashable_ann = tail in _UNHASHABLE_ANN
+        arrayish_unannotated = (a.annotation is None
+                                and _ARRAYISH_NAME.search(a.arg))
+        if not unhashable_ann and not arrayish_unannotated:
+            continue
+        why = (f"annotated {tail!r}" if unhashable_ann
+               else "un-annotated array-ish name")
+        findings.append(Finding(
+            rule="D105", path=mod.relpath, line=func.lineno,
+            symbol=symbol, match=f"{func.name}:{a.arg}",
+            message=f"lru_cache on {func.name}() keyed by parameter "
+                    f"{a.arg!r} ({why}) in a DAH-critical module — "
+                    "arrays are unhashable (TypeError at height N) and "
+                    "hashable proxies silently retrace; key caches by "
+                    "scalar geometry (ints/tuples/bytes) only",
+        ))
     return findings
 
 

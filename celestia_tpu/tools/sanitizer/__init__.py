@@ -1,0 +1,39 @@
+"""celestia-san: opt-in runtime lock-order & device-boundary sanitizer.
+
+The dynamic half of the ADR-020 contract guard. celestia-lint proves
+the *declared* lock order is never contradicted by the AST; this
+package proves the *observed* order matches it — and that the spec is
+complete (T004) and exercised (T005), which no static pass can.
+
+    from celestia_tpu.tools import sanitizer
+
+    with sanitizer.Session() as sess:
+        ...drive the serving stack...
+    report = sanitizer.finalize(sess, root=".")
+    report.new_findings        # T001-T005, celestia-lint Finding shape
+
+Zero overhead when off: activation swaps the `threading` lock
+factories; deactivation restores them. Rules, activation contract and
+the overhead budget live in specs/analysis.md ("Runtime sanitizer").
+Cross-validation against the static analyzer is `cross_validate()`;
+`make san` wires the whole thing as a tier-1 gate.
+"""
+
+from __future__ import annotations
+
+from celestia_tpu.tools.sanitizer.crossval import (  # noqa: F401
+    CrossvalResult, cross_validate,
+)
+from celestia_tpu.tools.sanitizer.report import (  # noqa: F401
+    SanReport, finalize,
+)
+from celestia_tpu.tools.sanitizer.runtime import (  # noqa: F401
+    Session, activate, deactivate, default_scope, is_active,
+    probe_names,
+)
+
+__all__ = [
+    "CrossvalResult", "SanReport", "Session", "activate",
+    "cross_validate", "deactivate", "default_scope", "finalize",
+    "is_active", "probe_names",
+]

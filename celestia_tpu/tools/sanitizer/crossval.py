@@ -1,0 +1,200 @@
+"""Static <-> runtime cross-validation (celestia-lint x celestia-san).
+
+Two directions, both gated by `make san`:
+
+  1. Mapping: every static C001/C002/C003 rule-site must map to an
+     *instrumentable* runtime site — the lock token must resolve to an
+     instance lock created inside the sanitizer's scope (or an adopted
+     singleton), and the blocking tail must be one the runtime probes.
+     A static finding the sanitizer could never reproduce means the
+     runtime guard has a blind spot; the gate fails until a probe or a
+     scope extension closes it. Sites in `testutil/`/`scenarios/` are
+     excluded from runtime scope BY DESIGN (the chaosnet facade and the
+     scenario world are test harness, not the serving stack) and are
+     reported as `static_only`, not failures; likewise module-global
+     locks created at import time, before any session can exist.
+
+  2. Suppression drift: a statically waived or baselined C001/C002/C003
+     finding whose runtime twin (same match token) actually FIRED is a
+     gate failure — the waiver claimed the hazard was theoretical and
+     the sanitizer just watched it happen.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+
+from celestia_tpu.tools.analysis import concurrency
+from celestia_tpu.tools.analysis.core import (
+    Finding, apply_baseline, apply_waivers, collect_waivers,
+    load_baseline, load_project,
+)
+from celestia_tpu.tools.sanitizer import runtime
+from celestia_tpu.tools.sanitizer.report import SanReport
+
+# static rule -> runtime twin
+_RULE_TWIN = {"C001": "T001", "C002": "T002", "C003": "T002"}
+
+
+@dataclasses.dataclass
+class CrossvalResult:
+    unmappable: list[dict]        # static sites the runtime cannot see
+    waived_but_fired: list[dict]  # suppressed statically, fired live
+    static_only: list[dict]       # out of runtime scope by design
+    mapped: int                   # static sites with a runtime twin
+
+    @property
+    def ok(self) -> bool:
+        return not self.unmappable and not self.waived_but_fired
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self) | {"ok": self.ok}
+
+
+def _instance_lock_scopes(project) -> dict[str, list[str]]:
+    """token -> relpaths where it is created as an INSTANCE lock
+    (`self.x = threading.Lock()` — the factory swap sees those)."""
+    by_module, _owners = concurrency._collect_locks(project)
+    out: dict[str, list[str]] = {}
+    for relpath, classes in by_module.items():
+        for cls, attrs in classes.items():
+            if cls is None:
+                continue  # module-global: created at import time
+            for info in attrs.values():
+                out.setdefault(info.token, []).append(relpath)
+    return out
+
+
+def _in_runtime_scope(relpath: str) -> bool:
+    return runtime.default_scope(f"/{relpath}")
+
+
+def cross_validate(root: pathlib.Path | str,
+                   san_report: SanReport | None = None,
+                   baseline_path: pathlib.Path | str | None = None,
+                   ) -> CrossvalResult:
+    root = pathlib.Path(root)
+    project = load_project(root)
+    raw = concurrency.ConcurrencyPass(project).run()
+    conc = [f for f in raw if f.rule in _RULE_TWIN]
+
+    adopted_tokens = {token for _m, _o, _a, token in runtime._ADOPTIONS}
+    instance_scopes = _instance_lock_scopes(project)
+    probes = set(runtime.probe_names())
+
+    def lock_mappable(token: str) -> tuple[bool, str]:
+        if token in adopted_tokens:
+            return True, "adopted singleton"
+        rels = instance_scopes.get(token)
+        if not rels:
+            return False, "module-global lock (created at import time)"
+        if any(_in_runtime_scope(r) for r in rels):
+            return True, "factory-swapped instance lock"
+        return False, "created outside runtime scope"
+
+    def finding_tail(f: Finding) -> str:
+        """The blocking tail the runtime would have to observe. C003 is
+        blocking-under-lock, which the runtime sees via faults.fire."""
+        if f.rule == "C003":
+            return "fire"
+        tail = (f.match.split(":", 1) + [""])[1]
+        return tail.split(":", 1)[0]  # drop any :via: suffix
+
+    # Mapping is per rule-SITE: a `with lock:` window that blocks via
+    # several tails (device_put AND a fire-bearing chain, say) is
+    # instrumentable as long as ANY of its tails is probed — the
+    # sanitizer observes the same held-across-boundary window through
+    # the sibling probe. Pre-compute which sites have a probed tail.
+    probed_sites: set[tuple[str, int]] = set()
+    for f in conc:
+        if f.rule in ("C002", "C003") and finding_tail(f) in probes:
+            probed_sites.add((f.path, f.line))
+
+    unmappable: list[dict] = []
+    static_only: list[dict] = []
+    mapped = 0
+    for f in conc:
+        entry = {"rule": f.rule, "path": f.path, "line": f.line,
+                 "match": f.match, "twin": _RULE_TWIN[f.rule]}
+        if not _in_runtime_scope(f.path):
+            static_only.append(entry | {
+                "why": "site excluded from runtime scope by design"})
+            continue
+        if f.rule == "C001":
+            toks = [t for t in f.match.replace("<->", "->").split("->")
+                    if t]
+        else:
+            toks = [f.match.split(":", 1)[0]]
+        bad_lock = None
+        for t in toks:
+            ok, why = lock_mappable(t)
+            if not ok:
+                bad_lock = (t, why)
+                break
+        if bad_lock is not None:
+            t, why = bad_lock
+            if why == "created outside runtime scope":
+                static_only.append(entry | {"why": f"{t}: {why}"})
+            else:
+                unmappable.append(entry | {"why": f"{t}: {why}"})
+            continue
+        if f.rule in ("C002", "C003"):
+            tail = finding_tail(f)
+            if tail not in probes \
+                    and (f.path, f.line) not in probed_sites:
+                unmappable.append(entry | {
+                    "why": f"blocking tail {tail!r} has no runtime "
+                           "probe and no probed sibling at this site"})
+                continue
+        mapped += 1
+
+    # suppression drift: which static findings were waived/baselined?
+    waivers = []
+    for mod in project.modules + project.test_files:
+        ws, _bad = collect_waivers(mod)
+        waivers.extend(ws)
+    after_waivers = apply_waivers(conc, waivers)
+    entries = []
+    if baseline_path is None:
+        baseline_path = root / "config" / "lint_baseline.json"
+    bp = pathlib.Path(baseline_path)
+    if bp.exists():
+        entries = load_baseline(bp)
+    after_baseline = apply_baseline(after_waivers, entries)
+    live = {f.fingerprint() for f in after_baseline}
+    suppressed = [f for f in conc if f.fingerprint() not in live]
+
+    def twin_match(f: Finding) -> str:
+        """Static match -> the runtime twin's match shape: drop any
+        `:via:callee` suffix, and C003 (blocking under lock) surfaces
+        at runtime as the faults.fire probe."""
+        if f.rule == "C001":
+            return f.match
+        tok = f.match.split(":", 1)[0]
+        if f.rule == "C003":
+            return f"{tok}:fire"
+        tail = (f.match.split(":", 2) + ["", ""])[1]
+        return f"{tok}:{tail}"
+
+    waived_but_fired: list[dict] = []
+    if san_report is not None:
+        fired: dict[tuple[str, str], Finding] = {}
+        for rf in san_report.all_findings:
+            if rf.rule in ("T001", "T002"):
+                fired[(rf.rule, rf.match)] = rf
+        for f in suppressed:
+            twin = fired.get((_RULE_TWIN[f.rule], twin_match(f)))
+            if twin is not None:
+                waived_but_fired.append({
+                    "rule": f.rule, "path": f.path, "line": f.line,
+                    "match": f.match,
+                    "runtime": f"{twin.rule} at {twin.path}:{twin.line}",
+                    "why": "statically suppressed hazard fired at "
+                           "runtime",
+                })
+
+    return CrossvalResult(
+        unmappable=unmappable, waived_but_fired=waived_but_fired,
+        static_only=static_only, mapped=mapped,
+    )

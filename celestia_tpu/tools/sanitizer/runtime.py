@@ -1,0 +1,551 @@
+"""celestia-san runtime: lock instrumentation and event capture.
+
+The sanitizer is OPT-IN and zero-overhead when off: nothing in the
+serving stack imports this module, and until a `Session` is activated
+`threading.Lock/RLock/Condition` are the stdlib originals. Activation
+swaps the three factories for wrappers (`activate`/`deactivate`, or the
+`Session` context manager); every lock the package creates *after* that
+point is wrapped, and the two process-global singletons that predate
+any session (`telemetry.metrics._lock`, `tracing._tracer._lock`) are
+adopted in place and restored on deactivate.
+
+What gets recorded (all bookkeeping on the real stdlib primitives the
+wrappers own internally, so the sanitizer can never deadlock with the
+code it watches):
+
+  * per-thread acquisition stacks -> first-seen acquisition EDGES,
+    keyed by lock *creation site* (every `_Job.lock` is one site, so
+    memory is bounded by code shape, not object count)
+  * hold durations (count / total / max) per creation site
+  * bracketed probe entry: `faults.fire` and the `ops.transfers` device
+    entry points are wrapped while a session is active; a probe entered
+    with sanitized locks held is a T002 event
+  * `Condition.wait` call sites (T003 lexical re-check happens at
+    report time) — `wait_for` re-checks its predicate internally and is
+    exempt by construction
+
+Scope: only locks created from files under ``celestia_tpu/`` are
+instrumented, excluding ``testutil/`` (the chaosnet facade),
+``scenarios/`` (the scenario world's own locks) and ``tools/`` (the
+analyzer and this package). Sessions nest: a lock belongs to the
+innermost active session whose scope matched its creation frame, so the
+seeded-defect fixtures in tests/test_sanitizer.py run their own
+sessions inside `pytest --san` without contaminating the outer gate.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+import threading
+import time
+
+# stdlib originals, captured at import time — the wrappers and all
+# internal bookkeeping use THESE, never the (possibly patched) module
+# attributes, so instrumentation cannot recurse into itself
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+_THIS_FILE = os.path.abspath(__file__)
+_THREADING_FILE = getattr(threading, "__file__", "<threading>")
+
+_EXCLUDED_DIRS = ("testutil", "scenarios", "tools")
+
+_PROBE_TRANSFERS = (
+    "device_put_chunked", "device_get_chunked", "device_put_sharded_rows",
+    "eds_row", "eds_col", "eds_share", "eds_rows_batch", "eds_cells_batch",
+)
+
+# singletons created at import time, before any session could patch the
+# factories: wrapped in place at activate, restored at deactivate
+_ADOPTIONS = (
+    ("celestia_tpu.telemetry", "metrics", "_lock", "telemetry._lock"),
+    ("celestia_tpu.tracing", "_tracer", "_lock", "tracing._lock"),
+)
+
+
+def default_scope(filename: str) -> bool:
+    """True when a lock created from `filename` should be sanitized."""
+    f = filename.replace("\\", "/")
+    if "/celestia_tpu/" not in f:
+        return False
+    tail = f.rsplit("/celestia_tpu/", 1)[1]
+    return tail.split("/", 1)[0] not in _EXCLUDED_DIRS
+
+
+# --- creation-site registry (process-global, interned) ----------------- #
+
+class Site:
+    __slots__ = ("sid", "file", "line", "token")
+
+    def __init__(self, sid: int, file: str, line: int,
+                 token: str | None):
+        self.sid = sid
+        self.file = file
+        self.line = line
+        self.token = token  # preset for adopted singletons, else None
+
+
+_registry_lock = _REAL_RLOCK()
+_sites: dict[tuple, Site] = {}
+_sid_counter = itertools.count(1)
+_session_stack: list["Session"] = []
+_probe_patches: list[tuple] = []
+
+
+def _intern_site(file: str, line: int, token: str | None = None) -> Site:
+    key = (file, line, token)
+    with _registry_lock:
+        site = _sites.get(key)
+        if site is None:
+            site = Site(next(_sid_counter), file, line, token)
+            _sites[key] = site
+        return site
+
+
+# --- per-thread held stack --------------------------------------------- #
+
+_tls = threading.local()
+
+
+class _Held:
+    __slots__ = ("wrapper", "t0")
+
+    def __init__(self, wrapper, t0):
+        self.wrapper = wrapper
+        self.t0 = t0
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _caller_site() -> tuple[str, int]:
+    """First frame outside this module and threading."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn != _THIS_FILE and fn != _THREADING_FILE:
+            return fn, f.f_lineno
+        f = f.f_back
+    return "<unknown>", 0
+
+
+# --- the session -------------------------------------------------------- #
+
+class Session:
+    """One sanitized run. Use as a context manager::
+
+        with sanitizer.Session() as sess:
+            ... drive the serving stack ...
+        report = sanitizer.finalize(sess, root)
+    """
+
+    def __init__(self, scope=None):
+        self._ilock = _REAL_LOCK()
+        self.active = False
+        self.scope = scope if scope is not None else default_scope
+        # (outer_sid, inner_sid) -> {count, file, line} (first-seen site)
+        self.edges: dict[tuple[int, int], dict] = {}
+        self.acquires: dict[int, int] = {}            # sid -> count
+        self.holds: dict[int, list] = {}              # sid -> [n, tot, max]
+        self.t002: dict[tuple[int, str], dict] = {}   # (sid, tail) -> obs
+        self.wait_sites: dict[tuple[str, int], int] = {}  # site -> sid
+        self.probes_entered: set[str] = set()
+        self.owned_sites: dict[int, Site] = {}        # sid -> Site
+        self._adopted: list[tuple] = []               # (obj, attr, orig)
+
+    # -- context manager -------------------------------------------------
+    def __enter__(self) -> "Session":
+        activate(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        deactivate(self)
+
+    # -- event recording (called from wrappers; self.active is True) -----
+    def _own_site(self, site: Site) -> None:
+        with self._ilock:
+            self.owned_sites[site.sid] = site
+
+    def _record_acquire(self, wrapper, held: list) -> None:
+        sid = wrapper._site.sid
+        outer_sids = []
+        for h in held:
+            w = h.wrapper
+            if w is wrapper:
+                continue
+            outer_sids.append(w._site.sid)
+        with self._ilock:
+            self.acquires[sid] = self.acquires.get(sid, 0) + 1
+            fresh = [o for o in outer_sids
+                     if (o, sid) not in self.edges and o != sid]
+            for o in outer_sids:
+                e = self.edges.get((o, sid))
+                if e is not None:
+                    e["count"] += 1
+        if fresh:
+            file, line = _caller_site()
+            with self._ilock:
+                for o in fresh:
+                    self.edges.setdefault(
+                        (o, sid), {"count": 1, "file": file, "line": line})
+
+    def _record_hold(self, wrapper, duration: float) -> None:
+        sid = wrapper._site.sid
+        with self._ilock:
+            h = self.holds.get(sid)
+            if h is None:
+                self.holds[sid] = [1, duration, duration]
+            else:
+                h[0] += 1
+                h[1] += duration
+                if duration > h[2]:
+                    h[2] = duration
+
+    def _record_probe_hit(self, wrapper, tail: str,
+                          file: str, line: int) -> None:
+        key = (wrapper._site.sid, tail)
+        with self._ilock:
+            e = self.t002.get(key)
+            if e is None:
+                self.t002[key] = {"count": 1, "file": file, "line": line}
+            else:
+                e["count"] += 1
+
+    def _record_wait_site(self, wrapper, file: str, line: int) -> None:
+        with self._ilock:
+            self.wait_sites.setdefault((file, line), wrapper._site.sid)
+
+    # -- singleton adoption ----------------------------------------------
+    def _adopt(self) -> None:
+        import importlib
+        for modname, objname, attr, token in _ADOPTIONS:
+            try:
+                mod = importlib.import_module(modname)
+                obj = getattr(mod, objname)
+                cur = getattr(obj, attr)
+            except Exception:
+                continue
+            if isinstance(cur, _SanBase):
+                continue  # already adopted by an outer session
+            site = _intern_site(f"<adopted:{token}>", 0, token=token)
+            self._own_site(site)
+            setattr(obj, attr, SanLock(cur, site, self))
+            self._adopted.append((obj, attr, cur))
+
+    def _restore(self) -> None:
+        for obj, attr, orig in reversed(self._adopted):
+            try:
+                setattr(obj, attr, orig)
+            except Exception:
+                pass
+        self._adopted.clear()
+
+
+# --- wrappers ----------------------------------------------------------- #
+
+class _SanBase:
+    __slots__ = ("_inner", "_site", "_session")
+
+    def __init__(self, inner, site: Site, session: Session):
+        self._inner = inner
+        self._site = site
+        self._session = session
+
+    def _acquired(self) -> None:
+        st = _stack()
+        sess = self._session
+        if sess.active:
+            sess._record_acquire(self, st)
+        st.append(_Held(self, time.monotonic()))
+
+    def _released(self) -> None:
+        st = _stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i].wrapper is self:
+                h = st.pop(i)
+                sess = self._session
+                if sess.active:
+                    sess._record_hold(self, time.monotonic() - h.t0)
+                return
+
+    def __repr__(self):
+        return f"<san {type(self).__name__} of {self._inner!r}>"
+
+
+class SanLock(_SanBase):
+    __slots__ = ()
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._acquired()
+        return ok
+
+    def release(self):
+        self._released()
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class SanRLock(_SanBase):
+    __slots__ = ("_owner", "_depth")
+
+    def __init__(self, inner, site, session):
+        super().__init__(inner, site, session)
+        self._owner = None
+        self._depth = 0
+
+    def acquire(self, blocking=True, timeout=-1):
+        me = threading.get_ident()
+        if self._owner == me:
+            # re-entrant: no stack push, no edge (mirrors the static
+            # analyzer, which sees one `with` nest per token)
+            ok = self._inner.acquire(blocking, timeout)
+            if ok:
+                self._depth += 1
+            return ok
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._owner = me
+            self._depth = 1
+            self._acquired()
+        return ok
+
+    def release(self):
+        if self._owner == threading.get_ident() and self._depth > 1:
+            self._depth -= 1
+            self._inner.release()
+            return
+        self._owner = None
+        self._depth = 0
+        self._released()
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class SanCondition(_SanBase):
+    __slots__ = ()
+
+    def acquire(self, *a, **kw):
+        ok = self._inner.acquire(*a, **kw)
+        if ok:
+            self._acquired()
+        return ok
+
+    def release(self):
+        self._released()
+        self._inner.release()
+
+    def __enter__(self):
+        self._inner.__enter__()
+        self._acquired()
+        return self
+
+    def __exit__(self, *exc):
+        self._released()
+        return self._inner.__exit__(*exc)
+
+    def _wait_inner(self, timeout):
+        # cond.wait releases the underlying lock: pop the held entry for
+        # the duration so concurrent acquisitions don't see a phantom
+        # outer lock, then re-push without re-recording the edge
+        st = _stack()
+        held = None
+        for i in range(len(st) - 1, -1, -1):
+            if st[i].wrapper is self:
+                held = st.pop(i)
+                break
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            if held is not None:
+                st.append(_Held(self, time.monotonic()))
+
+    def wait(self, timeout=None):
+        sess = self._session
+        if sess.active:
+            file, line = _caller_site()
+            sess._record_wait_site(self, file, line)
+        return self._wait_inner(timeout)
+
+    def wait_for(self, predicate, timeout=None):
+        # stdlib semantics, routed through _wait_inner; the predicate is
+        # re-checked here, so wait_for sites are T003-exempt
+        endtime = None
+        waittime = timeout
+        result = predicate()
+        while not result:
+            if waittime is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + waittime
+                else:
+                    waittime = endtime - time.monotonic()
+                    if waittime <= 0:
+                        break
+            self._wait_inner(waittime)
+            result = predicate()
+        return result
+
+    def notify(self, n=1):
+        self._inner.notify(n)
+
+    def notify_all(self):
+        self._inner.notify_all()
+
+
+_WRAPPER_FOR = {"Lock": SanLock, "RLock": SanRLock,
+                "Condition": SanCondition}
+
+
+# --- factory swap ------------------------------------------------------- #
+
+def _owner_session(filename: str) -> Session | None:
+    for sess in reversed(_session_stack):
+        if sess.active and sess.scope(filename):
+            return sess
+    return None
+
+
+def _make_factory(kind: str, real):
+    wrapper_cls = _WRAPPER_FOR[kind]
+
+    def factory(*args, **kwargs):
+        inner = real(*args, **kwargs)
+        frame = sys._getframe(1)
+        sess = _owner_session(frame.f_code.co_filename)
+        if sess is None:
+            return inner
+        site = _intern_site(frame.f_code.co_filename, frame.f_lineno)
+        sess._own_site(site)
+        return wrapper_cls(inner, site, sess)
+
+    factory.__name__ = kind
+    factory.__qualname__ = kind
+    return factory
+
+
+def _probed(orig, tail: str):
+    def wrapper(*args, **kwargs):
+        # nested probes are opaque — device_put_chunked firing the
+        # transfer.chunk fault site is ONE boundary crossing, reported
+        # as the outermost entry (mirrors the static analyzer, which
+        # never expands probe bodies)
+        depth = getattr(_tls, "probe_depth", 0)
+        if depth == 0:
+            st = getattr(_tls, "stack", None)
+            if st:
+                file = line = None
+                for h in list(st):
+                    sess = h.wrapper._session
+                    if sess.active:
+                        if file is None:
+                            f = sys._getframe(1)
+                            file, line = f.f_code.co_filename, f.f_lineno
+                        sess._record_probe_hit(h.wrapper, tail, file, line)
+            if _session_stack:
+                sess = _session_stack[-1]
+                if sess.active and tail not in sess.probes_entered:
+                    with sess._ilock:
+                        sess.probes_entered.add(tail)
+        _tls.probe_depth = depth + 1
+        try:
+            return orig(*args, **kwargs)
+        finally:
+            _tls.probe_depth = depth
+
+    wrapper.__name__ = getattr(orig, "__name__", tail)
+    wrapper.__wrapped__ = orig
+    return wrapper
+
+
+def _patch_probes() -> None:
+    targets = []
+    try:
+        from celestia_tpu import faults as _faults
+        targets.append((_faults, "fire", "fire"))
+    except Exception:
+        pass
+    try:
+        from celestia_tpu.ops import transfers as _transfers
+        for name in _PROBE_TRANSFERS:
+            if hasattr(_transfers, name):
+                targets.append((_transfers, name, name))
+    except Exception:
+        pass
+    for mod, name, tail in targets:
+        orig = getattr(mod, name)
+        if getattr(orig, "__wrapped__", None) is not None:
+            continue
+        setattr(mod, name, _probed(orig, tail))
+        _probe_patches.append((mod, name, orig))
+
+
+def _unpatch_probes() -> None:
+    for mod, name, orig in reversed(_probe_patches):
+        try:
+            setattr(mod, name, orig)
+        except Exception:
+            pass
+    _probe_patches.clear()
+
+
+def probe_names() -> tuple[str, ...]:
+    """Every probe tail the runtime can observe ('fire' + transfers)."""
+    return ("fire",) + _PROBE_TRANSFERS
+
+
+def activate(session: Session) -> Session:
+    with _registry_lock:
+        if session in _session_stack:
+            raise RuntimeError("sanitizer session already active")
+        if not _session_stack:
+            threading.Lock = _make_factory("Lock", _REAL_LOCK)
+            threading.RLock = _make_factory("RLock", _REAL_RLOCK)
+            threading.Condition = _make_factory(
+                "Condition", _REAL_CONDITION)
+            _patch_probes()
+        _session_stack.append(session)
+        session.active = True
+        session._adopt()
+    return session
+
+
+def deactivate(session: Session) -> None:
+    with _registry_lock:
+        session.active = False
+        session._restore()
+        if session in _session_stack:
+            _session_stack.remove(session)
+        if not _session_stack:
+            threading.Lock = _REAL_LOCK
+            threading.RLock = _REAL_RLOCK
+            threading.Condition = _REAL_CONDITION
+            _unpatch_probes()
+
+
+def is_active() -> bool:
+    return bool(_session_stack)

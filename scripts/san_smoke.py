@@ -1,0 +1,285 @@
+"""celestia-san smoke gate (`make san`, specs/analysis.md §Runtime
+sanitizer).
+
+Three phases, CPU-only, crypto-free, <120 s wall total:
+
+  1. HAMMER, twice on one seed: an in-process storm over the serving
+     stack's whole lock surface — dispatcher batching storm with
+     concurrent depth reads, resident + paged EDS cache churn with
+     sliced device-page reads, block-store persist + restore-from-disk,
+     gateway ring membership ops and routed fetches, host DA slice
+     reads, an armed fault injector, tracing spans and telemetry.
+     Gates: ZERO new T-findings with the full coverage rules on
+     (T001/T002/T003 hazards, T004 spec completeness, T005
+     exercised-edge coverage) and run-to-run determinism (identical
+     finding fingerprints and identical instrumented-token sets).
+
+  2. CROSS-VALIDATION against celestia-lint: every static C001/C002/
+     C003 rule-site must map to an instrumentable runtime site, and a
+     statically waived/baselined finding whose runtime twin fired in
+     phase 1 fails the gate.
+
+  3. SANITIZED TIER-1 SUBSET: the lock-heavy test files under
+     `pytest --san` in a fresh interpreter (the serving race suite, the
+     continuous-batching suite, and the sanitizer's own seeded-defect
+     fixtures).
+
+Writes san_report.json (gitignored) for trend inspection.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_xla = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla:
+    os.environ["XLA_FLAGS"] = (
+        _xla + " --xla_force_host_platform_device_count=8").strip()
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+BUDGET_S = 120.0
+SEED = 1337
+SAN_TESTS = ["tests/test_sanitizer.py", "tests/test_serving.py",
+             "tests/test_batching.py"]
+
+
+def _preimport() -> None:
+    """Import the whole serving surface BEFORE any session activates:
+    module-global locks (consensus rotation, transfer executor, fault
+    stack, ...) are created at import time and must stay stdlib — only
+    locks created after activation are wrapped, which keeps ownership
+    deterministic across runs."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.devices()
+    from celestia_tpu import blob, da, faults, integrity, state  # noqa: F401
+    from celestia_tpu import telemetry, tracing  # noqa: F401
+    from celestia_tpu.node import dispatch, eds_cache, gateway  # noqa: F401
+    from celestia_tpu.ops import blob_pool, transfers  # noqa: F401
+    from celestia_tpu.store import BlockStore  # noqa: F401
+
+
+def _drive(seed: int, tmpdir: pathlib.Path) -> None:
+    """One storm over the lock surface. Everything here must exercise a
+    declared lock (T005) without inventing undeclared nests (T004)."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from celestia_tpu import da, faults, tracing
+    from celestia_tpu.node.dispatch import DeviceDispatcher, Shed
+    from celestia_tpu.node.eds_cache import PagedEdsCache, ResidentEdsCache
+    from celestia_tpu.node.gateway import Gateway
+    from celestia_tpu.store import BlockStore
+    from celestia_tpu.telemetry import metrics
+
+    from celestia_tpu.testutil.chaosnet import chain_shares
+
+    k = 4
+    eds = da.extend_shares(chain_shares(k, seed % 97))
+    arr = np.asarray(eds.data, dtype=np.uint8)
+
+    # -- dispatcher batching storm + concurrent depth reads ------------
+    disp = DeviceDispatcher(capacity=32, max_batch=8,
+                            batch_window_s=0.002).start()
+
+    def client(tid: int) -> None:
+        for i in range(10):
+            try:
+                assert disp.submit(lambda i=i: i, label="san") == i
+                disp.submit(batch_key=("san",),
+                            batch_exec=lambda ps: [p * 2 for p in ps],
+                            payload=tid * 100 + i)
+            except Shed:
+                pass
+            disp.depth  # torn-read twin: gauge read under _cv
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    disp.begin_drain()
+    disp.drain(timeout=10.0)
+
+    # -- resident + paged EDS cache churn, sliced device-page reads ----
+    resident = ResidentEdsCache(capacity=2)
+    for h in range(1, 5):
+        resident.put(h, ("blob", h))
+        resident.get(h)
+        with resident.pinned(h):
+            pass
+
+    dev_eds = da.ExtendedDataSquare.from_device(jax.device_put(arr), k)
+    paged = PagedEdsCache(rows_per_page=2, device_byte_budget=1 << 20,
+                          max_heights=2)
+    paged.put(10, dev_eds)
+    pe = paged.get(10)
+    pe.row(0)
+    pe.col(1)
+    pe.share(1, 2)
+    pe.rows_batch([0, 3])
+
+    # -- host DA slice reads -------------------------------------------
+    eds.row(0)
+    eds.col(0)
+    eds.share(0, 1)
+
+    # -- device DA slice reads: the slice-cache path (da._slice_lock)
+    #    only runs on a device-backed square with no host copy ---------
+    dev_direct = da.ExtendedDataSquare.from_device(jax.device_put(arr), k)
+    dev_direct.row(0)
+    dev_direct.col(1)
+    dev_direct.share(0, 1)
+    dev_direct.rows_batch([0, 2])
+
+    # -- rpc inflight tracker (near-leaf rpc._cv + gauge publish) ------
+    from celestia_tpu.node.rpc import _InflightTracker
+
+    tracker = _InflightTracker()
+    with tracker:
+        assert tracker.count == 1
+    tracker.wait_idle(timeout=0.1)
+
+    # -- block store: persist, then serve the height back off disk -----
+    store = BlockStore(tmpdir / "store")
+    dah = da.new_data_availability_header(eds)
+    store.put_eds(11, eds.data, k, dah_doc=dah.to_json())
+    restored = PagedEdsCache(rows_per_page=2, store=store)
+    restored.load_from_store(11).row(1)
+
+    # -- gateway: ring membership + routed fetch (dead backend — the
+    #    hedge path and the DAH cache miss path both run) --------------
+    gw = Gateway(backends=["http://127.0.0.1:9/"], timeout_s=0.2)
+    gw.start()
+    try:
+        gw.ring.owners("11:0")
+        gw.add_backend("http://127.0.0.1:1/")
+        gw.remove_backend("http://127.0.0.1:1/")
+        for _ in range(2):
+            try:
+                gw.route("/dah/11")
+            except Exception:
+                pass
+    finally:
+        try:
+            gw.stop()
+        except Exception:
+            pass
+
+    # -- armed injector + fault sites ----------------------------------
+    with faults.inject(faults.rule("san.*", "delay", delay_s=0.001,
+                                   times=1), seed=seed):
+        faults.fire("san.site")
+        faults.fire("san.other")
+
+    # -- tracing + telemetry (adopted singletons); spans only touch the
+    #    tracer registry lock while recording is enabled ---------------
+    tracing.enable()
+    try:
+        with tracing.span("san.hammer", seed=seed):
+            metrics.incr_counter("san_hammer_total")
+    finally:
+        tracing.disable()
+
+
+def run_hammer(seed: int):
+    from celestia_tpu.tools.sanitizer import Session, finalize
+
+    with tempfile.TemporaryDirectory() as td:
+        with Session() as sess:
+            _drive(seed, pathlib.Path(td))
+    return finalize(sess, ROOT, coverage=True)
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    failures: list[str] = []
+    _preimport()
+
+    # -- phase 1: hammer x2, determinism + clean -----------------------
+    reports = [run_hammer(SEED) for _ in range(2)]
+    report = reports[0]
+    for i, rep in enumerate(reports):
+        if rep.new_findings:
+            failures.append(
+                f"hammer run {i + 1}: {len(rep.new_findings)} new "
+                "T-finding(s):\n  " + "\n  ".join(
+                    f.render() for f in rep.new_findings))
+    if reports[0].fingerprints() != reports[1].fingerprints():
+        failures.append(
+            "determinism: the two same-seed runs disagree on findings: "
+            f"{reports[0].fingerprints() ^ reports[1].fingerprints()}")
+    toks = [set(r.tokens) for r in reports]
+    if toks[0] != toks[1]:
+        failures.append(
+            f"determinism: instrumented token sets differ: {toks[0] ^ toks[1]}")
+    print(f"san hammer: {len(report.tokens)} tokens, "
+          f"{len(report.edges)} edges, "
+          f"{len(report.all_findings)} raw finding(s), "
+          f"probes: {', '.join(report.probes_entered)}")
+    if report.uncovered_tokens:
+        print("  declared-but-never-instantiated (informational): "
+              + ", ".join(report.uncovered_tokens))
+
+    # -- phase 2: cross-validation -------------------------------------
+    from celestia_tpu.tools.sanitizer import cross_validate
+
+    xv = cross_validate(ROOT, san_report=report)
+    print(f"crossval: {xv.mapped} static site(s) mapped, "
+          f"{len(xv.static_only)} static-only by design")
+    if not xv.ok:
+        for e in xv.unmappable:
+            failures.append(f"crossval unmappable: {e}")
+        for e in xv.waived_but_fired:
+            failures.append(f"crossval waived-but-fired: {e}")
+
+    doc = {"schema": "celestia-san-smoke/1",
+           "report": report.to_dict(), "crossval": xv.to_dict()}
+    (ROOT / "san_report.json").write_text(
+        json.dumps(doc, indent=2, sort_keys=True) + "\n",
+        encoding="utf-8")
+
+    # -- phase 3: sanitized tier-1 subset ------------------------------
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", *SAN_TESTS, "--san", "-q",
+         "-p", "no:cacheprovider"],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=600)
+    tail = "\n".join(proc.stdout.strip().splitlines()[-4:])
+    print(f"sanitized subset ({' '.join(SAN_TESTS)}):\n{tail}")
+    if proc.returncode != 0:
+        failures.append(
+            f"sanitized pytest subset failed (rc={proc.returncode}):\n"
+            f"{proc.stdout[-4000:]}\n{proc.stderr[-2000:]}")
+
+    elapsed = time.monotonic() - t0
+    if elapsed >= BUDGET_S:
+        failures.append(
+            f"wall budget blown: {elapsed:.1f}s >= {BUDGET_S:.0f}s")
+
+    if failures:
+        print(f"\ncelestia-san: FAIL ({elapsed:.1f}s)", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print(f"celestia-san: clean ({len(report.tokens)} tokens, "
+          f"{len(report.edges)} edges, crossval {xv.mapped} mapped, "
+          f"{elapsed:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
