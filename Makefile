@@ -6,10 +6,10 @@ PY ?= python
 .PHONY: test test-all test-slow chaos bench bench-transfers dryrun native \
 	trace-smoke bench-gate obs-smoke sdc-smoke storm-smoke storm-bench \
 	ragged-smoke \
-	store-smoke gateway-bench \
+	store-smoke gateway-bench fleet-smoke \
 	scenario-smoke scenario-pfb-storm scenario-rolling-outage \
 	scenario-sdc-under-storm scenario-rejoin-under-load \
-	scenario-gateway-fleet scenarios \
+	scenario-gateway-fleet scenario-scale-out-under-load scenarios \
 	kernel-smoke bench-fused analyze san multichip-smoke multichip-bench
 
 # Static analysis gate (specs/analysis.md, ADR-020): AST-level
@@ -180,6 +180,22 @@ gateway-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py --gateway-fleet \
 		--seconds 3 --threads 16 --k 8 --fleet 3 \
 		--require-scaling 0.7 --ledger storm_ledger.json
+	JAX_PLATFORMS=cpu $(PY) bench.py --gateway-fleet --processes 3 \
+		--seconds 6 --threads 16 --k 8 --heights 2 \
+		--require-scaling 0.4 --ledger storm_ledger.json
+
+# Process-fleet smoke gate (ADR-023): two real supervised backend
+# subprocesses behind the gateway, SIGKILL one mid-storm — the
+# supervisor must reap/backoff/respawn/warm/re-attach it while the
+# gateway keeps serving NMT-verified samples (no client ever sees a
+# 500), with ONE merged Chrome trace spanning the gateway plus both
+# backend PIDs; then a 1000-height chain is compacted to a byte budget
+# through the `store compact` CLI with every retained DAH
+# byte-identical. Runs under celestia-san: any new runtime finding
+# fails the gate. CPU-only, crypto-free, <120 s.
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/fleet_smoke.py --san \
+		--trace-out /tmp/fleet_smoke.json
 
 # Fused-kernel smoke gate (ADR-019): fused extend+hash DAH byte-parity
 # vs the host oracle at k ∈ {32, 64} (production dispatch + the
@@ -237,10 +253,14 @@ scenario-gateway-fleet:
 	JAX_PLATFORMS=cpu $(PY) -m celestia_tpu.scenarios gateway-fleet \
 		--ledger scenario_ledger.json
 
-# All five suites back to back.
+scenario-scale-out-under-load:
+	JAX_PLATFORMS=cpu $(PY) -m celestia_tpu.scenarios \
+		scale-out-under-load --ledger scenario_ledger.json
+
+# All six suites back to back.
 scenarios: scenario-pfb-storm scenario-rolling-outage \
 	scenario-sdc-under-storm scenario-rejoin-under-load \
-	scenario-gateway-fleet
+	scenario-gateway-fleet scenario-scale-out-under-load
 
 # Multi-chip block-pipeline smoke gate (specs/parallel.md §Block
 # pipeline): stream blocks through the 3-deep H2D/compute/D2H pipeline

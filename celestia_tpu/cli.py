@@ -503,12 +503,16 @@ def cmd_ops(args):
 
 
 def cmd_store(args):
-    """`celestia-tpu store stat|verify`: inspect or deep-verify the
-    CRC32C-guarded on-disk block store under --home (specs/store.md,
-    ADR-021). `stat` re-indexes shallowly (header + size checks) and
-    prints the index summary; `verify` additionally checks EVERY page
-    record's CRC and exits 1 when any file was quarantined — the
-    offline bit-rot audit for a node's persisted chain."""
+    """`celestia-tpu store stat|verify|compact`: inspect, deep-verify
+    or garbage-collect the CRC32C-guarded on-disk block store under
+    --home (specs/store.md, ADR-021/ADR-023). `stat` re-indexes
+    shallowly (header + size checks) and prints the index summary;
+    `verify` additionally checks EVERY page record's CRC and exits 1
+    when any file was quarantined — the offline bit-rot audit for a
+    node's persisted chain. `compact --byte-budget N [--keep-recent R]`
+    evicts whole cold heights (lowest first, newest R protected) until
+    the store fits N bytes; retained files are untouched, so surviving
+    DAH bytes are identical before and after."""
     from celestia_tpu.store import BlockStore
 
     home = _home(args)
@@ -522,8 +526,18 @@ def cmd_store(args):
     doc = dict(store.stats())
     doc["cmd"] = args.store_cmd
     doc["skipped_files"] = report["skipped"]
+    if args.store_cmd == "compact":
+        if args.byte_budget is None:
+            print(json.dumps({"error": "compact requires --byte-budget"}),
+                  file=sys.stderr)
+            sys.exit(2)
+        doc["compaction"] = store.compact(args.byte_budget,
+                                          keep_recent=args.keep_recent)
+        doc.update(store.stats())
     print(json.dumps(doc, indent=2))
     if args.store_cmd == "verify" and report["skipped"]:
+        sys.exit(1)
+    if args.store_cmd == "compact" and doc["compaction"]["over_budget"]:
         sys.exit(1)
 
 
@@ -701,10 +715,17 @@ def main(argv=None):
                            help="blocks to retain below the snapshot height")
 
     p_store = sub.add_parser(
-        "store", help="inspect (stat) or CRC-audit (verify) the on-disk "
-        "block store under --home; verify exits 1 on any quarantined "
-        "file")
-    p_store.add_argument("store_cmd", choices=["stat", "verify"])
+        "store", help="inspect (stat), CRC-audit (verify) or GC "
+        "(compact) the on-disk block store under --home; verify exits "
+        "1 on any quarantined file, compact evicts cold heights to a "
+        "byte budget (ADR-023)")
+    p_store.add_argument("store_cmd", choices=["stat", "verify",
+                                               "compact"])
+    p_store.add_argument("--byte-budget", type=int, default=None,
+                         help="compact: target on-disk byte budget "
+                         "(required)")
+    p_store.add_argument("--keep-recent", type=int, default=16,
+                         help="compact: newest heights never evicted")
 
     p_light = sub.add_parser(
         "light", help="fraud-aware light client: follow headers from a "

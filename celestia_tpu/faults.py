@@ -25,6 +25,8 @@ touching production code paths:
     gateway.route          gateway ring routing decision  (node/gateway.py)
     gateway.hedge          gateway hedged retry hop       (node/gateway.py)
     pipeline.block         block-pipeline admission       (node/pipeline.py)
+    fleet.spawn            fleet supervisor process launch (node/fleet.py)
+    fleet.health           fleet supervisor readyz probe   (node/fleet.py)
 
 The dispatch trio drives overload drills deterministically: a ``delay``
 rule at ``dispatch.run`` stalls the single dispatcher thread, which
@@ -40,7 +42,12 @@ caught by the page CRC before any reader sees the bytes. The
 rot-on-disk the read path must refuse — while ``store.read`` faults
 the page fetch itself. The ``gateway.*`` pair drills fleet routing:
 ``gateway.route`` fires at the ring-ownership decision, and
-``gateway.hedge`` on every retry hop to the next ring position.
+``gateway.hedge`` on every retry hop to the next ring position. The
+``fleet.*`` pair drills supervision itself: an ``error`` rule at
+``fleet.spawn`` models a fork/exec that never produces a process (the
+supervisor's backoff path), and one at ``fleet.health`` a health
+checker that itself fails — the probe counts as failed, but only
+process EXIT triggers a restart.
 
 Fault kinds:
 

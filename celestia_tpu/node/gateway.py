@@ -17,18 +17,28 @@ adds is placement and failover:
     the request to the NEXT distinct ring position (`gateway.hedge`
     fault site + `gateway_hedge_total`); non-503 HTTP statuses (404,
     400) are backend answers and pass through untouched;
+  * shed cooldown — a backend that sheds is DEMOTED to the back of
+    the candidate order for its `Retry-After` window (default
+    `cooldown_s`, capped), so the very next request does not re-hedge
+    straight into the replica that just said "not now"
+    (`gateway_backend_cooldown_total` counts demotion windows opened);
   * ring rebalance — `add_backend`/`remove_backend` re-point only the
     vnode arcs that move (consistent hashing), so a join/leave does
     not reshuffle the whole keyspace;
   * `/status` aggregation — one document with every backend's own
-    `/status` plus the ring view; `/readyz` is ready iff ≥1 backend
-    is ready.
+    `/status` plus the ring view; a member that cannot answer within
+    the short per-backend `status_timeout_s` is reported as
+    `{"state": "down"}` instead of stalling the aggregation for the
+    full routing timeout; `/readyz` is ready iff ≥1 backend is ready.
 
 Locking: `HashRing._ring_lock` guards the vnode table and backend
-set; it is the FIRST lock in the specs/serving.md declared order and
-is NEVER held across a backend fetch (`urlopen` is a blocking call —
-celestia-lint C002): routing snapshots the candidate list under the
-lock, then fetches unlocked.
+set; it is in the FIRST rank of the specs/serving.md declared order
+(after the fleet supervisor's `fleet._lock`) and is NEVER held across
+a backend fetch (`urlopen` is a blocking call — celestia-lint C002):
+routing snapshots the candidate list under the lock, then fetches
+unlocked. `gateway._cooldown_lock` is its rank peer guarding only the
+cooldown table — dict ops only, never nested with the ring lock and
+never held across a fetch.
 
 Fault sites (specs/faults.md): `gateway.route` fires once per routing
 decision (delay/error rules model a slow or failing router);
@@ -43,6 +53,7 @@ import collections
 import http.server
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 from hashlib import sha256
@@ -143,9 +154,24 @@ class Gateway:
 
     def __init__(self, backends=(), host: str = "127.0.0.1",
                  port: int = 0, *, vnodes: int = DEFAULT_VNODES,
-                 timeout_s: float = 10.0):
+                 timeout_s: float = 10.0, cooldown_s: float = 1.0,
+                 cooldown_max_s: float = 5.0,
+                 status_timeout_s: float = 2.0):
         self.ring = HashRing(backends, vnodes=vnodes)
         self.timeout_s = float(timeout_s)
+        # aggregation endpoints probe every backend serially; a dead
+        # member must cost at most this short connect timeout, not the
+        # full routing timeout
+        self.status_timeout_s = min(float(status_timeout_s),
+                                    float(timeout_s))
+        # shed cooldown table: backend url -> monotonic deadline until
+        # which the backend is demoted in the hedge candidate order.
+        # `_cooldown_lock` is a rank peer of the ring lock (specs/
+        # serving.md lock ordering): dict ops only, never nested.
+        self.cooldown_s = float(cooldown_s)
+        self.cooldown_max_s = float(cooldown_max_s)
+        self._cooldown: dict[str, float] = {}
+        self._cooldown_lock = threading.Lock()
         # read-through LRU for /dah/<h> bodies: a committed height's
         # DAH is immutable, so entries are NEVER invalidated — only
         # LRU-evicted. `_dah_lock` is a leaf lock (specs/serving.md
@@ -312,7 +338,7 @@ class Gateway:
                 return 200, body, "cache"
             metrics.incr_counter("gateway_dah_cache_miss_total")
         key = self._route_key(path)
-        candidates = self.ring.owners(key)
+        candidates = self._demote_cooling(self.ring.owners(key))
         with tracing.span("gateway.route", key=key,
                           candidates=len(candidates)) as sp:
             if isinstance(sp, tracing.Span) and ctx is not None:
@@ -331,6 +357,45 @@ class Gateway:
                     while len(self._dah_cache) > self.DAH_CACHE_CAP:
                         self._dah_cache.popitem(last=False)
             return status, body, backend
+
+    def _demote_cooling(self, candidates: list[str]) -> list[str]:
+        """Stable-partition the hedge candidates: backends inside a
+        shed-cooldown window go to the BACK of the order (still
+        reachable as a last resort — a fleet that is all-cooling must
+        still answer), everyone else keeps ring order."""
+        now = time.monotonic()
+        with self._cooldown_lock:
+            if not self._cooldown:
+                return candidates
+            for b in [b for b, t in self._cooldown.items() if t <= now]:
+                del self._cooldown[b]
+            cooling = {b for b in candidates
+                       if self._cooldown.get(b, 0.0) > now}
+        if not cooling:
+            return candidates
+        return ([b for b in candidates if b not in cooling]
+                + [b for b in candidates if b in cooling])
+
+    def _note_cooldown(self, backend: str, retry_after) -> None:
+        """Open (or extend) a backend's demotion window from its 503
+        `Retry-After` answer; absent/garbled headers get the default
+        `cooldown_s`, and every window is capped at `cooldown_max_s`."""
+        try:
+            window = float(retry_after)
+        except (TypeError, ValueError):
+            window = self.cooldown_s
+        window = max(0.0, min(window, self.cooldown_max_s))
+        if window <= 0.0:
+            return
+        until = time.monotonic() + window
+        opened = False
+        with self._cooldown_lock:
+            if self._cooldown.get(backend, 0.0) < until:
+                opened = backend not in self._cooldown or \
+                    self._cooldown[backend] <= time.monotonic()
+                self._cooldown[backend] = until
+        if opened:
+            metrics.incr_counter("gateway_backend_cooldown_total")
 
     def fetch_hedged(self, path: str, candidates: list[str],
                      deadline_ms: str | None = None, ctx=None):
@@ -375,10 +440,16 @@ class Gateway:
                     body = e.read()
                     if e.code == 503:
                         # a shed is load placement gone wrong — exactly
-                        # what the hedge exists for
+                        # what the hedge exists for. Honor the shed's
+                        # Retry-After: demote this backend in the
+                        # candidate order until the window passes.
                         metrics.incr_counter(
                             "gateway_backend_error_total",
                             backend=backend)
+                        self._note_cooldown(
+                            backend,
+                            e.headers.get("Retry-After")
+                            if e.headers else None)
                         hsp.set(outcome="shed", status=e.code)
                         last_shed = (e.code, body, backend)
                         continue
@@ -398,10 +469,16 @@ class Gateway:
 
     # -- aggregation ---------------------------------------------------- #
 
-    def _backend_doc(self, backend: str, path: str):
+    def _backend_doc(self, backend: str, path: str,
+                     timeout: float | None = None):
+        """One backend's own document. Aggregation callers pass the
+        short `status_timeout_s` so one dead process costs a quick
+        connect failure, not the full routing timeout per member."""
         try:
-            with urllib.request.urlopen(backend + path,
-                                        timeout=self.timeout_s) as resp:
+            with urllib.request.urlopen(
+                    backend + path,
+                    timeout=self.timeout_s if timeout is None
+                    else timeout) as resp:
                 return resp.status, json.loads(resp.read() or b"{}")
         except urllib.error.HTTPError as e:
             try:
@@ -415,10 +492,17 @@ class Gateway:
         backends = self.ring.backends()
         per = {}
         for backend in backends:
-            _status, doc = self._backend_doc(backend, "/status")
-            per[backend] = doc
+            status, doc = self._backend_doc(
+                backend, "/status", timeout=self.status_timeout_s)
+            if status is None:
+                # unreachable member: report it, don't stall on it
+                per[backend] = {"state": "down",
+                                "error": doc.get("error")}
+            else:
+                per[backend] = doc
         heights = [d.get("height") for d in per.values()
                    if isinstance(d.get("height"), int)]
+        down = [b for b, d in per.items() if d.get("state") == "down"]
         return json.dumps({
             # the MIN backend height: the head every ring member can
             # serve — what a prober/light client should sample so a
@@ -428,6 +512,7 @@ class Gateway:
                 "url": self.url,
                 "backends": backends,
                 "ring_backends": len(self.ring),
+                "down_backends": down,
             },
             "backends": per,
         }).encode()
@@ -441,7 +526,8 @@ class Gateway:
         but not shipped."""
         per_source: dict[str, list[dict]] = {"gateway": tracing.flight()}
         for backend in self.ring.backends():
-            _status, doc = self._backend_doc(backend, "/debug/flight")
+            _status, doc = self._backend_doc(
+                backend, "/debug/flight", timeout=self.status_timeout_s)
             spans = doc.get("spans") if isinstance(doc, dict) else None
             per_source[backend] = spans if isinstance(spans, list) else []
         by_trace: dict[str, list[dict]] = {}
@@ -468,7 +554,8 @@ class Gateway:
         backends = self.ring.backends()
         ready = []
         for backend in backends:
-            status, _doc = self._backend_doc(backend, "/readyz")
+            status, _doc = self._backend_doc(
+                backend, "/readyz", timeout=self.status_timeout_s)
             if status == 200:
                 ready.append(backend)
         doc = json.dumps({

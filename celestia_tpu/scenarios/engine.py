@@ -59,7 +59,11 @@ def run_scenario(scenario: Scenario, *, seed: int = 1337,
     """Execute one scenario end to end; returns the scenario report."""
     if registry is None:
         from celestia_tpu.telemetry import metrics as registry
-    if getattr(scenario, "fleet", 0):
+    if getattr(scenario, "fleet_processes", 0):
+        from .fleet import FleetProcessWorld
+
+        world = FleetProcessWorld(scenario, seed, registry=registry)
+    elif getattr(scenario, "fleet", 0):
         from .fleet import FleetWorld
 
         world = FleetWorld(scenario, seed, registry=registry)

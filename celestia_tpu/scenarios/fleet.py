@@ -194,3 +194,128 @@ class FleetWorld(ScenarioWorld):
             "stores": [b["node"].store.stats() for b in self.backends
                        if b["node"].store is not None],
         }
+
+
+class FleetProcessWorld(FleetWorld):
+    """OS-process fleet world (ADR-023): supervised backend
+    SUBPROCESSES behind the gateway instead of in-process servers.
+
+    ``Scenario.fleet_processes = N`` selects this world. It boots with
+    ONE supervised backend process on the ring; the in-process primary
+    node still anchors the deterministic chain but is deliberately OFF
+    the ring — it is the verification oracle every das client and
+    invariant probe recomputes against, never a serving path. Block
+    production is lockstep through ``FleetSupervisor.advance``: the
+    primary grows, then every ready process proves the same extension
+    in its own address space (shared (k, seed, chain_id) keeps the
+    replica DAHs byte-identical by construction).
+
+    The ``fleet_scale_out`` action grows the fleet to the target size
+    ASYNCHRONOUSLY — the phase's flash crowd storms the gateway while
+    each joiner spawns, re-indexes its store, backfills to the fleet
+    head, and only then takes ring traffic (the warming contract,
+    specs/serving.md). The ``fleet_scaled_out`` invariant audits the
+    join events at teardown: every member reached ready, every join
+    backfilled to at least the head it observed, and a pre-join height
+    still NMT-verifies through the gateway after the ring grew."""
+
+    def __init__(self, scenario: Scenario, seed: int, registry=None):
+        super().__init__(scenario, seed, registry=registry)
+        self.supervisor = None  # built on start
+        self._scale_thread: threading.Thread | None = None
+
+    # -- lifecycle ----------------------------------------------------- #
+
+    def start(self) -> None:
+        from celestia_tpu.node.fleet import FleetSupervisor
+        from celestia_tpu.node.gateway import Gateway
+
+        self.server.start()  # the oracle: never added to the ring
+        self.primary_url = f"http://127.0.0.1:{self.server.port}"
+        self.gateway = Gateway([])
+        self.gateway.start()
+        self.url = self.gateway.url
+        self.supervisor = FleetSupervisor(
+            1, os.path.join(self._store_root, "procs"),
+            gateway=self.gateway, k=self.scenario.k,
+            heights=self.scenario.initial_heights, seed=self.seed,
+            chain_id=self.node.chain_id,
+        )
+        self.supervisor.start()
+        self.prober = self._prober_cls(
+            self.url, samples_per_cycle=4, timeout=5.0,
+            share_proofs=False, rng=self._prober_rng,
+            registry=self.registry,
+        )
+        self._watch_thread = threading.Thread(target=self._watch_readyz,
+                                              daemon=True)
+        self._watch_thread.start()
+        self._producer_thread = threading.Thread(target=self._produce_loop,
+                                                 daemon=True)
+        self._producer_thread.start()
+
+    def stop(self) -> None:
+        self._producer_stop.set()
+        if self._producer_thread is not None:
+            self._producer_thread.join(timeout=10)
+        self._watch_stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=5)
+        if self._scale_thread is not None:
+            self._scale_thread.join(timeout=60)
+        # supervisor first: it detaches members from the ring before
+        # stopping them, so nothing routes into a dying process
+        if self.supervisor is not None:
+            self.supervisor.stop()
+        if self.gateway is not None:
+            self.gateway.stop()
+        self.server.stop(drain_timeout=5.0)
+        if self.follower_server is not None:
+            self.follower_server.stop(drain_timeout=2.0)
+        shutil.rmtree(self._store_root, ignore_errors=True)
+
+    def freeze(self) -> None:
+        # let an in-flight scale-out land before heights are declared
+        # stable: joiners warm to the frozen head, then the probes run
+        super().freeze()
+        if self._scale_thread is not None:
+            self._scale_thread.join(timeout=60)
+
+    # -- block production ---------------------------------------------- #
+
+    def produce_block(self) -> int:
+        """Grow the oracle, then fan the new height out to every ready
+        process. No mempool drain: the subprocess replicas cannot see
+        the primary's mempool, and spec validation keeps pfb load off
+        process-fleet scenarios, so the chain stays seed-pure."""
+        with self._produce_lock:
+            self.node.grow()
+            h = self.node.latest_height()
+            self.produced["blocks"] += 1
+        self.supervisor.advance(h)
+        return h
+
+    # -- phase-boundary actions ---------------------------------------- #
+
+    def _action_fleet_scale_out(self) -> None:
+        """Grow the fleet to ``scenario.fleet_processes`` WITHOUT
+        blocking the phase: the storm must overlap the join window —
+        that is the scenario's whole point."""
+        target = self.scenario.fleet_processes
+
+        def scale() -> None:
+            try:
+                self.supervisor.scale_to(target)
+            except Exception:  # noqa: BLE001 — the invariant judges it
+                pass
+
+        self._scale_thread = threading.Thread(target=scale, daemon=True)
+        self._scale_thread.start()
+
+    # -- reporting ------------------------------------------------------ #
+
+    def fleet_report(self) -> dict:
+        doc = self.supervisor.report() if self.supervisor else {}
+        doc["gateway"] = self.url
+        doc["oracle"] = self.primary_url
+        return doc

@@ -25,6 +25,13 @@ Each is a production-emulation campaign judged by the SLO board:
                       restarts; each restarted backend must re-index
                       its on-disk block store and serve byte-identical
                       DAHs from disk (ADR-021).
+    scale-out-under-load
+                      a DAS flash crowd through the gateway while the
+                      supervised OS-process fleet grows 1 -> 4 real
+                      backend subprocesses mid-storm; every joiner
+                      must backfill to the fleet head before taking
+                      ring traffic and pre-join heights must still
+                      NMT-verify through the grown ring (ADR-023).
     smoke             the crypto-free CI gate: every engine mechanism
                       (profiles, phase-scoped campaigns, SDC drill,
                       strike/recover, windowed verdict) in a few
@@ -244,6 +251,42 @@ def _gateway_fleet() -> Scenario:
     )
 
 
+def _scale_out_under_load() -> Scenario:
+    return Scenario(
+        name="scale-out-under-load",
+        description=("DAS flash crowd through the gateway while the "
+                     "OS-process fleet grows 1 -> 4 supervised backend "
+                     "subprocesses mid-storm; every joiner must "
+                     "re-index its store and backfill to the fleet "
+                     "head before taking ring traffic, and a pre-join "
+                     "height must still NMT-verify through the grown "
+                     "ring (ADR-023)"),
+        k=4,
+        fleet_processes=4,
+        queue_capacity=64,
+        block_interval_s=0.25,
+        initial_heights=2,
+        phases=(
+            Phase(name="warmup", duration_s=2.0, loads=(
+                LoadSpec(kind="das", clients=3),
+            )),
+            # the scale-out is ASYNC: the flash crowd storms the
+            # 1-process ring while three joiners spawn, re-index, and
+            # backfill — the warming window is under full load
+            Phase(name="scale-out-storm", duration_s=5.0,
+                  enter_actions=("fleet_scale_out",),
+                  loads=(
+                      LoadSpec(kind="das", clients=8),
+                  )),
+            Phase(name="grown-steady", duration_s=2.0, loads=(
+                LoadSpec(kind="das", clients=5),
+            )),
+        ),
+        invariants=("prober_verified", "dah_byte_identical",
+                    "readyz_well_ordered", "fleet_scaled_out"),
+    )
+
+
 def _smoke() -> Scenario:
     return Scenario(
         name="smoke",
@@ -289,7 +332,8 @@ def _smoke() -> Scenario:
 SCENARIOS = {
     fn().name: fn
     for fn in (_pfb_storm, _rolling_outage, _sdc_under_storm,
-               _rejoin_under_load, _gateway_fleet, _smoke)
+               _rejoin_under_load, _gateway_fleet,
+               _scale_out_under_load, _smoke)
 }
 
 

@@ -33,12 +33,13 @@ LOAD_KINDS = ("das", "pfb", "follower_sync")
 
 #: phase-boundary world actions engine.py may apply
 ACTIONS = ("tpu_strike", "tpu_recover", "sdc_clear", "follower_boot",
-           "backend_restart")
+           "backend_restart", "fleet_scale_out")
 
 #: invariant probes verdict.py implements
 INVARIANTS = ("prober_verified", "dah_byte_identical",
               "readyz_well_ordered", "zero_undetected_sdc",
-              "follower_caught_up", "restarted_serves_from_store")
+              "follower_caught_up", "restarted_serves_from_store",
+              "fleet_scaled_out")
 
 #: fault sites whose bitflips are silent-data-corruption injections —
 #: the zero_undetected_sdc probe counts timeline entries at these
@@ -123,6 +124,13 @@ class Scenario:
     # nodes behind a consistent-hash gateway (scenarios/fleet.py) and
     # every load/probe hits the GATEWAY url; 0 = single-node world
     fleet: int = 0
+    # OS-process fleet mode (ADR-023): >0 boots ONE supervised backend
+    # subprocess behind the gateway (scenarios/fleet.FleetProcessWorld,
+    # node/fleet.FleetSupervisor) with the in-process primary kept OFF
+    # the ring as the verification oracle; the ``fleet_scale_out``
+    # action then grows the fleet to this target size under load, each
+    # joiner backfilling to the fleet head before taking traffic
+    fleet_processes: int = 0
     # verdict contract
     allowed_breaches: frozenset[str] = frozenset()
     required_breaches: frozenset[str] = frozenset()
@@ -159,3 +167,26 @@ class Scenario:
             raise ValueError("fleet mode produces through the plain "
                              "lockstep path; sdc_producer is "
                              "single-node only")
+        if self.fleet_processes:
+            if self.fleet:
+                raise ValueError("fleet (in-process) and fleet_processes "
+                                 "(OS-process) modes are mutually "
+                                 "exclusive")
+            if self.sdc_producer:
+                raise ValueError("process-fleet mode produces through "
+                                 "the plain lockstep path; sdc_producer "
+                                 "is single-node only")
+            if any(ls.kind == "pfb" for p in self.phases
+                   for ls in p.loads):
+                raise ValueError("process-fleet backends replicate the "
+                                 "deterministic chain and cannot see "
+                                 "the primary's mempool; pfb load is "
+                                 "not supported with fleet_processes")
+        uses_scale_out = any(
+            "fleet_scale_out" in p.enter_actions + p.exit_actions
+            for p in self.phases)
+        if (uses_scale_out or "fleet_scaled_out" in self.invariants) \
+                and self.fleet_processes < 2:
+            raise ValueError("fleet_scale_out / fleet_scaled_out require "
+                             "fleet_processes >= 2 (there must be a "
+                             "target size to grow to)")

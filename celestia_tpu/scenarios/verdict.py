@@ -55,6 +55,7 @@ def run_invariants(scenario: Scenario, world, injector, registry,
         "zero_undetected_sdc": _probe_zero_undetected_sdc,
         "follower_caught_up": _probe_follower_caught_up,
         "restarted_serves_from_store": _probe_restarted_serves_from_store,
+        "fleet_scaled_out": _probe_fleet_scaled_out,
     }
     out = []
     for name in scenario.invariants:
@@ -215,6 +216,58 @@ def _probe_restarted_serves_from_store(scenario, world, injector,
         checked += 1
     return True, (f"{checked} restarted backends served NMT-verified "
                   "samples from disk with byte-identical DAHs")
+
+
+def _probe_fleet_scaled_out(scenario, world, injector, registry,
+                            cap0, cap1):
+    """The mid-storm scale-out completed and honored the warming
+    contract (ADR-023): the supervisor reached the target size with
+    every member ready, every join event backfilled to at least the
+    fleet head it observed (no joiner took ring traffic cold), nothing
+    crash-looped, and a pre-scale-out height still serves an
+    NMT-verified sample THROUGH the grown ring, byte-identical to the
+    oracle's DAH."""
+    from .world import _fetch, _verify_sample
+
+    sup = getattr(world, "supervisor", None)
+    if sup is None:
+        return False, "world has no process-fleet supervisor"
+    report = sup.report()
+    target = scenario.fleet_processes
+    joins = [e for e in report["events"] if e.get("event") == "join"]
+    if len(joins) < target:
+        return False, (f"{len(joins)} join events < target fleet size "
+                       f"{target} (scale-out never completed)")
+    states = [m["state"] for m in report["members"]]
+    ready = sum(1 for s in states if s == "ready")
+    if ready < target:
+        return False, f"{ready}/{target} members ready at teardown: {states}"
+    cold = [j for j in joins
+            if j.get("warmed_to") is None or j["warmed_to"] < j["head"]]
+    if cold:
+        j = cold[0]
+        return False, (f"member {j['member']} joined at warmed_to="
+                       f"{j.get('warmed_to')} < head {j['head']} — the "
+                       "warming contract was violated")
+    if report["crashloops"]:
+        return False, f"{report['crashloops']} members crash-looped"
+    # a height that predates every join must still be servable through
+    # the grown ring, wherever the bigger ring now places it
+    h = 1
+    dah = world.node.block_dah(h)
+    w = 2 * scenario.k
+    for i, j in ((0, 0), (w // 2, w - 1)):  # an original + a parity cell
+        status, body = _fetch(world.url, f"/sample/{h}/{i}/{j}")
+        if status != 200:
+            return False, (f"pre-join height {h} cell ({i},{j}) -> "
+                           f"http {status} through the grown ring")
+        if not _verify_sample(dah, scenario.k, i, j, body):
+            return False, (f"pre-join height {h} cell ({i},{j}) failed "
+                           "NMT verification against the oracle DAH")
+    return True, (f"{len(joins)} joins to target {target}, all warmed to "
+                  f"their observed head; {report['restarts']} restarts, "
+                  f"0 crashloops; pre-join height {h} NMT-verified "
+                  "through the grown ring")
 
 
 def _probe_follower_caught_up(scenario, world, injector, registry,

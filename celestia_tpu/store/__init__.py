@@ -27,6 +27,15 @@ damaged files — truncated tail records, corrupt headers, CRC-mismatched
 pages, duplicate heights — are SKIPPED with a
 `store_reindex_skipped_total{reason=...}` bump, never a startup crash.
 
+Compaction (`compact()`, ADR-023) keeps a long-running backend bounded
+on disk: given a byte budget it evicts whole COLD heights — lowest
+first, never the newest `keep_recent` — by dropping the index entry
+first (under `_index_lock`) and unlinking the file after. Retained
+files are never rewritten, so surviving DAH bytes are identical before
+and after a compaction. A reader racing an eviction sees the ordinary
+"height not in store" KeyError (the read paths map a vanished file to
+the same miss), never a torn record.
+
 Layout (specs/store.md is the normative format doc):
 
     header (64 bytes, fixed):
@@ -54,6 +63,7 @@ touch share bytes, mirroring node/eds_cache.py.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
@@ -172,6 +182,8 @@ class BlockStore:
         self._page_reads = 0
         self._puts = 0
         self._write_errors = 0
+        self._compactions = 0
+        self._evicted = 0
 
     # -- write ---------------------------------------------------------- #
 
@@ -294,6 +306,66 @@ class BlockStore:
         log.info("store re-indexed", root=str(self.root), **report)
         return report
 
+    # -- compaction ----------------------------------------------------- #
+
+    def compact(self, byte_budget: int, *, keep_recent: int = 16) -> dict:
+        """Evict whole cold heights until the store fits `byte_budget`
+        (ADR-023's GC policy). Lowest heights go first — the DAS-cold
+        tail — and the newest `keep_recent` heights are NEVER evicted
+        even over budget, so the hot serving window survives a
+        too-small budget. Eviction order: drop the index entry under
+        `_index_lock`, then unlink the file unlocked — a racing reader
+        holding the stale entry maps the vanished file to the ordinary
+        KeyError miss. Retained files are untouched: their DAH and
+        page bytes are identical before and after."""
+        byte_budget = int(byte_budget)
+        with self._index_lock:
+            heights = sorted(self._index)
+            sizes = {h: self._index[h].page_offset(
+                self._index[h].page_count) for h in heights}
+        total = sum(sizes.values())
+        bytes_before = total
+        protected = set(heights[-keep_recent:]) if keep_recent > 0 \
+            else set()
+        victims: list[int] = []
+        for h in heights:
+            if total <= byte_budget:
+                break
+            if h in protected:
+                continue
+            victims.append(h)
+            total -= sizes[h]
+        evicted: list[int] = []
+        freed = 0
+        for h in victims:
+            with self._index_lock:
+                entry = self._index.pop(h, None)
+            if entry is None:
+                continue  # lost a race with a concurrent compaction
+            try:
+                entry.path.unlink(missing_ok=True)
+            except OSError:
+                pass  # the index drop already hid the height
+            evicted.append(h)
+            freed += sizes[h]
+            metrics.incr_counter("store_compact_evicted_total")
+        with self._index_lock:
+            self._compactions += 1
+            self._evicted += len(evicted)
+        metrics.incr_counter("store_compact_total")
+        self._publish()
+        report = {
+            "budget": byte_budget, "evicted": len(evicted),
+            "evicted_heights": evicted, "bytes_before": bytes_before,
+            "bytes_after": bytes_before - freed, "bytes_freed": freed,
+            "over_budget": bytes_before - freed > byte_budget,
+        }
+        if evicted:
+            log.info("store compacted", **{k: v for k, v in
+                                           report.items()
+                                           if k != "evicted_heights"})
+        return report
+
     def _read_header(self, path: pathlib.Path) -> StoreEntry | None:
         try:
             with open(path, "rb") as f:
@@ -358,6 +430,17 @@ class BlockStore:
             raise KeyError(f"height {height} not in store")
         return entry
 
+    @staticmethod
+    @contextlib.contextmanager
+    def _evictable(height: int):
+        """Map a file that vanished under a racing `compact()` to the
+        ordinary height-miss KeyError — never a FileNotFoundError leak."""
+        try:
+            yield
+        except FileNotFoundError:
+            raise KeyError(
+                f"height {height} not in store (evicted)") from None
+
     def read_page(self, height: int, index: int):
         """One page record -> (uint8 array (rows, 2k, share_size),
         payload CRC32C). ONE seek + one bounded read — never the
@@ -370,7 +453,7 @@ class BlockStore:
         if not (0 <= index < entry.page_count):
             raise IndexError(
                 f"page {index} out of range ({entry.page_count} pages)")
-        with open(entry.path, "rb") as f:
+        with self._evictable(height), open(entry.path, "rb") as f:
             f.seek(entry.page_offset(index))
             nbytes, crc, _r = _RECORD.unpack(f.read(RECORD_HEADER_SIZE))
             payload = f.read(nbytes)
@@ -400,7 +483,7 @@ class BlockStore:
         store-seeded cache page adopts before its first fault-in."""
         entry = self._require(height)
         crcs = []
-        with open(entry.path, "rb") as f:
+        with self._evictable(height), open(entry.path, "rb") as f:
             for i in range(entry.page_count):
                 f.seek(entry.page_offset(i))
                 _n, crc, _r = _RECORD.unpack(f.read(RECORD_HEADER_SIZE))
@@ -411,7 +494,7 @@ class BlockStore:
         """The stored DataAvailabilityHeader JSON doc — byte-identical
         to what the node served before restart."""
         entry = self._require(height)
-        with open(entry.path, "rb") as f:
+        with self._evictable(height), open(entry.path, "rb") as f:
             f.seek(HEADER_SIZE)
             raw = f.read(entry.dah_len)
         if len(raw) != entry.dah_len or crc32c(raw) != entry.dah_crc:
@@ -429,7 +512,7 @@ class BlockStore:
         entry = self._require(height)
         if entry.levels_len == 0:
             return None
-        with open(entry.path, "rb") as f:
+        with self._evictable(height), open(entry.path, "rb") as f:
             f.seek(HEADER_SIZE + entry.dah_len)
             raw = f.read(entry.levels_len)
         if len(raw) != entry.levels_len or crc32c(raw) != entry.levels_crc:
@@ -450,6 +533,8 @@ class BlockStore:
             page_reads = self._page_reads
             puts = self._puts
             write_errors = self._write_errors
+            compactions = self._compactions
+            evicted = self._evicted
             nbytes = sum(e.page_offset(e.page_count)
                          for e in self._index.values())
         return {
@@ -462,6 +547,8 @@ class BlockStore:
             "puts": puts,
             "page_reads": page_reads,
             "write_errors": write_errors,
+            "compactions": compactions,
+            "evicted": evicted,
             "reindex_skipped": skipped,
         }
 

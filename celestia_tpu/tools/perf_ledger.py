@@ -103,12 +103,22 @@ TRACKED: dict[str, list[tuple[str | None, str]]] = {
     # such series): a collapse here means sharding overhead ate the
     # scale-out win. Folded from storm_ledger.json runs.
     "multichip_blocks_per_sec": [],
+    # OS-process fleet (ADR-023): per-accepted-sample wall of the
+    # fleet-N phase of `bench.py --gateway-fleet --processes N` — N
+    # real supervised backend subprocesses behind the gateway with a
+    # live block stream. Folded from storm_ledger.json runs that carry
+    # the fleet series keys.
+    "fleet_ms_per_accepted_sample": [],
+    # OS-process fleet block stream: blocks/sec the supervisor pushed
+    # through every ready process during the same phase. HIGHER is
+    # better: a collapse means the fan-out grow path stopped scaling.
+    "fleet_blocks_per_sec": [],
 }
 
 # throughput series: the regression direction is inverted — the gate
 # trips when the newest point FALLS below the baseline beyond
 # threshold+band. Everything else in TRACKED is a wall (lower-better).
-HIGHER_IS_BETTER = {"multichip_blocks_per_sec"}
+HIGHER_IS_BETTER = {"multichip_blocks_per_sec", "fleet_blocks_per_sec"}
 
 DEFAULT_THRESHOLD = 1.5  # newest/baseline ratio that counts as regression
 DEFAULT_MIN_HISTORY = 3  # points before a metric gates
@@ -283,6 +293,16 @@ def load_ledger(root: str) -> dict[str, list[tuple[str, float]]]:
                 if isinstance(b, (int, float)):
                     ledger["multichip_blocks_per_sec"].append(
                         (f"storm_ledger.json#{idx}", float(b)))
+                fm = (run.get("fleet_ms_per_accepted_sample")
+                      if isinstance(run, dict) else None)
+                if isinstance(fm, (int, float)):
+                    ledger["fleet_ms_per_accepted_sample"].append(
+                        (f"storm_ledger.json#{idx}", float(fm)))
+                fb = (run.get("fleet_blocks_per_sec")
+                      if isinstance(run, dict) else None)
+                if isinstance(fb, (int, float)):
+                    ledger["fleet_blocks_per_sec"].append(
+                        (f"storm_ledger.json#{idx}", float(fb)))
     # scenario ledger (`python -m celestia_tpu.scenarios --ledger`):
     # each run's breach count is one point of the scenario_slo_pass
     # series — the healthy trajectory is all zeros, so any breaching

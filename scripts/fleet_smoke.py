@@ -1,0 +1,308 @@
+"""Fleet smoke gate (ADR-023): supervised OS-process backends under
+SIGKILL, and store compaction at chain scale. CPU-only, crypto-free,
+<120 s.
+
+Two drills, both gated:
+
+    supervisor   a FleetSupervisor launches TWO real backend
+                 subprocesses (own port + own store dir) behind the
+                 gateway; a client storm samples through the ring with
+                 every accepted share NMT-verified against an
+                 in-process oracle while a producer streams new
+                 blocks. Mid-storm one backend is SIGKILL'd: the
+                 supervisor must reap it, back off, respawn, re-index
+                 its store, warm it to the fleet head, and re-attach
+                 it — and the gateway must keep serving verified
+                 samples the whole time (hedging covers the dead
+                 window; no client ever sees a 500). The gateway's
+                 trace and every backend process's trace merge
+                 (tools/trace_merge) into ONE Chrome trace that must
+                 span the gateway plus both backend PIDs.
+
+    compaction   a 1000-height store-backed chain is compacted to a
+                 ~200-height byte budget through the `store compact`
+                 CLI: the store must land under budget, evict lowest
+                 heights first, keep every retained DAH byte-identical
+                 to its pre-compaction bytes, answer evicted reads
+                 with a clean miss, and re-index cleanly afterwards.
+
+`--san` wraps the whole run in a celestia-san Session and fails on any
+new runtime finding — the restart path crosses the fleet, gateway,
+store, and dispatch locks, exactly where an inversion would surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _get(url: str, timeout: float = 5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def run_supervisor_drill(trace_out: str) -> dict:
+    from celestia_tpu import tracing
+    from celestia_tpu.node.fleet import FleetSupervisor
+    from celestia_tpu.node.gateway import Gateway
+    from celestia_tpu.scenarios.world import _verify_sample
+    from celestia_tpu.testutil.chaosnet import RpcChaosNode
+    from celestia_tpu.tools import trace_merge
+
+    k, heights = 4, 2
+    root = tempfile.mkdtemp(prefix="fleet-smoke-")
+    trace_dir = pathlib.Path(root) / "traces"
+    oracle = RpcChaosNode(heights=heights, k=k, seed=7,
+                          chain_id="fleet-smoke")
+    gw = Gateway([])
+    gw.start()
+    sup = FleetSupervisor(2, pathlib.Path(root) / "fleet", gateway=gw,
+                          k=k, heights=heights, seed=7,
+                          chain_id="fleet-smoke", backoff_base_s=0.1,
+                          trace_dir=str(trace_dir))
+    rec = tracing.record().start()
+    sup.start()
+    w = 2 * k
+    dahs = {h: oracle.block_dah(h) for h in range(1, heights + 1)}
+    shared = {"head": heights}
+    counts = {"ok": 0, "shed": 0, "not_found": 0, "other": 0,
+              "error": 0, "http_500": 0}
+    verify_failures = 0
+    ok_after_kill = 0
+    killed_at = [None]
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def producer() -> None:
+        while not stop.is_set():
+            oracle.grow()
+            h = oracle.latest_height()
+            dah = oracle.block_dah(h)
+            sup.advance(h)
+            with lock:
+                dahs[h] = dah
+                shared["head"] = h
+            stop.wait(0.1)
+
+    def client(ci: int) -> None:
+        nonlocal verify_failures, ok_after_kill
+        n = ci
+        while not stop.is_set():
+            with lock:
+                head = shared["head"]
+            h = (n % head) + 1
+            i, j = n % w, (n * 3) % w
+            n += 7
+            status, body = _get(f"{gw.url}/sample/{h}/{i}/{j}")
+            key = {200: "ok", 503: "shed",
+                   404: "not_found"}.get(status, "other")
+            with lock:
+                if status == 500:
+                    counts["http_500"] += 1
+                if status == 200:
+                    if not _verify_sample(dahs[h], k, i, j,
+                                          json.loads(body)):
+                        verify_failures += 1
+                    elif killed_at[0] is not None:
+                        ok_after_kill += 1
+                counts[key] += 1
+
+    threads = [threading.Thread(target=producer, daemon=True)]
+    threads += [threading.Thread(target=client, args=(1000 + ci,),
+                                 daemon=True) for ci in range(6)]
+    for t in threads:
+        t.start()
+
+    time.sleep(1.5)  # storm against the healthy fleet first
+    victim = sup.members()[0]
+    gen0, pid0 = victim.generation, victim.pid()
+    victim.proc.kill()
+    killed_at[0] = time.monotonic()
+    restarted = sup.wait_ready(0, timeout=60.0, min_generation=gen0 + 1)
+    restart_s = time.monotonic() - killed_at[0]
+    time.sleep(1.5)  # storm against the healed fleet
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    report = sup.report()
+    sup.stop()
+    gw.stop()
+    rec.stop()
+    gateway_trace = str(trace_dir / "gateway.json")
+    rec.write(gateway_trace)
+    merged = trace_merge.merge_files(
+        trace_out, [gateway_trace, *sup.trace_files()])
+    pids = {ev.get("pid") for ev in merged.get("traceEvents", [])
+            if ev.get("ph") == "X" and isinstance(ev.get("pid"), int)}
+
+    failures = []
+    if not restarted:
+        failures.append("supervisor never restarted the SIGKILL'd member")
+    if report["restarts"] < 1:
+        failures.append(f"restarts={report['restarts']}, expected >= 1")
+    if verify_failures:
+        failures.append(f"{verify_failures} accepted samples failed "
+                        "NMT verification")
+    if counts["http_500"]:
+        failures.append(f"{counts['http_500']} HTTP 500s leaked "
+                        "through the gateway")
+    if counts["error"]:
+        failures.append(f"{counts['error']} transport-level errors")
+    if not counts["ok"]:
+        failures.append("storm never served a verified sample")
+    if not ok_after_kill:
+        failures.append("no verified samples served after the kill "
+                        "(the fleet never healed under load)")
+    if len(pids) < 3:
+        failures.append(f"merged trace spans {len(pids)} pids, "
+                        "expected >= 3 (gateway + 2 backends)")
+    doc = {
+        "drill": "supervisor",
+        "counts": counts,
+        "verify_failures": verify_failures,
+        "ok_after_kill": ok_after_kill,
+        "killed_pid": pid0,
+        "restart_s": round(restart_s, 2),
+        "restarts": report["restarts"],
+        "events": report["events"],
+        "merged_trace": trace_out,
+        "merged_pids": sorted(pids),
+        "failures": failures,
+    }
+    print(json.dumps(doc))
+    return doc
+
+
+def run_compaction_drill(heights: int = 1000, keep: int = 200) -> dict:
+    from celestia_tpu import cli
+    from celestia_tpu.store import BlockStore
+    from celestia_tpu.testutil.chaosnet import RpcChaosNode
+
+    home = tempfile.mkdtemp(prefix="fleet-smoke-store-")
+    t0 = time.perf_counter()
+    node = RpcChaosNode(heights=heights, k=4, seed=7,
+                        chain_id="compact-smoke",
+                        store_dir=os.path.join(home, "store"))
+    grow_s = time.perf_counter() - t0
+    store = node.store
+    all_heights = store.heights()
+    per = store.stats()["bytes"] // heights
+    budget = per * keep
+    # the oracle copy of every DAH that must survive, byte-exact
+    survivors = all_heights[-keep:]
+    pre_dahs = {h: store.read_dah(h) for h in survivors}
+
+    rc = 0
+    try:
+        cli.main(["--home", home, "store", "compact",
+                  "--byte-budget", str(budget), "--keep-recent", "16"])
+    except SystemExit as e:
+        rc = int(e.code or 0)
+
+    failures = []
+    fresh = BlockStore(os.path.join(home, "store"))
+    reindex = fresh.reindex()
+    stats = fresh.stats()
+    kept = fresh.heights()
+    if rc:
+        failures.append(f"store compact CLI exited {rc}")
+    if stats["bytes"] > budget:
+        failures.append(f"store holds {stats['bytes']} bytes over the "
+                        f"{budget} budget")
+    if kept != all_heights[-len(kept):]:
+        failures.append("eviction was not lowest-heights-first")
+    if reindex["skipped"]:
+        failures.append(f"{reindex['skipped']} files quarantined by the "
+                        "post-compaction re-index")
+    mismatched = [h for h in kept
+                  if h in pre_dahs and fresh.read_dah(h) != pre_dahs[h]]
+    if mismatched:
+        failures.append(f"{len(mismatched)} retained DAHs changed bytes "
+                        "across compaction")
+    try:
+        fresh.read_dah(all_heights[0])
+        failures.append("evicted height still answered a DAH read")
+    except KeyError:
+        pass
+    doc = {
+        "drill": "compaction",
+        "heights": heights,
+        "grow_s": round(grow_s, 1),
+        "budget": budget,
+        "kept": len(kept),
+        "bytes_after": stats["bytes"],
+        "failures": failures,
+    }
+    print(json.dumps(doc))
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace-out", default="/tmp/fleet_smoke.json",
+                    help="merged fleet trace path")
+    ap.add_argument("--heights", type=int, default=1000,
+                    help="compaction drill chain length")
+    ap.add_argument("--san", action="store_true",
+                    help="wrap the run in a celestia-san Session")
+    args = ap.parse_args(argv)
+
+    san = None
+    if args.san:
+        from celestia_tpu.tools import sanitizer
+
+        san = sanitizer.Session()
+        sanitizer.activate(san)
+
+    t0 = time.perf_counter()
+    sup_doc = run_supervisor_drill(args.trace_out)
+    comp_doc = run_compaction_drill(heights=args.heights)
+    failures = sup_doc["failures"] + comp_doc["failures"]
+
+    if san is not None:
+        from celestia_tpu.tools import sanitizer
+
+        srep = sanitizer.finalize(san, REPO, coverage=False)
+        if srep.new_findings:
+            for f in srep.new_findings:
+                print(f"  {f.render()}", file=sys.stderr)
+            failures.append(f"celestia-san: {len(srep.new_findings)} "
+                            "new runtime finding(s)")
+        else:
+            print(f"celestia-san: clean ({len(srep.tokens)} tokens, "
+                  f"{len(srep.edges)} edges observed)", file=sys.stderr)
+
+    wall = time.perf_counter() - t0
+    if failures:
+        print(f"fleet-smoke FAILED in {wall:.1f}s: "
+              + "; ".join(failures), file=sys.stderr)
+        return 1
+    print(f"fleet-smoke PASS in {wall:.1f}s: SIGKILL+restart in "
+          f"{sup_doc['restart_s']}s with {sup_doc['counts']['ok']} "
+          f"verified samples ({sup_doc['ok_after_kill']} post-kill), "
+          f"merged trace spans pids {sup_doc['merged_pids']}; "
+          f"{comp_doc['heights']}-height chain compacted to "
+          f"{comp_doc['kept']} heights under {comp_doc['budget']} bytes "
+          "with byte-identical DAHs", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,33 +1,34 @@
 #!/usr/bin/env bash
-# Localhost multi-validator devnet (the reference's scripts/single-node.sh
-# sibling, scaled out; see test/util/testnode/full_node.go:70 for the
-# capability this reproduces). Each validator is its own OS process with
-# its own RPC port; they exchange proposals, stake votes, commit
-# certificates, and gossiped txs over HTTP.
+# Localhost multi-process fleet devnet (ADR-023; the reference's
+# scripts/single-node.sh sibling, scaled out). One supervisor process
+# (node/fleet.FleetSupervisor) launches N backend OS processes — each
+# with its own RPC port and its own on-disk block store — fronts them
+# with the consistent-hash gateway, health-checks every member, and
+# restarts crashed ones with exponential backoff (SIGKILL a member to
+# watch it re-index its store, warm to the fleet head, and rejoin the
+# ring). Blocks stream to the whole fleet in lockstep once per
+# BLOCK_INTERVAL seconds.
 #
-#   scripts/multi-node.sh [N_VALIDATORS] [BASE_DIR]
+#   scripts/multi-node.sh [N_BACKENDS] [BASE_DIR]
 #
-# RPC endpoints come up on 127.0.0.1:26657..26657+N-1. Ctrl-C stops all.
+# The gateway URL and every member's pid + URL are printed at boot;
+# sample through the gateway (e.g. curl $GW/sample/1/0/0, /status,
+# /readyz). Ctrl-C stops the supervisor, which drains and stops every
+# backend. Env knobs: GATEWAY_PORT (default 26657), BLOCK_INTERVAL
+# seconds (default 1.0), STORE_BUDGET bytes (default 0 = no
+# compaction; >0 auto-compacts each backend's store after every grow,
+# keeping the newest KEEP_RECENT heights).
 set -euo pipefail
 N=${1:-3}
-BASE=${2:-"${TMPDIR:-/tmp}/celestia-devnet"}
-PORT0=${PORT0:-26657}
+BASE=${2:-"${TMPDIR:-/tmp}/celestia-fleet"}
+GATEWAY_PORT=${GATEWAY_PORT:-26657}
+BLOCK_INTERVAL=${BLOCK_INTERVAL:-1.0}
+STORE_BUDGET=${STORE_BUDGET:-0}
+KEEP_RECENT=${KEEP_RECENT:-16}
 cd "$(dirname "$0")/.."
 
 mkdir -p "$BASE"
-GENESIS="$BASE/genesis.json"
-python -c "from celestia_tpu.node.devnet import write_genesis; write_genesis('$GENESIS', $N)"
-
-PORTS=$(python -c "print(','.join(str($PORT0+i) for i in range($N)))")
-PIDS=()
-cleanup() { for p in "${PIDS[@]}"; do kill "$p" 2>/dev/null || true; done; }
-trap cleanup EXIT INT TERM
-
-for i in $(seq 0 $((N-1))); do
-  JAX_PLATFORMS=cpu python -m celestia_tpu.node.devnet \
-    --genesis "$GENESIS" --index "$i" --ports "$PORTS" \
-    --home "$BASE/v$i" &
-  PIDS+=($!)
-done
-echo "devnet up: $N validators, RPC on ports $PORTS (base dir $BASE)"
-wait
+exec env JAX_PLATFORMS=cpu python -m celestia_tpu.node.fleet \
+  --processes "$N" --store-root "$BASE" --port "$GATEWAY_PORT" \
+  --block-interval "$BLOCK_INTERVAL" \
+  --store-budget "$STORE_BUDGET" --keep-recent "$KEEP_RECENT"

@@ -1,0 +1,460 @@
+"""Process-fleet PR surface (ADR-023): the remove_backend-mid-hedge
+race, shed-cooldown demotion, /status "down" aggregation, the
+supervisor's member state machine, and store compaction.
+
+The hedge race is the satellite this file exists for: `fetch_hedged`
+works from a CANDIDATE SNAPSHOT taken before the ring lock was
+released, so a concurrent `remove_backend` (supervisor reaping a
+crashed member) can leave a dead URL in the order mid-flight. The
+contract is that the request hedges past it and serves from a
+survivor — the client must never see a 500.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from celestia_tpu.node.fleet import (
+    BACKOFF,
+    CRASHLOOP,
+    READY,
+    FleetSupervisor,
+)
+from celestia_tpu.node.gateway import Gateway
+from celestia_tpu.node.rpc import RpcServer
+from celestia_tpu.scenarios.world import _verify_sample
+from celestia_tpu.telemetry import metrics
+from celestia_tpu.testutil.chaosnet import RpcChaosNode
+
+
+def _backend(tmp_path=None, heights=2, k=4, name=None):
+    node = RpcChaosNode(heights=heights, k=k, seed=7, chain_id="fleet-t",
+                        store_dir=str(tmp_path / name) if name else None)
+    server = RpcServer(node, port=0)
+    server.start()
+    return node, server, f"http://127.0.0.1:{server.port}"
+
+
+def _get(url, timeout=5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class TestHedgeMembershipRace:
+    def test_remove_backend_mid_hedge_serves_from_survivor(self):
+        """A candidate snapshot holding a just-removed (and dead)
+        backend must hedge to the survivor and return its answer."""
+        node_a, server_a, url_a = _backend()
+        node_b, server_b, url_b = _backend()
+        gw = Gateway([url_a, url_b], timeout_s=2.0)
+        try:
+            # snapshot taken while A was still a member...
+            stale = [url_a, url_b]
+            # ...then the supervisor reaps A: off the ring, process gone
+            gw.remove_backend(url_a)
+            server_a.stop(drain_timeout=0.5)
+            status, body, backend = gw.fetch_hedged("/dah/1", stale)
+            assert status == 200
+            assert backend == url_b
+            from celestia_tpu import da
+
+            served = da.DataAvailabilityHeader.from_json(json.loads(body))
+            assert served.hash() == node_b.block_dah(1).hash()
+        finally:
+            server_b.stop(drain_timeout=0.5)
+
+    def test_every_candidate_dead_is_503_never_500(self):
+        """When the snapshot is ENTIRELY stale the gateway answers
+        unavailability (503), not a stack trace (500)."""
+        node, server, url = _backend()
+        gw = Gateway([url], timeout_s=1.0)
+        gw.start()
+        try:
+            server.stop(drain_timeout=0.5)  # the whole snapshot is dead
+            status, body = _get(gw.url + "/dah/1")
+            assert status == 503
+            doc = json.loads(body)
+            assert doc["error"] == "gateway_unavailable"
+        finally:
+            gw.stop()
+
+    def test_hedge_storm_during_membership_churn_never_500s(self):
+        """Clients storm through the gateway while one backend leaves
+        and rejoins the ring repeatedly: every answer is a real status
+        (200/404/503), never a 500, and every 200 NMT-verifies."""
+        node_a, server_a, url_a = _backend()
+        node_b, server_b, url_b = _backend()
+        gw = Gateway([url_a, url_b], timeout_s=2.0)
+        gw.start()
+        dah = node_a.block_dah(1)
+        statuses: list[int] = []
+        bad_bodies: list[bytes] = []
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def client(ci: int) -> None:
+            while not stop.is_set():
+                i, j = ci % 8, (ci * 3) % 8
+                status, body = _get(
+                    f"{gw.url}/sample/1/{i}/{j}", timeout=5.0)
+                ok = True
+                if status == 200:
+                    ok = _verify_sample(dah, 4, i, j, json.loads(body))
+                with lock:
+                    statuses.append(status)
+                    if not ok:
+                        bad_bodies.append(body)
+
+        def churn() -> None:
+            while not stop.is_set():
+                gw.remove_backend(url_b)
+                time.sleep(0.02)
+                gw.add_backend(url_b)
+                time.sleep(0.02)
+
+        threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+                   for ci in range(4)]
+        threads.append(threading.Thread(target=churn, daemon=True))
+        for t in threads:
+            t.start()
+        time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        gw.stop()
+        server_a.stop(drain_timeout=0.5)
+        server_b.stop(drain_timeout=0.5)
+        assert statuses, "storm produced no answers"
+        assert 500 not in statuses, "membership churn leaked a 500"
+        assert not bad_bodies, "an accepted sample failed verification"
+        assert statuses.count(200) > 0, "storm never served"
+
+
+class TestShedCooldown:
+    def test_note_cooldown_demotes_and_counts(self):
+        gw = Gateway(["http://a/", "http://b/", "http://c/"])
+        before = metrics.get_counter("gateway_backend_cooldown_total")
+        gw._note_cooldown("http://b/", "0.5")
+        assert metrics.get_counter(
+            "gateway_backend_cooldown_total") == before + 1
+        order = gw._demote_cooling(["http://a/", "http://b/", "http://c/"])
+        assert order == ["http://a/", "http://c/", "http://b/"]
+        # extending an OPEN window is not a new demotion event
+        gw._note_cooldown("http://b/", "0.6")
+        assert metrics.get_counter(
+            "gateway_backend_cooldown_total") == before + 1
+
+    def test_garbled_retry_after_uses_default_window(self):
+        gw = Gateway([], cooldown_s=0.4, cooldown_max_s=5.0)
+        t0 = time.monotonic()
+        gw._note_cooldown("http://x/", "not-a-number")
+        with gw._cooldown_lock:
+            until = gw._cooldown["http://x/"]
+        assert 0.2 <= until - t0 <= 0.5
+
+    def test_retry_after_is_capped(self):
+        gw = Gateway([], cooldown_max_s=2.0)
+        t0 = time.monotonic()
+        gw._note_cooldown("http://x/", "9999")
+        with gw._cooldown_lock:
+            until = gw._cooldown["http://x/"]
+        assert until - t0 <= 2.1
+
+    def test_cooldown_expires_and_is_pruned(self):
+        gw = Gateway([])
+        gw._note_cooldown("http://b/", "0.05")
+        time.sleep(0.1)
+        order = gw._demote_cooling(["http://a/", "http://b/"])
+        assert order == ["http://a/", "http://b/"]
+        with gw._cooldown_lock:
+            assert "http://b/" not in gw._cooldown
+
+    def test_shedding_backend_503_opens_cooldown_end_to_end(self):
+        """A real 503 + Retry-After from a candidate demotes it: the
+        hedge serves from the survivor AND the next routing order puts
+        the shedder last for the window."""
+        import http.server
+
+        class Shedder(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = b'{"error": "shed"}'
+                self.send_response(503)
+                self.send_header("Retry-After", "1.5")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        shed_srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                   Shedder)
+        shed_thread = threading.Thread(target=shed_srv.serve_forever,
+                                       daemon=True)
+        shed_thread.start()
+        shed_url = f"http://127.0.0.1:{shed_srv.server_address[1]}"
+        node, server, live_url = _backend()
+        gw = Gateway([shed_url, live_url], timeout_s=2.0)
+        before = metrics.get_counter("gateway_backend_cooldown_total")
+        try:
+            status, body, backend = gw.fetch_hedged(
+                "/dah/1", [shed_url, live_url])
+            assert status == 200 and backend == live_url
+            assert metrics.get_counter(
+                "gateway_backend_cooldown_total") == before + 1
+            order = gw._demote_cooling([shed_url, live_url])
+            assert order == [live_url, shed_url]
+        finally:
+            shed_srv.shutdown()
+            shed_srv.server_close()
+            server.stop(drain_timeout=0.5)
+
+
+class TestStatusDownAggregation:
+    def test_unreachable_backend_reported_down_and_fast(self):
+        node, server, live_url = _backend()
+        dead_url = "http://127.0.0.1:9"  # discard port: nothing listens
+        gw = Gateway([live_url, dead_url], timeout_s=5.0,
+                     status_timeout_s=0.5)
+        gw.start()
+        try:
+            t0 = time.monotonic()
+            status, body = _get(gw.url + "/status")
+            elapsed = time.monotonic() - t0
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["backends"][dead_url]["state"] == "down"
+            assert dead_url in doc["gateway"]["down_backends"]
+            # the live member still reports real node status
+            assert doc["backends"][live_url].get("state") != "down"
+            # per-backend connect timeout, not the 5 s fetch timeout
+            assert elapsed < 4.0
+        finally:
+            gw.stop()
+            server.stop(drain_timeout=0.5)
+
+
+class TestSupervisorStateMachine:
+    """The member lifecycle, unit-level: no real subprocesses."""
+
+    def _sup(self, tmp_path, **kw):
+        kw.setdefault("backoff_base_s", 0.05)
+        kw.setdefault("backoff_max_s", 0.4)
+        return FleetSupervisor(0, tmp_path / "fleet", **kw)
+
+    def _member(self, sup):
+        from celestia_tpu.node.fleet import FleetMember
+
+        m = FleetMember(0, sup.store_root / "m0")
+        with sup._lock:
+            sup._members.append(m)
+        return m
+
+    def test_backoff_doubles_then_caps(self, tmp_path):
+        sup = self._sup(tmp_path)
+        m = self._member(sup)
+        m.state = READY
+        seen = []
+        for _ in range(5):
+            m.state = READY
+            sup._on_crash(m, 1)
+            seen.append(m.backoff_s)
+            assert m.state == BACKOFF
+            m.crash_times.clear()  # isolate backoff from crash-loop
+        assert seen == [0.05, 0.1, 0.2, 0.4, 0.4]
+
+    def test_crash_loop_detection_gives_up(self, tmp_path):
+        sup = self._sup(tmp_path, crash_loop_limit=2,
+                        crash_loop_window_s=30.0)
+        m = self._member(sup)
+        for _ in range(2):
+            m.state = READY
+            sup._on_crash(m, -9)
+            assert m.state == BACKOFF
+        m.state = READY
+        sup._on_crash(m, -9)  # third strike within the window
+        assert m.state == CRASHLOOP
+        report = sup.report()
+        assert report["crashloops"] == 1
+        assert [e for e in report["events"]
+                if e["event"] == "crashloop"]
+        # the health loop must leave a crash-looped member alone
+        sup.health_check_once()
+        assert m.state == CRASHLOOP
+
+    def test_old_crashes_age_out_of_the_window(self, tmp_path):
+        sup = self._sup(tmp_path, crash_loop_limit=2,
+                        crash_loop_window_s=0.2)
+        m = self._member(sup)
+        for _ in range(2):
+            m.state = READY
+            sup._on_crash(m, 1)
+        time.sleep(0.25)  # both strikes age out
+        m.state = READY
+        sup._on_crash(m, 1)
+        assert m.state == BACKOFF, "aged-out crashes must not loop"
+
+    def test_stable_member_forgives_crash_history(self, tmp_path):
+        node, server, url = _backend()
+        sup = self._sup(tmp_path, crash_loop_window_s=0.1)
+        m = self._member(sup)
+        m.state = READY
+        m.url = url
+        m.backoff_s = 0.4
+        m.ready_since = time.monotonic() - 1.0  # stable > window
+        m.crash_times = [time.monotonic() - 5.0]
+        try:
+            sup._probe(m, time.monotonic())
+            assert m.healthy
+            assert m.backoff_s == 0.0
+            assert m.crash_times == []
+        finally:
+            server.stop(drain_timeout=0.5)
+
+    def test_failed_probe_counts_but_never_restarts(self, tmp_path):
+        sup = self._sup(tmp_path)
+        m = self._member(sup)
+        m.state = READY
+        m.url = "http://127.0.0.1:9"  # discard port
+        before = metrics.get_counter("fleet_health_fail_total")
+        sup._probe(m, time.monotonic())
+        assert not m.healthy
+        assert m.health_fails == 1
+        assert metrics.get_counter(
+            "fleet_health_fail_total") == before + 1
+        assert m.state == READY, ("only process EXIT restarts a member; "
+                                  "a failed probe just counts")
+
+
+class TestStoreCompaction:
+    def _grown_store(self, tmp_path, heights=30):
+        node = RpcChaosNode(heights=heights, k=4, seed=7,
+                            chain_id="compact-t",
+                            store_dir=str(tmp_path / "store"))
+        return node, node.store
+
+    def test_compaction_holds_budget_and_keeps_dahs_identical(
+            self, tmp_path):
+        node, store = self._grown_store(tmp_path)
+        all_heights = store.heights()
+        assert len(all_heights) == 30
+        per = store.stats()["bytes"] // 30
+        budget = per * 10
+        pre_dahs = {h: store.read_dah(h)
+                    for h in all_heights[-10:]}
+        report = store.compact(budget, keep_recent=4)
+        assert report["bytes_after"] <= budget
+        assert not report["over_budget"]
+        kept = store.heights()
+        # cold (lowest) heights went first; the newest stayed
+        assert kept == all_heights[-len(kept):]
+        assert set(all_heights[-4:]) <= set(kept)
+        for h in kept:
+            if h in pre_dahs:
+                assert store.read_dah(h) == pre_dahs[h]
+        stats = store.stats()
+        assert stats["compactions"] == 1
+        assert stats["evicted"] == report["evicted"]
+
+    def test_evicted_heights_read_as_missing_not_oserror(self, tmp_path):
+        node, store = self._grown_store(tmp_path, heights=8)
+        report = store.compact(0, keep_recent=2)
+        assert report["evicted"] == 6
+        with pytest.raises(KeyError):
+            store.read_dah(1)
+        with pytest.raises(KeyError):
+            store.read_page(1, 0)
+
+    def test_keep_recent_overrides_budget(self, tmp_path):
+        node, store = self._grown_store(tmp_path, heights=8)
+        report = store.compact(0, keep_recent=3)
+        assert store.heights() == [6, 7, 8]
+        assert report["over_budget"], \
+            "protected heights above a zero budget must be reported"
+
+    def test_cli_store_compact(self, tmp_path, capsys):
+        from celestia_tpu import cli
+
+        node, store = self._grown_store(tmp_path / "home" / "store",
+                                        heights=12)
+        # _grown_store nests its own "store" dir: point --home above it
+        home = str(tmp_path / "home" / "store")
+        per = store.stats()["bytes"] // 12
+        rc = cli.main(["--home", home, "store", "compact",
+                       "--byte-budget", str(per * 6),
+                       "--keep-recent", "2"])
+        assert not rc
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["compaction"]["evicted"] == 6
+        assert doc["compaction"]["bytes_after"] <= per * 6
+
+    def test_compaction_under_concurrent_reads(self, tmp_path):
+        """Readers racing an eviction see either the record or a clean
+        KeyError — never a torn read or an OS-level error."""
+        node, store = self._grown_store(tmp_path)
+        errors: list[Exception] = []
+        stop = threading.Event()
+
+        def reader() -> None:
+            h = 1
+            while not stop.is_set():
+                try:
+                    store.read_dah((h % 30) + 1)
+                except KeyError:
+                    pass
+                except Exception as e:  # noqa: BLE001 — the assertion
+                    errors.append(e)
+                h += 1
+
+        threads = [threading.Thread(target=reader, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        per = store.stats()["bytes"] // 30
+        for budget in (per * 20, per * 10, per * 5):
+            store.compact(budget, keep_recent=2)
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert not errors, f"racing reader saw {errors[0]!r}"
+
+
+@pytest.mark.slow
+class TestSupervisorEndToEnd:
+    def test_kill_restart_scale_with_real_processes(self, tmp_path):
+        gw = Gateway([])
+        gw.start()
+        sup = FleetSupervisor(2, tmp_path / "fleet", gateway=gw, k=4,
+                              heights=2, seed=7, chain_id="fleet-e2e",
+                              backoff_base_s=0.1)
+        try:
+            sup.start()
+            sup.advance(4)
+            status, body = _get(gw.url + "/dah/4")
+            assert status == 200
+            victim = sup.members()[0]
+            gen0 = victim.generation
+            victim.proc.kill()
+            assert sup.wait_ready(0, timeout=60.0,
+                                  min_generation=gen0 + 1)
+            assert sup.report()["restarts"] == 1
+            sup.scale_to(3)
+            joins = [e for e in sup.report()["events"]
+                     if e["event"] == "join"]
+            assert len(joins) == 3
+            assert joins[-1]["warmed_to"] == 4
+            status, body = _get(gw.url + "/dah/4")
+            assert status == 200
+        finally:
+            sup.stop()
+            gw.stop()

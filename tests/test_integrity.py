@@ -612,6 +612,43 @@ class TestFaultSiteCoverage:
                 # hops to the live backend, firing the hedge site
                 gw.fetch_hedged("/dah/1",
                                 ["http://127.0.0.1:1", server.url])
+        elif site in ("fleet.spawn", "fleet.health"):
+            import pathlib
+            import shutil
+            import sys
+            import tempfile
+
+            from celestia_tpu.node.fleet import (
+                FleetMember,
+                FleetSupervisor,
+            )
+
+            root = tempfile.mkdtemp(prefix="site-coverage-fleet-")
+            try:
+                if site == "fleet.spawn":
+                    # a stub child (prints PORT, waits for stop) keeps
+                    # the spawn path real without booting a backend
+                    inline = ("import sys\n"
+                              "print('PORT 1', flush=True)\n"
+                              "sys.stdin.readline()\n")
+                    sup = FleetSupervisor(
+                        0, root,
+                        command=lambda m: [sys.executable, "-c", inline])
+                    m = FleetMember(0, pathlib.Path(root) / "member0")
+                    sup._spawn(m)
+                    sup._stop_member(m)
+                else:
+                    # one fake ready member pointing at the live chaos
+                    # server: the health pass fires the probe site
+                    sup = FleetSupervisor(0, root)
+                    m = FleetMember(0, pathlib.Path(root) / "member0")
+                    m.url = server.url
+                    m.state = "ready"
+                    with sup._lock:
+                        sup._members.append(m)
+                    sup.health_check_once()
+            finally:
+                shutil.rmtree(root, ignore_errors=True)
         else:  # pragma: no cover — keep the list and the spec in sync
             pytest.fail(f"no driver for documented site {site!r}")
 
@@ -637,6 +674,8 @@ class TestFaultSiteCoverage:
         "gateway.route",
         "gateway.hedge",
         "pipeline.block",
+        "fleet.spawn",
+        "fleet.health",
     ])
     def test_site_fires(self, site, net):
         with faults.inject(

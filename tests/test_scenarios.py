@@ -370,7 +370,8 @@ class TestLibrary:
     def test_shipped_names(self):
         assert set(SCENARIOS) == {"pfb-storm", "rolling-outage",
                                   "sdc-under-storm", "rejoin-under-load",
-                                  "smoke", "gateway-fleet"}
+                                  "smoke", "gateway-fleet",
+                                  "scale-out-under-load"}
 
     @pytest.mark.parametrize("name", sorted(SCENARIOS))
     def test_constructs_and_name_matches(self, name):
