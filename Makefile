@@ -10,7 +10,8 @@ PY ?= python
 	scenario-smoke scenario-pfb-storm scenario-rolling-outage \
 	scenario-sdc-under-storm scenario-rejoin-under-load \
 	scenario-gateway-fleet scenario-scale-out-under-load scenarios \
-	kernel-smoke bench-fused analyze san multichip-smoke multichip-bench
+	kernel-smoke bench-fused analyze san multichip-smoke multichip-bench \
+	xor-smoke bench-xor
 
 # Static analysis gate (specs/analysis.md, ADR-020): AST-level
 # concurrency lint (lock ordering vs the specs/serving.md partial
@@ -207,11 +208,28 @@ fleet-smoke:
 kernel-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/kernel_smoke.py
 
+# XOR-schedule smoke gate (ADR-024): sparse-schedule vs dense GF(2)
+# bit-matmul byte-parity at k ∈ {4, 16, 32}, DAH parity through the
+# production roots path with the schedule forced on, one jit cache
+# entry per (k, spelling), and CELESTIA_XOR_SCHEDULE override
+# semantics (0 pins dense over any table, 1 forces xor, non-pow2 k
+# always refuses). CPU-only, crypto-free, <120 s (repeat runs much
+# faster via the persistent XLA compile cache).
+xor-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/xor_smoke.py
+
 # The ADR-019 step-change configs alone on the real chip: fused
 # roots-only vs the XLA roots path vs native at k ∈ {64, 32}; writes
 # the fused_ms_per_square_k64 series `make bench-gate` judges.
 bench-fused:
 	$(PY) bench.py --fused-kernels
+
+# The ADR-024 A/B alone: sparse XOR schedule vs the dense bit-matmul
+# inside the same fused hash pipeline at k ∈ {64, 32}; writes the
+# xor_schedule_ms_per_square_k64 series `make bench-gate` judges.
+# Add --write-table to refresh config/xor_schedule.json.
+bench-xor:
+	$(PY) bench.py --xor-schedule
 
 # Scenario-engine smoke gate (specs/scenarios.md, ADR-018): run the
 # condensed `smoke` scenario twice on one seed, pin an identical fault

@@ -13,7 +13,6 @@ import math
 
 from celestia_tpu import appconsts
 from celestia_tpu.appconsts import BOND_DENOM
-from celestia_tpu.crypto import verify_signature
 from celestia_tpu.shares.splitters import sparse_shares_needed
 from celestia_tpu.tx import Tx, sign_doc_bytes
 from celestia_tpu.x.bank import FEE_COLLECTOR
@@ -185,6 +184,10 @@ class AnteHandler:
             doc = sign_doc_bytes(
                 tx.body_bytes(), tx.auth_info_bytes(), ctx.chain_id, acc.account_number
             )
+            # lazy: signature checks need the cryptography wheel, but
+            # the App must import (DA-only proposal path) without it
+            from celestia_tpu.crypto import verify_signature
+
             if not verify_signature(si.public_key, doc, sig):
                 raise ValueError("signature verification failed")
 

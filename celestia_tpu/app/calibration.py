@@ -33,6 +33,8 @@ log = logger("calibration")
 
 DEFAULT_KS = (16, 32, 64, 128)
 FILENAME = "crossover.json"
+XOR_FILENAME = "xor_schedule.json"
+XOR_DEFAULT_KS = (32, 64)
 
 
 @dataclasses.dataclass
@@ -125,6 +127,65 @@ def load_default_table() -> "CrossoverTable | None":
         _default_table = CrossoverTable.load(repo_root / "config" / FILENAME)
         _default_loaded = True
     return _default_table
+
+
+_xor_table: "CrossoverTable | None" = None
+_xor_loaded = False
+
+
+def load_xor_table() -> "CrossoverTable | None":
+    """The repo-committed XOR-schedule A/B table
+    (`<repo>/config/xor_schedule.json`), same CrossoverTable format as
+    the backend table but with contraction-spelling keys
+    ("dense"/"xor") instead of backend names. Refreshed whenever
+    `bench.py --xor-schedule` lands a measured step-change (ADR-024).
+    Loaded once per process; None when absent or corrupt."""
+    global _xor_table, _xor_loaded
+    if not _xor_loaded:
+        repo_root = pathlib.Path(__file__).resolve().parents[2]
+        _xor_table = CrossoverTable.load(repo_root / "config" / XOR_FILENAME)
+        _xor_loaded = True
+    return _xor_table
+
+
+def xor_winner(k: int) -> str:
+    """Measured winner ("dense" or "xor") for the contraction spelling
+    at square size k. Dense when the table is absent or empty — the
+    dense bit-matmul is the always-correct default; the schedule only
+    routes on a measurement that says it is faster."""
+    table = load_xor_table()
+    if table is None:
+        return "dense"
+    return table.winner(k) or "dense"
+
+
+def measure_xor_crossover(
+    ks: tuple[int, ...] = XOR_DEFAULT_KS, repeats: int = 3
+) -> CrossoverTable:
+    """A/B the two contraction spellings through the SAME jitted
+    roots-only core the proposal path runs (`_jitted_roots_noeds` with
+    the spelling pinned), per k. Both spellings are plain XLA programs,
+    so this measures on any backend — the fused-kernel choice is
+    resolved independently and left at its default here."""
+    import jax
+
+    from celestia_tpu.ops import extend_tpu
+
+    entries: dict[int, dict[str, float]] = {}
+    for k in ks:
+        rng = np.random.default_rng(k)
+        arr = rng.integers(0, 256, size=(k, k, SHARE_SIZE), dtype=np.uint8)
+        dev = jax.device_put(arr)
+        timings: dict[str, float] = {}
+        for name, pin in (("dense", False), ("xor", True)):
+            fn = extend_tpu._jitted_roots_noeds(k, xor=pin)
+            timings[name] = _best_of(
+                lambda: jax.block_until_ready(fn(dev)), repeats
+            )
+        entries[k] = timings
+        log.info("xor crossover rung", k=k,
+                 **{s: round(ms, 3) for s, ms in timings.items()})
+    return CrossoverTable(entries, measured_at=time.time())
 
 
 def _best_of(fn, repeats: int) -> float:

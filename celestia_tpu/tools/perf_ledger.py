@@ -66,6 +66,14 @@ TRACKED: dict[str, list[tuple[str | None, str]]] = {
     # history.
     "fused_ms_per_square_k64": [("12_fused_kernels_k64",
                                  "fused_ms_per_square")],
+    # XOR-schedule contraction at the governance-default square
+    # (ADR-024, bench.py --xor-schedule): ms/square of the sparse
+    # CSE-shared schedule through the roots-only core. Rides the same
+    # lower-is-better double gate as the walls above once it has
+    # min_history points — a regression here means the schedule
+    # compiler (or its XLA lowering) lost the ground the A/B won.
+    "xor_schedule_ms_per_square_k64": [("13_xor_schedule_k64",
+                                        "xor_ms_per_square")],
     # the recalibrated crossover point: the TPU side of the k=64 rung.
     # History accrues from the measured fused config like the series
     # above, but the loader appends the COMMITTED table's rung

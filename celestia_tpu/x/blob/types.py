@@ -13,7 +13,7 @@ from celestia_tpu import blob as blob_pkg
 from celestia_tpu import inclusion
 from celestia_tpu import namespace as ns_pkg
 from celestia_tpu.blob import _field_bytes, _field_uint, _parse_fields, _require_wt
-from celestia_tpu.crypto import bech32_decode
+from celestia_tpu.bech32 import bech32_decode
 from celestia_tpu.shares.splitters import sparse_shares_needed
 from celestia_tpu.tx import register_msg
 

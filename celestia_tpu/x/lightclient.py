@@ -56,7 +56,6 @@ import dataclasses
 import json
 
 from celestia_tpu import smt as smt_mod
-from celestia_tpu.crypto import verify_signature
 
 CLIENT_STATE_PREFIX = b"ibc/client/state/"
 CONSENSUS_STATE_PREFIX = b"ibc/client/consensus/"
@@ -247,6 +246,10 @@ def verify_commit(
         raise ValueError("trusted validator set has no power")
     signed = 0
     seen: set[str] = set()
+    # lazy: header verification needs the cryptography wheel, but the
+    # module (and the App importing it) must load without it
+    from celestia_tpu.crypto import verify_signature
+
     for pubkey_hex, sig_hex in signatures:
         if pubkey_hex in seen or pubkey_hex not in power_of:
             continue

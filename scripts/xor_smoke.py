@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""XOR-schedule smoke gate (ADR-024, `make xor-smoke`).
+
+Crypto-free, <120 s, CPU-capable drill of the sparse XOR-schedule
+extend path and its routing. Fails (non-zero exit) unless:
+
+  1. the compiled schedule evaluates byte-identically to the dense
+     GF(2) bit-matmul on random planes at k ∈ {4, 16, 32} (pure-numpy
+     evaluator vs `encode_bit_matrix` — no jit, no device),
+  2. the PRODUCTION roots path with the schedule forced on
+     (`CELESTIA_XOR_SCHEDULE=1` semantics via the `xor=` pin) returns
+     byte-identical DAH axis roots vs the host oracle at k=16,
+  3. the jit cache holds exactly ONE entry per (k, spelling) — the
+     xor and dense programs are distinct cache rungs and a repeat
+     dispatch retraces neither,
+  4. the env override degrades to dense: `CELESTIA_XOR_SCHEDULE=0`
+     pins dense even when a table says xor, `=1` pins xor for any
+     supported k, and a non-power-of-two k refuses the schedule no
+     matter what the override says.
+
+Budget note: the k=16 xor roots program costs ~25 s of XLA:CPU
+compile cold; the persistent compile cache (repo-local `.jax_cache`)
+absorbs it on repeat runs, keeping this gate well inside 120 s in CI
+loops.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+T0 = time.time()
+
+
+def gate(ok: bool, what: str) -> None:
+    print(f"[{time.time() - T0:6.1f}s] " + ("PASS " if ok else "FAIL ") + what)
+    if not ok:
+        raise SystemExit(f"xor-smoke: {what}")
+
+
+def main() -> None:
+    import numpy as np
+
+    from celestia_tpu.ops import enable_compile_cache
+
+    enable_compile_cache()
+
+    import jax
+
+    from celestia_tpu import da
+    from celestia_tpu.ops import extend_tpu, rs_tpu, xor_schedule
+
+    rng = np.random.default_rng(0x40)
+
+    # 1. schedule vs dense bit-matmul, pure numpy (no jit in the loop)
+    for k in (4, 16, 32):
+        sched = xor_schedule.compile_schedule(k)
+        m2 = rs_tpu.encode_bit_matrix(k)
+        planes = rng.integers(0, 2, (8 * k, 64), dtype=np.int32)
+        dense = (m2.astype(np.int32) @ planes) & 1
+        ours = xor_schedule.apply_planes_np(planes, sched) & 1
+        gate(
+            np.array_equal(ours, dense),
+            f"schedule evaluation == dense GF(2) matmul at k={k} "
+            f"({sched.xor_ops} xor ops vs {sched.dense_ops} dense)",
+        )
+
+    # 2. DAH parity with the schedule forced on, through the real
+    # jitted production spelling (one size: the k=16 program is the
+    # same code path at every k and its compile dominates the budget;
+    # tier-1 + slow tests pin k∈{2..128})
+    from bench import build_square
+
+    k = 16
+    sq = build_square(k)
+    eds_ref = da.extend_shares(sq.reshape(k * k, 512))
+    dah = da.new_data_availability_header(eds_ref)
+    fx = extend_tpu._jitted_roots_noeds(k, xor=True)
+    rows_x, cols_x = (np.asarray(a) for a in fx(sq))
+    gate(
+        [bytes(r) for r in rows_x] == dah.row_roots
+        and [bytes(c) for c in cols_x] == dah.column_roots,
+        f"DAH parity with XOR schedule forced on at k={k}",
+    )
+
+    # 3. per-k jit cache discipline: xor and dense are distinct rungs,
+    # repeats retrace nothing. k=4 keeps both compiles cheap — the
+    # cache semantics are k-independent (same lru + jit machinery)
+    k4 = 4
+    sq4 = build_square(k4)
+    f4x = extend_tpu._jitted_roots_noeds(k4, xor=True)
+    f4d = extend_tpu._jitted_roots_noeds(k4, xor=False)
+    gate(f4x is not f4d, "xor and dense spellings are distinct jit rungs")
+    for _ in range(2):
+        jax.block_until_ready(f4x(sq4))
+        jax.block_until_ready(f4d(sq4))
+    gate(
+        f4x._cache_size() == 1 and f4d._cache_size() == 1,
+        f"one jit cache entry per (k, spelling) "
+        f"(xor={f4x._cache_size()}, dense={f4d._cache_size()})",
+    )
+    gate(
+        extend_tpu._jitted_roots_noeds(k4, xor=True) is f4x,
+        "lru returns the same compiled callable per (k, xor) key",
+    )
+
+    # 4. env-override routing: =0 beats any table, =1 forces on,
+    # non-pow2 never schedules
+    env = extend_tpu._XOR_ENV
+    old = os.environ.get(env)
+    try:
+        os.environ[env] = "0"
+        gate(not extend_tpu._xor_active(64),
+             f"{env}=0 pins dense regardless of table")
+        os.environ[env] = "1"
+        gate(extend_tpu._xor_active(64), f"{env}=1 pins xor for pow2 k")
+        gate(not extend_tpu._xor_active(48),
+             f"{env}=1 still refuses unsupported k=48")
+    finally:
+        if old is None:
+            os.environ.pop(env, None)
+        else:
+            os.environ[env] = old
+
+    print(f"xor-smoke: all gates green in {time.time() - T0:.1f}s")
+
+
+if __name__ == "__main__":
+    main()

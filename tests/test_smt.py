@@ -2,6 +2,7 @@
 commits + store proofs, app/app.go:263-279)."""
 
 import numpy as np
+import pytest
 
 from celestia_tpu import smt
 from celestia_tpu.state import StateStore
@@ -104,6 +105,9 @@ class TestStateProofRPC:
     def test_proof_route(self):
         import json
         import urllib.request
+
+        # signs real txs with a secp256k1 key — needs the wheel
+        pytest.importorskip("cryptography")
 
         from celestia_tpu.app import App
         from celestia_tpu.node.node import Node

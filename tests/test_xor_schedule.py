@@ -1,0 +1,347 @@
+"""XOR-schedule-compiled extend (ADR-024): compiler correctness,
+byte-exactness against the dense GF(2) bit-matmul, and routing.
+
+The schedule is a perf spelling of the SAME code the dense path
+computes, so everything here is a byte-parity pin:
+
+  * the GF(2)-expanded encode matrix agrees with the Leopard matrix
+    spelling for every committed power-of-two k (2..128) — the property
+    both contraction spellings stand on;
+  * schedule evaluation (numpy, jnp, interpret-mode Pallas kernel,
+    fused-hash reference) is byte-identical to the dense matmul over
+    random squares;
+  * DAH parity through the production roots core with the schedule
+    forced on, and through the row-sharded spelling on the virtual
+    8-device mesh;
+  * routing: env pin beats the table, dense is the fallback when the
+    schedule is off or unsupported, and the jit caches key the choice.
+
+Small k run tier-1; k >= 32 rides the slow tier (compile-bound on one
+CPU core), mirroring tests/test_fused_roots.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import celestia_tpu.namespace as ns
+from celestia_tpu import da
+from celestia_tpu.ops import extend_tpu, gf256, rs_tpu, xor_schedule
+
+POW2_KS = [2, 4, 8, 16, 32, 64, 128]
+TIER1_KS = [k for k in POW2_KS if k < 32]
+SLOW_KS = [k for k in POW2_KS if k >= 32]
+
+
+def _rand_square(rng, k):
+    sh = rng.integers(0, 256, size=(k, k, 512), dtype=np.uint8)
+    flat = sh.reshape(k * k, 512)
+    subs = sorted(
+        rng.integers(0, 200, size=(k * k, 10), dtype=np.uint8).tolist()
+    )
+    for i, sub in enumerate(subs):
+        flat[i, :29] = np.frombuffer(
+            ns.new_v0(bytes(sub)).bytes, dtype=np.uint8
+        )
+    return flat.reshape(k, k, 512)
+
+
+def _dense_planes(k: int, planes: np.ndarray) -> np.ndarray:
+    m2 = rs_tpu.encode_bit_matrix(k)
+    return (m2.astype(np.int64) @ planes) & 1
+
+
+def _assert_matrix_matches_leopard(k: int) -> None:
+    """The expanded (8k,8k) GF(2) matrix must spell exactly the Leopard
+    encode: parity bytes via unpack -> m2-contraction -> pack equal
+    gf256.leopard_encode on random shards (satellite property)."""
+    rng = np.random.default_rng(1000 + k)
+    data = rng.integers(0, 256, size=(k, 48), dtype=np.uint8)
+    ref = gf256.leopard_encode(data)
+    # pure-numpy spelling of the bit contraction (LSB-first planes,
+    # contraction index q = 8*shard + bit — the rs_tpu layout contract)
+    bits = ((data[:, None, :] >> np.arange(8)[None, :, None]) & 1).reshape(
+        8 * k, -1
+    )
+    pbits = _dense_planes(k, bits).reshape(k, 8, -1)
+    got = (pbits << np.arange(8)[None, :, None]).sum(axis=1).astype(np.uint8)
+    assert np.array_equal(ref, got)
+
+
+def _assert_schedule_matches_dense(k: int) -> None:
+    sched = xor_schedule.compile_schedule(k)
+    rng = np.random.default_rng(2000 + k)
+    planes = rng.integers(0, 2, size=(8 * k, 195), dtype=np.int32)
+    assert np.array_equal(
+        _dense_planes(k, planes),
+        xor_schedule.apply_planes_np(planes, sched),
+    )
+
+
+class TestEncodeMatrixVsLeopard:
+    @pytest.mark.parametrize("k", TIER1_KS)
+    def test_matrix_matches_leopard(self, k):
+        _assert_matrix_matches_leopard(k)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("k", SLOW_KS)
+    def test_matrix_matches_leopard_large(self, k):
+        _assert_matrix_matches_leopard(k)
+
+
+class TestScheduleCompiler:
+    @pytest.mark.parametrize("k", TIER1_KS)
+    def test_schedule_matches_dense(self, k):
+        _assert_schedule_matches_dense(k)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("k", SLOW_KS)
+    def test_schedule_matches_dense_large(self, k):
+        _assert_schedule_matches_dense(k)
+
+    @pytest.mark.parametrize("k", [4, 16])
+    def test_schedule_shape_invariants(self, k):
+        s = xor_schedule.compile_schedule(k)
+        assert s.n_in == s.n_out == 8 * k
+        assert s.n_nodes == sum(s.level_widths) == len(s.flat_a)
+        # topological: a node's operands must be inputs, ZERO, or nodes
+        # from STRICTLY earlier levels
+        base = s.n_in + 1
+        off = 0
+        for w in s.level_widths:
+            for t in range(off, off + w):
+                assert s.flat_a[t] < base + off
+                assert s.flat_b[t] < base + off
+            off += w
+        assert s.row_idx.min() >= 0
+        assert s.row_idx.max() < base + s.n_nodes
+        # the whole point: CSE must beat the naive per-row XOR count
+        assert 0 < s.xor_ops < s.dense_ops
+        assert s.cse_hits > 0
+
+    def test_compile_cached_per_k(self):
+        assert xor_schedule.compile_schedule(4) is xor_schedule.compile_schedule(4)
+
+    def test_supported_domain(self):
+        assert xor_schedule.supported(2)
+        assert xor_schedule.supported(128)
+        assert not xor_schedule.supported(0)
+        assert not xor_schedule.supported(3)
+        assert not xor_schedule.supported(512)
+
+
+class TestJnpSpellings:
+    @pytest.mark.parametrize("k", [2, 4, 16])
+    def test_rows_match_leopard(self, k):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(k)
+        data = rng.integers(0, 256, size=(k, 64), dtype=np.uint8)
+        ref = gf256.leopard_encode(data)
+        sched = xor_schedule.compile_schedule(k)
+        got = np.asarray(
+            xor_schedule.rs_encode_rows_xor(jnp.asarray(data), sched)
+        )
+        assert np.array_equal(ref, got)
+
+    @pytest.mark.parametrize("k", [2, 4, 16])
+    def test_extend_square_matches_dense(self, k):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(300 + k)
+        q0 = _rand_square(rng, k)
+        m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
+        ref = np.asarray(rs_tpu.extend_square(jnp.asarray(q0), m2))
+        got = np.asarray(
+            xor_schedule.extend_square_xor(
+                jnp.asarray(q0), xor_schedule.compile_schedule(k)
+            )
+        )
+        assert np.array_equal(ref, got)
+
+    @pytest.mark.parametrize("k", [32, 64])
+    def test_pallas_kernel_matches_dense(self, k):
+        """Interpret mode drives the kernel's exact grid/BlockSpec glue
+        on the CPU platform, mirroring TestPallasKernel."""
+        import jax.numpy as jnp
+
+        from celestia_tpu.ops import rs_pallas
+
+        rng = np.random.default_rng(400 + k)
+        x2 = rng.integers(0, 256, size=(k, k * 512), dtype=np.uint8)
+        m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
+        ref = np.asarray(rs_pallas.encode2d(jnp.asarray(x2), m2, interpret=True))
+        got = np.asarray(
+            xor_schedule.encode2d_xor(jnp.asarray(x2), interpret=True)
+        )
+        assert np.array_equal(ref, got)
+
+    @pytest.mark.parametrize("k", [4, 16])
+    def test_fused_hash_reference_matches_dense(self, k):
+        """The fused-pipeline parity: XOR-contraction reference spelling
+        vs the dense one — parity bytes AND leaf digest words."""
+        from celestia_tpu.ops import rs_pallas
+
+        rng = np.random.default_rng(500 + k)
+        x2 = rng.integers(0, 256, size=(k, k * 512), dtype=np.uint8)
+        m2 = rs_tpu.encode_bit_matrix(k)
+        ref_p, ref_d = rs_pallas.encode2d_hash_reference(x2, m2, tile=k * 512)
+        got_p, got_d = xor_schedule.encode2d_xor_hash_reference(
+            x2, tile=k * 512
+        )
+        assert np.array_equal(np.asarray(ref_p), np.asarray(got_p))
+        assert np.array_equal(np.asarray(ref_d), np.asarray(got_d))
+
+
+class TestDahParity:
+    """The production contract: the schedule forced on must produce the
+    byte-identical DAH the host oracle computes."""
+
+    def _assert_dah(self, k: int, xor_fused: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(600 + k)
+        sq = _rand_square(rng, k)
+        eds_ref = da.extend_shares(sq.reshape(k * k, 512))
+        dah_ref = da.new_data_availability_header(eds_ref)
+        m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
+        # jitted like every production entry — the eager spelling would
+        # dispatch the schedule's levels op by op
+        eds, rows, cols = jax.jit(
+            lambda s: extend_tpu._roots_of(s, m2, fused=False, xor=True)
+        )(jnp.asarray(sq))
+        assert np.array_equal(np.asarray(eds), eds_ref.data)
+        assert [bytes(np.asarray(r)) for r in rows] == dah_ref.row_roots
+        assert [bytes(np.asarray(c)) for c in cols] == dah_ref.column_roots
+        if xor_fused:
+            eds_f, rows_f, cols_f = extend_tpu.fused_roots_reference(
+                sq, tile=k * 512, xor=True
+            )
+            assert np.array_equal(eds_f, eds_ref.data)
+            assert [bytes(r) for r in rows_f] == dah_ref.row_roots
+            assert [bytes(c) for c in cols_f] == dah_ref.column_roots
+
+    @pytest.mark.parametrize("k", [2, 4, 16])
+    def test_dah_parity_small_k(self, k):
+        self._assert_dah(k, xor_fused=(k == 16))
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("k", [32, 64])
+    def test_dah_parity_large_k(self, k):
+        self._assert_dah(k, xor_fused=(k == 32))
+
+
+class TestRowSharded:
+    """Per-shard column-block schedules on the virtual 8-device mesh
+    (conftest pins --xla_force_host_platform_device_count=8)."""
+
+    @pytest.mark.parametrize("k,sp", [(4, 2), (16, 4)])
+    def test_sharded_arrays_cover_matrix(self, k, sp):
+        """XOR of per-shard column-block evaluations == full dense
+        contraction (the psum-combine identity the mesh program uses)."""
+        import jax.numpy as jnp
+
+        tpl, fa, fb, ri = xor_schedule.sharded_schedule_arrays(k, sp)
+        rng = np.random.default_rng(700 + k)
+        planes = rng.integers(0, 2, size=(8 * k, 97), dtype=np.int32)
+        cols = (8 * k) // sp
+        acc = np.zeros((8 * k, 97), dtype=np.int32)
+        for i in range(sp):
+            block = jnp.asarray(planes[i * cols:(i + 1) * cols])
+            acc ^= np.asarray(xor_schedule.apply_planes(
+                block, tpl,
+                flat_a=jnp.asarray(fa[i]), flat_b=jnp.asarray(fb[i]),
+                row_idx=jnp.asarray(ri[i]),
+            ))
+        assert np.array_equal(_dense_planes(k, planes), acc)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("k", [16, 32])
+    def test_rowsharded_mesh_parity(self, k):
+        import jax.numpy as jnp
+
+        from celestia_tpu import parallel
+
+        rng = np.random.default_rng(800 + k)
+        sq = _rand_square(rng, k)
+        mesh = parallel.make_mesh(1, 8)
+        dense = parallel.extend_and_root_rowsharded(mesh, k, xor=False)
+        xor = parallel.extend_and_root_rowsharded(mesh, k, xor=True)
+        out_d = [np.asarray(t) for t in dense(jnp.asarray(sq))]
+        out_x = [np.asarray(t) for t in xor(jnp.asarray(sq))]
+        for a, b in zip(out_d, out_x):
+            assert np.array_equal(a, b)
+
+    @pytest.mark.slow
+    def test_levels_spelling_parity(self):
+        import jax.numpy as jnp
+
+        from celestia_tpu import parallel
+
+        k = 16
+        rng = np.random.default_rng(900)
+        sq = _rand_square(rng, k)
+        mesh = parallel.make_mesh(1, 8)
+        out_d = parallel.extend_root_levels_rowsharded(mesh, k, xor=False)(
+            jnp.asarray(sq)
+        )
+        out_x = parallel.extend_root_levels_rowsharded(mesh, k, xor=True)(
+            jnp.asarray(sq)
+        )
+        for a, b in zip(out_d[:4], out_x[:4]):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(out_d[4], out_x[4]):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestRouting:
+    def test_env_pins(self, monkeypatch):
+        monkeypatch.setenv(extend_tpu._XOR_ENV, "0")
+        assert not extend_tpu._xor_active(64)
+        monkeypatch.setenv(extend_tpu._XOR_ENV, "1")
+        assert extend_tpu._xor_active(64)
+        # non-pow2 k: no schedule exists, even forced on
+        assert not extend_tpu._xor_active(48)
+
+    def test_auto_consults_table(self, monkeypatch):
+        from celestia_tpu.app import calibration
+
+        monkeypatch.delenv(extend_tpu._XOR_ENV, raising=False)
+        table = calibration.CrossoverTable(
+            {64: {"dense": 5.0, "xor": 1.0}, 16: {"dense": 1.0, "xor": 5.0}}
+        )
+        monkeypatch.setattr(calibration, "_xor_table", table)
+        monkeypatch.setattr(calibration, "_xor_loaded", True)
+        assert calibration.xor_winner(64) == "xor"
+        assert calibration.xor_winner(16) == "dense"
+        assert extend_tpu._xor_active(64)
+        assert not extend_tpu._xor_active(16)
+
+    def test_winner_defaults_dense(self, monkeypatch):
+        from celestia_tpu.app import calibration
+
+        monkeypatch.setattr(calibration, "_xor_table", None)
+        monkeypatch.setattr(calibration, "_xor_loaded", True)
+        assert calibration.xor_winner(64) == "dense"
+
+    def test_jit_cache_keys_spelling(self):
+        a = extend_tpu._jitted_roots_noeds(4, fused=False, xor=False)
+        b = extend_tpu._jitted_roots_noeds(4, fused=False, xor=True)
+        assert a is not b
+        assert a is extend_tpu._jitted_roots_noeds(4, fused=False, xor=False)
+
+    def test_committed_table_loads(self):
+        """The repo-committed config/xor_schedule.json must parse into a
+        table with dense/xor entries at the benched rungs."""
+        from celestia_tpu.app import calibration
+
+        import pathlib
+
+        path = (pathlib.Path(extend_tpu.__file__).resolve().parents[2]
+                / "config" / calibration.XOR_FILENAME)
+        table = calibration.CrossoverTable.load(path)
+        assert table is not None
+        for k in (32, 64):
+            assert set(table.entries[k]) == {"dense", "xor"}
