@@ -10,6 +10,7 @@ PY ?= python
 	scenario-smoke scenario-pfb-storm scenario-rolling-outage \
 	scenario-sdc-under-storm scenario-rejoin-under-load \
 	scenario-gateway-fleet scenario-scale-out-under-load scenarios \
+	soak-smoke scenario-soak scenario-das-sweep \
 	kernel-smoke bench-fused analyze san multichip-smoke multichip-bench \
 	xor-smoke bench-xor
 
@@ -118,6 +119,15 @@ bench-gate:
 # 2x regression. CPU-only, seconds.
 obs-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/obs_smoke.py
+
+# Longitudinal-telemetry smoke gate (specs/observability.md
+# §Longitudinal telemetry): live .ctts recording over the real
+# /metrics wire, a mid-recording node kill/restart absorbed by the
+# counter-reset rebase, the drift detector flagging a synthetic leak
+# while clearing a flat control, and CRC refusal of a flipped byte.
+# CPU-only, crypto-free, seconds warm.
+soak-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/soak_smoke.py
 
 # SDC defense drill (ADR-015): arm a seeded bitflip at every integrity
 # injection point (extend output, repair output, transfer chunk), prove
@@ -275,10 +285,31 @@ scenario-scale-out-under-load:
 	JAX_PLATFORMS=cpu $(PY) -m celestia_tpu.scenarios \
 		scale-out-under-load --ledger scenario_ledger.json
 
+# Longitudinal soak (specs/observability.md §Longitudinal telemetry):
+# thousands of heights under store compaction churn with the whole run
+# recorded to a durable .ctts; judged by Theil-Sen drift detectors
+# over the RECORDED series (RSS, fds, store bytes, probe p99) plus
+# byte-identity re-verification of samples served `soak_sample_lag`
+# heights apart. --soak-ledger feeds soak_ledger.json so `make
+# bench-gate` judges the drift-breach trajectory.
+scenario-soak:
+	JAX_PLATFORMS=cpu $(PY) -m celestia_tpu.scenarios soak \
+		--ledger scenario_ledger.json --soak-ledger soak_ledger.json \
+		--record soak.ctts
+
+# Open-loop offered-load sweep: stepped seeded-Poisson arrival rates
+# against /sample with latency measured from the INTENDED send time
+# (no coordinated omission) — emits the latency-vs-offered-load curve
+# and the knee estimate into the report + soak ledger.
+scenario-das-sweep:
+	JAX_PLATFORMS=cpu $(PY) -m celestia_tpu.scenarios das-sweep \
+		--ledger scenario_ledger.json --soak-ledger soak_ledger.json
+
 # All six suites back to back.
 scenarios: scenario-pfb-storm scenario-rolling-outage \
 	scenario-sdc-under-storm scenario-rejoin-under-load \
-	scenario-gateway-fleet scenario-scale-out-under-load
+	scenario-gateway-fleet scenario-scale-out-under-load \
+	scenario-soak scenario-das-sweep
 
 # Multi-chip block-pipeline smoke gate (specs/parallel.md §Block
 # pipeline): stream blocks through the 3-deep H2D/compute/D2H pipeline

@@ -292,12 +292,25 @@ class Prober:
         return self
 
     def _run(self) -> None:
+        # cycles fire on an ABSOLUTE clock grid. The old loop slept a
+        # fixed interval AFTER each cycle, so a slow serve path
+        # silently lowered the probe rate — the prober coordinated
+        # with the very degradation it exists to measure. Now a slow
+        # cycle overruns its slot (counted), the missed grid points
+        # are skipped, and the cadence stays honest.
+        next_slot = time.monotonic()
         while not self._stop.is_set():
             try:
                 self.probe_cycle()
             except Exception as e:  # noqa: BLE001 — the loop never dies
                 log.error("probe cycle crashed", error=str(e))
-            self._stop.wait(self.interval)
+            next_slot += self.interval
+            now = time.monotonic()
+            if now >= next_slot:
+                self.metrics.incr_counter("probe_overrun_total")
+                while next_slot <= now:
+                    next_slot += self.interval
+            self._stop.wait(max(0.0, next_slot - now))
 
     def stop(self) -> None:
         self._stop.set()

@@ -307,8 +307,12 @@ def _handler_for(node: Node, dispatcher: DeviceDispatcher | None = None,
             parts = [p for p in self.path.split("/") if p]
             try:
                 if parts == ["metrics"]:
-                    from celestia_tpu.telemetry import metrics
+                    from celestia_tpu.telemetry import (
+                        metrics, refresh_process_gauges)
 
+                    # host-resource gauges are pull-refreshed: nobody
+                    # scraping = zero cycles spent reading procfs
+                    refresh_process_gauges(metrics)
                     body = metrics.prometheus_text().encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain; version=0.0.4")

@@ -29,6 +29,17 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--ledger", metavar="PATH",
                    help="append a {pass, breaches} run record to this "
                         "scenario ledger (read by make bench-gate)")
+    p.add_argument("--record", metavar="PATH",
+                   help="record the run's /metrics into this .ctts "
+                        "file (tools/tsdb.py); implied to a temp file "
+                        "when the scenario sets record_cadence_s")
+    p.add_argument("--soak-ledger", metavar="PATH",
+                   help="append a {drift_breaches, knee} run record to "
+                        "this soak ledger (read by make bench-gate)")
+    p.add_argument("--inject-leak", action="store_true",
+                   help="run a synthetic monotone-gauge leak the drift "
+                        "verdict MUST flag (red-path self-test; the "
+                        "run is EXPECTED to fail)")
     p.add_argument("--quiet", action="store_true",
                    help="suppress the report summary on stdout")
     p.add_argument("--san", action="store_true",
@@ -61,7 +72,10 @@ def main(argv: list[str] | None = None) -> int:
         report = run_scenario(scenario, seed=args.seed,
                               duration_scale=args.duration_scale,
                               report_path=args.report,
-                              ledger_path=args.ledger)
+                              ledger_path=args.ledger,
+                              record_path=args.record,
+                              soak_ledger_path=args.soak_ledger,
+                              inject_leak=args.inject_leak)
     finally:
         if san_session is not None:
             sanitizer.deactivate(san_session)
@@ -104,6 +118,28 @@ def _summarize(report: dict) -> None:
     w = report["world"]
     print(f"  world: heights={w['heights']} das={w['das']} "
           f"pfb={w['pfb']} mempool={w['mempool']}")
+    rec = report.get("recording")
+    if rec:
+        print(f"  recording: {rec.get('samples', 0)} samples / "
+              f"{rec.get('series', 0)} series @ {rec.get('cadence_s')}s "
+              f"({rec.get('scrapes')} scrapes, "
+              f"{rec.get('overruns')} overruns, "
+              f"{rec.get('counter_resets')} counter resets)")
+    for d in report.get("drift") or ():
+        mark = "DRIFTING" if d.get("drifting") else "flat"
+        note = d.get("note")
+        extra = (f" rel_growth={d['rel_growth']:.2f}"
+                 if "rel_growth" in d else f" ({note})" if note else "")
+        print(f"  drift {mark:8s} {d['series']}{extra}")
+    curve = report.get("load_curve")
+    if curve:
+        for s in curve["steps"]:
+            print(f"  load {s['planned_hz']:8.1f} Hz planned -> "
+                  f"offered {s['offered_hz']:8.1f} goodput "
+                  f"{s['goodput_hz']:8.1f} p50={s['p50_s']:.4f}s "
+                  f"p99={s['p99_s']:.4f}s")
+        knee = curve["knee"]
+        print(f"  knee: {knee}")
     if not report["scenario_slo_pass"]:
         print(json.dumps(v, indent=2), file=sys.stderr)
 

@@ -55,8 +55,18 @@ def run_scenario(scenario: Scenario, *, seed: int = 1337,
                  duration_scale: float = 1.0,
                  report_path: str | None = None,
                  ledger_path: str | None = None,
+                 record_path: str | None = None,
+                 soak_ledger_path: str | None = None,
+                 inject_leak: bool = False,
                  registry=None) -> dict:
-    """Execute one scenario end to end; returns the scenario report."""
+    """Execute one scenario end to end; returns the scenario report.
+
+    ``record_path`` (or a scenario with ``record_cadence_s > 0``)
+    starts a tsdb Scraper for the run's whole life and judges drift /
+    the recorded-SLO replay from the resulting ``.ctts``.
+    ``inject_leak`` runs a synthetic monotone-gauge leak
+    (``soak_leak_bytes``) that the drift verdict MUST flag — the
+    red-path self-test of the no_monotone_drift invariant."""
     if registry is None:
         from celestia_tpu.telemetry import metrics as registry
     if getattr(scenario, "fleet_processes", 0):
@@ -69,25 +79,38 @@ def run_scenario(scenario: Scenario, *, seed: int = 1337,
         world = FleetWorld(scenario, seed, registry=registry)
     else:
         world = ScenarioWorld(scenario, seed, registry=registry)
+    world.duration_scale = duration_scale
     injector = faults.FaultInjector(campaign_rules(scenario), seed=seed)
     engine = slo.SloEngine(registry=registry)
     phases: list[dict] = []
+    recording_meta: dict | None = None
     t_start = time.monotonic()
     with faults.inject(injector=injector):
         world.start()
+        scraper, rec_path, rec_tmp = _start_recording(
+            scenario, world, registry, record_path, seed)
+        leak_stop = _start_leak(registry) if inject_leak else None
         run_cap0 = engine.capture()
         for ph in scenario.phases:
             phases.append(_run_phase(scenario, ph, world, injector,
                                      engine, seed, duration_scale))
+        world.openload.end(time.monotonic())
         world.quiesce()
         world.freeze()  # heights stable: probes judge a fixed chain
         world.settle_follower()
+        if leak_stop is not None:
+            leak_stop.set()
+        recording_meta = _finish_recording(scenario, world, engine,
+                                           scraper, rec_path,
+                                           inject_leak)
         run_cap1 = engine.capture()
         whole_run = engine.evaluate_at((run_cap0, run_cap1))
         final = engine.evaluate()  # breach transitions on full history
         invariants = verdict_mod.run_invariants(scenario, world, injector,
                                                 registry, run_cap0, run_cap1)
         world.stop()
+        if rec_tmp is not None:
+            rec_tmp.cleanup()
     v = verdict_mod.assemble(scenario, whole_run, phases, final, invariants)
     report = {
         "scenario": scenario.name,
@@ -119,6 +142,19 @@ def run_scenario(scenario: Scenario, *, seed: int = 1337,
     }
     if hasattr(world, "fleet_report"):
         report["world"]["fleet"] = world.fleet_report()
+    curve = world.openload.curve()
+    if curve:
+        from .openload import detect_knee
+
+        report["load_curve"] = {"steps": curve,
+                                "knee": detect_knee(curve)}
+    if recording_meta is not None:
+        report["recording"] = recording_meta
+        report["drift"] = world.drift_report
+        if "slo_recorded" in recording_meta:
+            report["slo"]["recorded"] = recording_meta.pop("slo_recorded")
+    if soak_ledger_path:
+        append_soak_ledger(soak_ledger_path, report)
     if report_path:
         with open(report_path, "w") as f:
             json.dump(report, f, indent=2)
@@ -127,11 +163,135 @@ def run_scenario(scenario: Scenario, *, seed: int = 1337,
     return report
 
 
+def _start_recording(scenario: Scenario, world, registry,
+                     record_path: str | None, seed: int):
+    """Boot the longitudinal recorder when the scenario (or caller)
+    asks for one. Returns (scraper, path, tempdir|None) or a None
+    triple. The global-registry world is scraped over HTTP — the real
+    /metrics wire — while an isolated-registry run (tests) renders its
+    own registry through the identical parse path."""
+    if not (record_path or scenario.record_cadence_s > 0):
+        return None, None, None
+    from celestia_tpu import telemetry
+    from celestia_tpu.tools import tsdb
+
+    rec_tmp = None
+    path = record_path
+    if path is None:
+        import tempfile
+
+        rec_tmp = tempfile.TemporaryDirectory(prefix="ctts-")
+        path = os.path.join(rec_tmp.name, f"{scenario.name}.ctts")
+    cadence = scenario.record_cadence_s or tsdb.DEFAULT_CADENCE_S
+    meta = {"scenario": scenario.name, "seed": seed}
+    if registry is telemetry.metrics and getattr(world, "url", None):
+        scraper = tsdb.Scraper(world.url + "/metrics", path,
+                               cadence_s=cadence, meta=meta)
+    else:
+        scraper = tsdb.RegistryScraper(registry, path, cadence_s=cadence,
+                                       meta=meta)
+    scraper.start()
+    return scraper, path, rec_tmp
+
+
+def _start_leak(registry) -> threading.Event:
+    """Synthetic leak: a gauge that only ever goes up. The drift
+    detector MUST flag it — the red-path self-test proving the
+    no_monotone_drift verdict can actually fail."""
+    stop = threading.Event()
+
+    def _leak():
+        total = 0.0
+        while not stop.is_set():
+            total += 1_048_576.0
+            registry.set_gauge("soak_leak_bytes", total)
+            stop.wait(0.1)
+
+    threading.Thread(target=_leak, daemon=True, name="soak-leak").start()
+    return stop
+
+
+def _finish_recording(scenario: Scenario, world, engine, scraper,
+                      rec_path: str | None,
+                      inject_leak: bool) -> dict | None:
+    """Stop the scraper, read the .ctts back, drift-judge the
+    configured series (plus the injected leak gauge), and replay the
+    whole-run SLO window from the RECORDING — durable data, not live
+    snapshots."""
+    if scraper is None:
+        return None
+    from celestia_tpu.tools import tsdb
+
+    scraper.stop(final_scrape=True)
+    meta = {
+        "path": rec_path,
+        "cadence_s": scraper.cadence_s,
+        "scrapes": scraper.scrapes,
+        "scrape_errors": scraper.scrape_errors,
+        "overruns": scraper.overruns,
+        "counter_resets": sum(scraper.reset_counts.values()),
+    }
+    try:
+        rec = tsdb.read(rec_path)
+    except Exception as e:  # noqa: BLE001 — a bad recording is reported
+        meta["read_error"] = str(e)
+        world.drift_report = None
+        return meta
+    meta["samples"] = len(rec.samples)
+    meta["series"] = len(rec.names)
+    specs = tuple(scenario.drift_series)
+    if inject_leak and "soak_leak_bytes" not in specs:
+        specs += ("soak_leak_bytes",)
+    if specs:
+        world.drift_report = tsdb.analyze_drift(rec, specs)
+    if len(rec.samples) >= 2:
+        meta["slo_recorded"] = engine.evaluate_at(
+            (rec.capture_at(engine.objectives, rec.t0),
+             rec.capture_at(engine.objectives, rec.t1)))
+    return meta
+
+
+def append_soak_ledger(path: str, report: dict) -> None:
+    """Fold one recorded run into soak_ledger.json (`make bench-gate`
+    reads ``drift_breaches`` — 0 means no series drifted — and the
+    knee goodput when a sweep emitted a load curve)."""
+    doc: dict = {"runs": []}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                loaded = json.load(f)
+            if isinstance(loaded, dict) and isinstance(
+                    loaded.get("runs"), list):
+                doc = loaded
+        except (OSError, ValueError):
+            pass
+    drift = report.get("drift") or []
+    knee = (report.get("load_curve") or {}).get("knee") or {}
+    doc["runs"].append({
+        "ts": time.time(),
+        "scenario": report["scenario"],
+        "seed": report["seed"],
+        "pass": report["scenario_slo_pass"],
+        "drift_breaches": sum(1 for d in drift if d.get("drifting")),
+        "knee_samples_per_sec": knee.get("knee_hz"),
+        "wall_s": report["wall_s"],
+    })
+    doc["runs"] = doc["runs"][-LEDGER_MAX_RUNS:]
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+
+
 def _run_phase(scenario: Scenario, ph, world: ScenarioWorld,
                injector: faults.FaultInjector, engine: slo.SloEngine,
                seed: int, duration_scale: float) -> dict:
     injector.set_phase(ph.name)
     world.apply_actions(ph.enter_actions)
+    open_hz = sum(ls.clients * (ls.rate_hz or 0.0)
+                  for ls in ph.loads if ls.kind == "open_das")
+    if open_hz:
+        world.openload.begin_phase(ph.name, open_hz, time.monotonic())
+    else:
+        world.openload.end(time.monotonic())
     overload = any(c.site.startswith("dispatch.") for c in ph.campaigns)
     if overload:
         # a dispatcher campaign may legitimately flip /readyz's
@@ -169,7 +329,8 @@ def _run_phase(scenario: Scenario, ph, world: ScenarioWorld,
         "name": ph.name,
         "duration_s": ph.duration_s * duration_scale,
         "loads": [
-            {"kind": ls.kind, "clients": ls.clients, "profile": ls.profile}
+            {"kind": ls.kind, "clients": ls.clients, "profile": ls.profile,
+             "rate_hz": ls.rate_hz}
             for ls in ph.loads
         ],
         "slo": engine.evaluate_at((cap0, cap1)),

@@ -32,6 +32,14 @@ Each is a production-emulation campaign judged by the SLO board:
                       must backfill to the fleet head before taking
                       ring traffic and pre-join heights must still
                       NMT-verify through the grown ring (ADR-023).
+    soak              duration-scalable long-chain soak: thousands of
+                      heights with store compaction churn + retention
+                      pruning, judged by Theil-Sen drift over the
+                      recorded .ctts series and the height-N ==
+                      height-N+lag byte-identity anchors.
+    das-sweep         stepped open-loop offered-load sweep emitting
+                      the coordinated-omission-free latency-vs-load
+                      curve with knee detection.
     smoke             the crypto-free CI gate: every engine mechanism
                       (profiles, phase-scoped campaigns, SDC drill,
                       strike/recover, windowed verdict) in a few
@@ -287,6 +295,79 @@ def _scale_out_under_load() -> Scenario:
     )
 
 
+def _soak() -> Scenario:
+    return Scenario(
+        name="soak",
+        description=("duration-scalable long-chain soak: thousands of "
+                     "heights through store compaction churn and "
+                     "in-memory retention pruning under mixed closed- "
+                     "and open-loop DAS load, judged by Theil-Sen "
+                     "drift over the recorded .ctts series plus the "
+                     "height-N == height-N+lag byte-identity anchor "
+                     "re-verification"),
+        k=2,  # small squares: the soak stresses LONGEVITY, not width
+        queue_capacity=64,
+        block_interval_s=0.002,  # produce as fast as the store allows
+        initial_heights=1,
+        store=True,
+        store_compact_budget_bytes=12 << 20,
+        store_compact_every=50,
+        retain_heights=300,
+        record_cadence_s=0.25,
+        soak_sample_lag=1000,
+        drift_series=("process_rss_bytes", "process_open_fds",
+                      "eds_cache_pages_resident", "eds_cache_pin_count",
+                      "store_bytes", "probe_sample:p99"),
+        phases=(
+            Phase(name="warmup", duration_s=2.0, loads=(
+                LoadSpec(kind="das", clients=2),
+            )),
+            Phase(name="soak-steady", duration_s=14.0, loads=(
+                LoadSpec(kind="das", clients=2),
+                LoadSpec(kind="open_das", clients=1, rate_hz=25.0,
+                         profile="mixed-namespaces"),
+            )),
+            Phase(name="cooldown", duration_s=2.0, loads=(
+                LoadSpec(kind="das", clients=2),
+            )),
+        ),
+        invariants=("prober_verified", "readyz_well_ordered",
+                    "no_monotone_drift", "soak_byte_identity"),
+    )
+
+
+def _das_sweep() -> Scenario:
+    # stepped offered-load sweep: each phase raises the OPEN-LOOP
+    # arrival rate; the report's load_curve has a monotone offered
+    # axis with intended-send-time latency per step and knee detection
+    steps = (10.0, 25.0, 60.0, 150.0, 400.0)
+    return Scenario(
+        name="das-sweep",
+        description=("stepped open-loop offered-load sweep over the "
+                     "DAS serve path: seeded Poisson arrivals with "
+                     "Zipf height popularity, latency from INTENDED "
+                     "send time (coordinated-omission-free), emitting "
+                     "the latency-vs-load curve + knee that replaces "
+                     "single-point storm numbers"),
+        k=4,
+        queue_capacity=64,
+        block_interval_s=0.2,
+        record_cadence_s=0.25,
+        phases=tuple(
+            Phase(name=f"step-{int(hz)}hz", duration_s=2.5, loads=(
+                LoadSpec(kind="open_das", clients=2, rate_hz=hz / 2,
+                         profile="mixed-namespaces"),
+            ))
+            for hz in steps
+        ),
+        # past the knee the open loop may overrun deadlines/shed — the
+        # sweep MEASURES saturation rather than forbidding it
+        allowed_breaches=frozenset({"rpc_admission"}),
+        invariants=("prober_verified", "dah_byte_identical",
+                    "readyz_well_ordered"),
+    )
+
+
 def _smoke() -> Scenario:
     return Scenario(
         name="smoke",
@@ -333,7 +414,7 @@ SCENARIOS = {
     fn().name: fn
     for fn in (_pfb_storm, _rolling_outage, _sdc_under_storm,
                _rejoin_under_load, _gateway_fleet,
-               _scale_out_under_load, _smoke)
+               _scale_out_under_load, _soak, _das_sweep, _smoke)
 }
 
 

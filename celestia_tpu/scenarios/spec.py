@@ -29,7 +29,7 @@ from __future__ import annotations
 import dataclasses
 
 #: load driver kinds world.py implements
-LOAD_KINDS = ("das", "pfb", "follower_sync")
+LOAD_KINDS = ("das", "pfb", "follower_sync", "open_das")
 
 #: phase-boundary world actions engine.py may apply
 ACTIONS = ("tpu_strike", "tpu_recover", "sdc_clear", "follower_boot",
@@ -39,7 +39,8 @@ ACTIONS = ("tpu_strike", "tpu_recover", "sdc_clear", "follower_boot",
 INVARIANTS = ("prober_verified", "dah_byte_identical",
               "readyz_well_ordered", "zero_undetected_sdc",
               "follower_caught_up", "restarted_serves_from_store",
-              "fleet_scaled_out")
+              "fleet_scaled_out", "no_monotone_drift",
+              "soak_byte_identity")
 
 #: fault sites whose bitflips are silent-data-corruption injections —
 #: the zero_undetected_sdc probe counts timeline entries at these
@@ -57,7 +58,13 @@ class LoadSpec:
     PFB payloads (txsim.PROFILES[profile]).
     ``kind='follower_sync'``: the booted follower node catches up from
     the primary over a real RpcClient (rides the ``rpc.get`` site).
-    ``rate_hz`` caps per-client op rate; None = closed loop."""
+    ``kind='open_das'``: ONE open-loop arrival process per client —
+    seeded Poisson arrivals at ``rate_hz`` on an absolute schedule with
+    Zipf height popularity (``profile``'s ns_skew, default
+    mixed-namespaces), latency measured from the INTENDED send time so
+    queue buildup is charged to the server (scenarios/openload.py).
+    ``rate_hz`` caps per-client op rate; None = closed loop (required
+    for ``open_das`` — an open loop IS its offered rate)."""
 
     kind: str
     clients: int = 1
@@ -70,6 +77,10 @@ class LoadSpec:
                 f"unknown load kind {self.kind!r}; one of {LOAD_KINDS}")
         if self.kind == "pfb" and self.profile is None:
             raise ValueError("pfb load requires a traffic profile")
+        if self.kind == "open_das" and not self.rate_hz:
+            raise ValueError("open_das load requires rate_hz: an "
+                             "open-loop driver is DEFINED by its "
+                             "offered arrival rate")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -131,6 +142,27 @@ class Scenario:
     # action then grows the fleet to this target size under load, each
     # joiner backfilling to the fleet head before taking traffic
     fleet_processes: int = 0
+    # soak shape (single-node only): a durable store under the node
+    # (fsync-relaxed; the harness is throughput-bound, torn writes
+    # still can't surface through the atomic rename), compaction churn
+    # every N produced blocks against a byte budget, and in-memory
+    # retention pruning so thousands of heights don't hold RSS hostage
+    store: bool = False
+    store_compact_budget_bytes: int = 0
+    store_compact_every: int = 50
+    retain_heights: int = 0
+    # longitudinal recording: >0 starts a tsdb Scraper against the
+    # node's /metrics at this cadence for the run's whole life; the
+    # drift verdict and recorded-SLO replay read the .ctts it writes
+    record_cadence_s: float = 0.0
+    # Theil-Sen drift series judged by the no_monotone_drift invariant
+    # ("name" for a recorded gauge/counter, "family:pNN" for a derived
+    # histogram quantile series, e.g. "probe_sample:p99")
+    drift_series: tuple[str, ...] = ()
+    # soak_byte_identity: anchored samples at height N must verify
+    # byte-identically once the chain reaches N + soak_sample_lag
+    # (scaled down with --duration-scale, floor 10)
+    soak_sample_lag: int = 0
     # verdict contract
     allowed_breaches: frozenset[str] = frozenset()
     required_breaches: frozenset[str] = frozenset()
@@ -190,3 +222,23 @@ class Scenario:
             raise ValueError("fleet_scale_out / fleet_scaled_out require "
                              "fleet_processes >= 2 (there must be a "
                              "target size to grow to)")
+        if self.store and (self.fleet or self.fleet_processes):
+            raise ValueError("the soak store rides the single-node "
+                             "world; fleet modes manage their own "
+                             "backend stores")
+        if (self.store_compact_budget_bytes or self.retain_heights) \
+                and not self.store:
+            raise ValueError("compaction budget / retention require "
+                             "store=True")
+        if "soak_byte_identity" in self.invariants and not (
+                self.store and self.soak_sample_lag > 0):
+            raise ValueError("soak_byte_identity requires store=True "
+                             "and soak_sample_lag > 0 (an anchor must "
+                             "outlive the in-memory window to prove "
+                             "anything)")
+        if "no_monotone_drift" in self.invariants and not (
+                self.drift_series and self.record_cadence_s > 0):
+            raise ValueError("no_monotone_drift requires drift_series "
+                             "and record_cadence_s > 0 (the verdict "
+                             "reads the recorded .ctts, not live "
+                             "snapshots)")

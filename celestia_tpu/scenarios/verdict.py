@@ -56,6 +56,8 @@ def run_invariants(scenario: Scenario, world, injector, registry,
         "follower_caught_up": _probe_follower_caught_up,
         "restarted_serves_from_store": _probe_restarted_serves_from_store,
         "fleet_scaled_out": _probe_fleet_scaled_out,
+        "no_monotone_drift": _probe_no_monotone_drift,
+        "soak_byte_identity": _probe_soak_byte_identity,
     }
     out = []
     for name in scenario.invariants:
@@ -268,6 +270,82 @@ def _probe_fleet_scaled_out(scenario, world, injector, registry,
                   f"their observed head; {report['restarts']} restarts, "
                   f"0 crashloops; pre-join height {h} NMT-verified "
                   "through the grown ring")
+
+
+def _probe_no_monotone_drift(scenario, world, injector, registry,
+                             cap0, cap1):
+    """No recorded resource series (RSS, cache pages, store bytes, pin
+    counts, latency quantiles) grew unboundedly over the soak: the
+    engine's teardown ran Theil-Sen drift analysis over the .ctts
+    recording (tools/tsdb.py) and every judged series came back
+    not-drifting. A missing or vacuous report FAILS — a soak that
+    recorded nothing proved nothing."""
+    report = world.drift_report
+    if not report:
+        return False, ("no drift report — the recording was absent, "
+                       "unreadable, or judged no series")
+    judged = [d for d in report if d.get("points", 0) > 0]
+    if not judged:
+        return False, ("every drift series was absent from the "
+                       "recording — the verdict is vacuous")
+    drifting = [d for d in report if d.get("drifting")]
+    if drifting:
+        worst = max(drifting, key=lambda d: d.get("rel_growth", 0.0))
+        return False, (f"{len(drifting)}/{len(report)} series drifting; "
+                       f"worst {worst['series']}: "
+                       f"rel_growth={worst['rel_growth']:.2f} over "
+                       f"{worst['span_s']:.0f}s "
+                       f"(increase_frac={worst['increase_frac']:.2f})")
+    return True, (f"{len(judged)} series judged over the recording, "
+                  f"0 drifting "
+                  f"({len(report) - len(judged)} absent, not judged)")
+
+
+def _probe_soak_byte_identity(scenario, world, injector, registry,
+                              cap0, cap1):
+    """Long-horizon serving identity: a sample anchored at height N
+    must come back BYTE-IDENTICAL once the chain is soak_lag heights
+    past N — across every compaction, eviction, and in-memory prune in
+    between — and must still NMT-verify against the (unchanged) DAH."""
+    from .world import _fetch, _verify_sample
+
+    lag = world.soak_lag
+    latest = world.node.latest_height()
+    eligible = [a for a in world.soak_anchors
+                if a["height"] + lag <= latest]
+    if not eligible:
+        return False, (f"no anchor aged past the lag: "
+                       f"{len(world.soak_anchors)} anchors, head "
+                       f"{latest}, lag {lag} — the soak was too short "
+                       "to prove anything")
+    verified = 0
+    for a in eligible:
+        h, i, j = a["height"], a["i"], a["j"]
+        status, body = _fetch(world.url, f"/sample/{h}/{i}/{j}",
+                              timeout=5.0)
+        if status == 404:
+            continue  # evicted by compaction: absent is honest, not wrong
+        if status != 200:
+            return False, f"height {h} cell ({i},{j}) -> http {status}"
+        if body != a["body"]:
+            return False, (f"height {h} cell ({i},{j}): served bytes "
+                           f"CHANGED between height {h} and {latest}")
+        dah = world.node.block_dah(h)
+        if dah is None:
+            return False, f"height {h}: DAH unavailable at re-verify"
+        if a["dah_hash"] and dah.hash().hex() != a["dah_hash"]:
+            return False, f"height {h}: DAH hash moved during the soak"
+        if not _verify_sample(dah, scenario.k, i, j, body):
+            return False, (f"height {h} cell ({i},{j}) failed NMT "
+                           "re-verification after the lag")
+        verified += 1
+    if verified == 0:
+        return False, (f"all {len(eligible)} aged anchors were evicted "
+                       "— retention/compaction budgets leave no "
+                       "window to re-verify")
+    return True, (f"{verified}/{len(eligible)} aged anchors re-served "
+                  f"byte-identically + NMT-verified at lag {lag} "
+                  f"(head {latest}, {len(world.soak_anchors)} anchored)")
 
 
 def _probe_follower_caught_up(scenario, world, injector, registry,

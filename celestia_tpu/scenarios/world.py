@@ -31,6 +31,7 @@ cross-checks one against the other.
 
 from __future__ import annotations
 
+import collections
 import json
 import threading
 import time
@@ -42,6 +43,7 @@ import numpy as np
 from celestia_tpu import da, txsim
 from celestia_tpu.testutil.chaosnet import RpcChaosNode, chain_shares
 
+from .openload import OpenLoadMeter
 from .spec import LoadSpec, Scenario
 
 
@@ -131,10 +133,21 @@ class ScenarioWorld:
         self.scenario = scenario
         self.seed = seed
         self.registry = registry
+        # soak store: fsync-relaxed (the atomic rename still guards
+        # torn writes; the soak is throughput-bound, not crash-bound)
+        self._store_tmp = None
+        node_kw = {}
+        if scenario.store:
+            import tempfile
+
+            self._store_tmp = tempfile.TemporaryDirectory(
+                prefix=f"soak-{scenario.name}-")
+            node_kw = {"store_dir": self._store_tmp.name,
+                       "store_durable": False}
         self.node = ScenarioNode(
             heights=scenario.initial_heights, k=scenario.k, seed=seed,
             chain_id=f"scenario-{scenario.name}",
-            mempool_cap=scenario.mempool_cap,
+            mempool_cap=scenario.mempool_cap, **node_kw,
         )
         from celestia_tpu.node.rpc import RpcServer
 
@@ -176,10 +189,21 @@ class ScenarioWorld:
         self.pfb_stats = {"accepted": 0, "rejected": 0, "bytes": 0,
                           "http_error": 0}
         self._stats_lock = threading.Lock()
+        # open-loop metering (scenarios/openload.py) + soak state; the
+        # engine sets duration_scale before start and drift_report at
+        # teardown (from the recorded .ctts, not live snapshots)
+        self.openload = OpenLoadMeter()
+        self.duration_scale = 1.0
+        self.soak_anchors: list[dict] = []
+        self.drift_report: dict | None = None
+        self._soak_t0: float | None = None
+        self._soak_budget_cap: int | None = None
+        self._soak_lag_cap: int | None = None
 
     # -- lifecycle ----------------------------------------------------- #
 
     def start(self) -> None:
+        self._soak_t0 = time.monotonic()
         if self.scenario.sdc_producer:
             from celestia_tpu import integrity
 
@@ -217,6 +241,9 @@ class ScenarioWorld:
             from celestia_tpu import integrity
 
             integrity.configure("off")
+        if self._store_tmp is not None:
+            self._store_tmp.cleanup()
+            self._store_tmp = None
 
     def quiesce(self, timeout: float = 3.0) -> None:
         """Let in-flight serving settle before the teardown verdict."""
@@ -328,9 +355,102 @@ class ScenarioWorld:
             if not self.scenario.sdc_producer:
                 self.node.grow()
                 self.produced["blocks"] += 1
+                self._soak_housekeeping(h)
                 return h
             # lint: allow(C002,C003) reason=the scenario world serializes block production on purpose (one producer thread, chaos harness not serving stack); the same design is waived at the direct device_put_chunked site below
             return self._produce_block_device(h)
+
+    # -- soak housekeeping (store churn + identity anchors) ------------- #
+
+    @property
+    def soak_lag(self) -> int:
+        """The byte-identity re-verification distance, scaled with
+        --duration-scale so shorter CI runs still cross it (floor 10:
+        a lag of zero would make the invariant vacuous)."""
+        lag = self.scenario.soak_sample_lag
+        lag = max(10, round(lag * min(1.0, self.duration_scale)))
+        # lint: allow(C005) reason=written once by the single producer thread (under _produce_lock) and only ever shrinks the lag; a one-read-stale None just means one more anchor at the configured lag
+        if self._soak_lag_cap is not None:
+            # compaction froze a retention window smaller than the
+            # configured lag — an anchor must age within what the store
+            # actually retains, or every anchor is evicted unverified
+            lag = max(10, min(lag, self._soak_lag_cap))
+        return lag
+
+    def _soak_housekeeping(self, h: int) -> None:
+        """Per-produced-block soak chores (store mode only): prune the
+        in-memory block map to the retention window (long chains must
+        not hold RSS hostage — serving older heights falls through to
+        CRC-verified store page reads), compact the store against its
+        byte budget every N blocks, and anchor a served sample every
+        ~lag/8 heights for the soak_byte_identity re-verification."""
+        sc = self.scenario
+        if not sc.store or self.node.store is None:
+            return
+        if sc.retain_heights:
+            cutoff = h - sc.retain_heights
+            for old in [x for x in self.node.blocks if x <= cutoff]:
+                self.node.blocks.pop(old, None)
+                if self.node._eds_cache is not None:
+                    try:
+                        self.node._eds_cache.invalidate(old)
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
+        if sc.store_compact_budget_bytes and \
+                h % max(1, sc.store_compact_every) == 0:
+            # Scale the byte budget with --duration-scale: a shortened
+            # CI run writes proportionally fewer bytes, and an unscaled
+            # budget would never fill — compaction would never fire and
+            # store_bytes would read as monotone drift.
+            budget = max(2 << 20,
+                         round(sc.store_compact_budget_bytes
+                               * min(1.0, self.duration_scale)))
+            # The fill rate itself is NOT scale-free (jit warmup eats a
+            # fixed slice of short runs), so even a scaled budget may be
+            # out of reach before the drift probe's 25% warmup window
+            # closes. Once ~20% of the planned wall has elapsed, freeze
+            # whatever the store filled to as a cap: compaction holds
+            # that level for the rest of the run — a steady state the
+            # run is guaranteed to reach, at any --duration-scale.
+            if self._soak_budget_cap is None and self._soak_t0 is not None:
+                planned = sum(p.duration_s for p in sc.phases) \
+                    * min(1.0, self.duration_scale)
+                if time.monotonic() - self._soak_t0 >= 0.2 * planned:
+                    stats = self.node.store.stats()
+                    self._soak_budget_cap = max(2 << 20,
+                                                int(stats["bytes"]))
+                    # the frozen byte level also bounds retention in
+                    # heights: shrink the identity-anchor lag to age
+                    # inside it (half, for compaction-cadence margin)
+                    self._soak_lag_cap = max(10,
+                                             int(stats["heights"]) // 2)
+            if self._soak_budget_cap is not None:
+                budget = min(budget, self._soak_budget_cap)
+            self.node.store.compact(budget)
+        if sc.soak_sample_lag and self.url is not None:
+            every = max(5, self.soak_lag // 8)
+            if h % every == 0:
+                self._anchor_sample(h)
+
+    def _anchor_sample(self, h: int) -> None:
+        """Record one served sample body at height h; the
+        soak_byte_identity probe re-fetches it once the chain is
+        soak_lag heights past h and demands byte equality + a fresh
+        NMT verification."""
+        w = 2 * self.scenario.k
+        i, j = (h * 3) % w, (h * 7) % w
+        try:
+            status, body = _fetch(self.url, f"/sample/{h}/{i}/{j}",
+                                  timeout=3.0)
+        except Exception:  # noqa: BLE001 — anchor under load: retry later
+            return
+        if status != 200:
+            return
+        dah = self.node.block_dah(h)
+        self.soak_anchors.append({
+            "height": h, "i": i, "j": j, "body": body,
+            "dah_hash": dah.hash().hex() if dah is not None else None,
+        })
 
     def _produce_block_device(self, h: int) -> int:
         """The audited device production path (ADR-015 flow): host
@@ -402,6 +522,7 @@ class ScenarioWorld:
                     "das": self._das_client,
                     "pfb": self._pfb_client,
                     "follower_sync": self._follower_sync,
+                    "open_das": self._open_das_client,
                 }[spec.kind]
                 t = threading.Thread(
                     target=target,
@@ -431,7 +552,12 @@ class ScenarioWorld:
                        404: "not_found"}.get(status, "error")
                 if status == 200:
                     dah = self.node.block_dah(h)
-                    if dah is None or not _verify_sample(
+                    if dah is None:
+                        # evicted between the sample fetch and the DAH
+                        # lookup (store compaction) — a pruning race,
+                        # not a failed proof
+                        key = "not_found"
+                    elif not _verify_sample(
                             dah, self.scenario.k, i, j, body):
                         key = "verify_fail"
                 with self._stats_lock:
@@ -440,6 +566,65 @@ class ScenarioWorld:
                 with self._stats_lock:
                     self.das_stats["error"] += 1
             self._pace(spec, stop)
+
+    def _open_das_client(self, spec: LoadSpec, seed: int,
+                         stop: threading.Event) -> None:
+        """One open-loop arrival process: Poisson inter-arrivals at
+        spec.rate_hz scheduled on an ABSOLUTE clock, Zipf height
+        popularity (newest = most popular, skew from the traffic
+        profile), latency measured from the INTENDED send time. A slow
+        server makes this serial client fall behind its schedule; it
+        then issues the overdue arrivals back-to-back and each one's
+        latency carries the backlog — queue buildup is charged to the
+        server, never silently absorbed (no coordinated omission)."""
+        rng = np.random.default_rng(seed)
+        prof = txsim.profile(spec.profile or "mixed-namespaces")
+        w = 2 * self.scenario.k
+        rate = float(spec.rate_hz)
+        next_t = time.monotonic() + float(rng.exponential(1.0 / rate))
+        pending: collections.deque[float] = collections.deque()
+        while not stop.is_set():
+            now = time.monotonic()
+            # arrivals are OFFERED the moment their schedule point
+            # passes — not when the serial client gets around to
+            # issuing them. A saturated server therefore sees offered
+            # keep tracking the schedule while done falls behind; the
+            # goodput ratio exposes the collapse instead of the meter
+            # quietly throttling offered down to the service rate.
+            while next_t <= now:
+                pending.append(next_t)
+                next_t += float(rng.exponential(1.0 / rate))
+                self.openload.note_offered()
+                self.registry.incr_counter("openload_offered_total")
+            if not pending:
+                if stop.wait(min(next_t - now, 0.05)):
+                    break
+                continue
+            intended = pending.popleft()
+            head = max(1, self.node.latest_height())
+            # Zipf(ns_skew) rank over heights, newest first, wrapped
+            # into the served range — the mixed-namespaces popularity
+            # shape applied to the height axis. Under a retention
+            # policy the client follows the advertised window: asking
+            # for heights the node has documented as pruned would
+            # record honest 404s as goodput loss and fake a knee.
+            window = head
+            if self.scenario.retain_heights:
+                window = min(window, self.scenario.retain_heights)
+            rank = int(rng.zipf(max(1.01, prof.ns_skew)))
+            h = head - ((rank - 1) % window)
+            i, j = int(rng.integers(0, w)), int(rng.integers(0, w))
+            ok = False
+            try:
+                status, _body = _fetch(self.url, f"/sample/{h}/{i}/{j}")
+                ok = status == 200
+            except Exception:  # noqa: BLE001 — transport failure = miss
+                pass
+            latency = time.monotonic() - intended
+            self.registry.incr_counter(
+                "openload_ok_total" if ok else "openload_miss_total")
+            self.registry.observe("openload_latency", latency)
+            self.openload.note(latency, ok)
 
     def _pfb_client(self, spec: LoadSpec, seed: int,
                     stop: threading.Event) -> None:

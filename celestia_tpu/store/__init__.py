@@ -173,9 +173,14 @@ class BlockStore:
     math run UNLOCKED: records are immutable once renamed into place,
     so readers only need the entry snapshot."""
 
-    def __init__(self, root: str | os.PathLike):
+    def __init__(self, root: str | os.PathLike, *, durable: bool = True):
         self.root = pathlib.Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
+        # durable=False skips the per-put fsync (atomic tmp+rename is
+        # kept, so a torn write still can't surface): soak/CI harnesses
+        # producing thousands of heights are fsync-bound otherwise.
+        # Production nodes never pass this.
+        self.durable = bool(durable)
         self._index_lock = threading.Lock()
         self._index: dict[int, StoreEntry] = {}
         self._skipped: dict[str, int] = {}
@@ -240,7 +245,8 @@ class BlockStore:
                     f.write(_RECORD.pack(len(payload), crc, 0))
                     f.write(payload.ljust(page_slot, b"\x00"))
                 f.flush()
-                os.fsync(f.fileno())
+                if self.durable:
+                    os.fsync(f.fileno())
             os.replace(tmp, path)
         except Exception:
             with self._index_lock:

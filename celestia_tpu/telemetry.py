@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import bisect
 import collections
+import os
 import threading
 import time
 
@@ -36,6 +37,11 @@ DEFAULT_BUCKETS = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
+
+try:
+    _PAGE_SIZE = os.sysconf("SC_PAGE_SIZE")
+except (ValueError, OSError, AttributeError):
+    _PAGE_SIZE = 4096
 
 
 class Histogram:
@@ -294,3 +300,31 @@ def _fmt_bound(bound: float) -> str:
 
 # process-global registry (the SDK telemetry singleton analogue)
 metrics = Registry()
+
+
+def refresh_process_gauges(registry: Registry | None = None) -> None:
+    """Refresh the host-resource gauges from /proc/self — the drift
+    detector's inputs (`process_rss_bytes`, `process_open_fds`,
+    `process_threads`). Called by the /metrics route (node/rpc.py) and
+    the tsdb scraper hook right before each render, never on a timer:
+    nobody scraping = zero cycles spent. Non-Linux hosts (no procfs)
+    read all three as 0 rather than raising."""
+    reg = registry if registry is not None else metrics
+    rss = 0.0
+    threads = 0.0
+    fds = 0.0
+    try:
+        with open("/proc/self/statm") as f:
+            # field 1 = resident pages
+            rss = float(f.read().split()[1]) * _PAGE_SIZE
+        with open("/proc/self/stat") as f:
+            # field 20 (1-based), counted after the parenthesized comm
+            # which may itself contain spaces
+            stat = f.read()
+            threads = float(stat.rsplit(")", 1)[1].split()[17])
+        fds = float(len(os.listdir("/proc/self/fd")))
+    except OSError:
+        pass  # non-Linux: graceful zeros
+    reg.set_gauge("process_rss_bytes", rss)
+    reg.set_gauge("process_threads", threads)
+    reg.set_gauge("process_open_fds", fds)

@@ -124,7 +124,8 @@ class RpcChaosNode(ChaosNode):
                  chain_id: str = "chaos-net",
                  paged_budget_bytes: int | None = None,
                  rows_per_page: int = 8,
-                 store_dir=None):
+                 store_dir=None,
+                 store_durable: bool = True):
         # durable store first (ADR-021): a restart is modelled as a
         # NEW instance with heights=0 over the same store_dir — the
         # re-index adopts every persisted height, and the serve path
@@ -134,7 +135,7 @@ class RpcChaosNode(ChaosNode):
         if store_dir is not None:
             from celestia_tpu.store import BlockStore
 
-            self.store = BlockStore(store_dir)
+            self.store = BlockStore(store_dir, durable=store_durable)
             self.store.reindex()
         # paged mode next: grow() in super().__init__ feeds the cache
         self._eds_cache = None

@@ -121,12 +121,25 @@ TRACKED: dict[str, list[tuple[str | None, str]]] = {
     # through every ready process during the same phase. HIGHER is
     # better: a collapse means the fan-out grow path stopped scaling.
     "fleet_blocks_per_sec": [],
+    # longitudinal soak (specs/observability.md §Longitudinal
+    # telemetry): count of drift-judged series the Theil–Sen detector
+    # flagged in a soak run. Folded from soak_ledger.json; the healthy
+    # trajectory is all zeros, so a drifting run regresses against the
+    # all-zero baseline exactly like scenario_slo_pass.
+    "soak_drift_breaches": [],
+    # open-loop sweep knee: the last sustainable offered rate of the
+    # das-sweep load curve (samples/s at the knee, or the top measured
+    # step when the knee was not reached). HIGHER is better — a falling
+    # knee means the serving path lost headroom. Folded from
+    # soak_ledger.json runs that carry a knee.
+    "soak_knee_samples_per_sec": [],
 }
 
 # throughput series: the regression direction is inverted — the gate
 # trips when the newest point FALLS below the baseline beyond
 # threshold+band. Everything else in TRACKED is a wall (lower-better).
-HIGHER_IS_BETTER = {"multichip_blocks_per_sec", "fleet_blocks_per_sec"}
+HIGHER_IS_BETTER = {"multichip_blocks_per_sec", "fleet_blocks_per_sec",
+                    "soak_knee_samples_per_sec"}
 
 DEFAULT_THRESHOLD = 1.5  # newest/baseline ratio that counts as regression
 DEFAULT_MIN_HISTORY = 3  # points before a metric gates
@@ -329,6 +342,29 @@ def load_ledger(root: str) -> dict[str, list[tuple[str, float]]]:
                     name = run.get("scenario", "?")
                     ledger["scenario_slo_pass"].append(
                         (f"scenario_ledger.json#{idx}:{name}", float(v)))
+    # soak ledger (`python -m celestia_tpu.scenarios soak
+    # --soak-ledger`): each run contributes its drift-breach count and,
+    # when the run carried a load sweep, the knee rate
+    soak_path = os.path.join(root, "soak_ledger.json")
+    if os.path.exists(soak_path):
+        try:
+            with open(soak_path) as f:
+                soak = json.load(f)
+        except (OSError, ValueError):
+            soak = None
+        if isinstance(soak, dict):
+            for idx, run in enumerate(soak.get("runs") or []):
+                if not isinstance(run, dict):
+                    continue
+                name = run.get("scenario", "?")
+                d = run.get("drift_breaches")
+                if isinstance(d, (int, float)):
+                    ledger["soak_drift_breaches"].append(
+                        (f"soak_ledger.json#{idx}:{name}", float(d)))
+                k = run.get("knee_samples_per_sec")
+                if isinstance(k, (int, float)):
+                    ledger["soak_knee_samples_per_sec"].append(
+                        (f"soak_ledger.json#{idx}:{name}", float(k)))
     return ledger
 
 

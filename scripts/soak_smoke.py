@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Longitudinal-telemetry smoke gate (`make soak-smoke`).
+
+Exercises the durable recording plane end-to-end against a LIVE node
+(specs/observability.md §Longitudinal telemetry) in under two minutes,
+crypto-free — the RpcChaosNode facade behind the real node/rpc.py
+handler, numpy-only. Fails (non-zero exit) unless:
+
+  1. the `.ctts` scraper records a growing chain over the real
+     /metrics wire at a sub-second cadence (samples + series counted),
+  2. a mid-recording node KILL + RESTART over the same store is
+     absorbed: the counter-reset rebase keeps every cumulative series
+     monotone in the recording, and the reset is counted — a fleet
+     respawn must never read as a negative rate,
+  3. the Theil–Sen drift verdict flags a synthetic monotone leak gauge
+     as DRIFTING while the flat control gauge stays clean — both
+     judged from the durable file, not live state,
+  4. flipping one byte of a complete frame makes `tsdb.read` refuse
+     the file with IntegrityError (rotted bytes are never analyzed),
+  5. the obs_report renderer produces a sparkline dashboard and its
+     machine report round-trips through JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def gate(ok: bool, what: str) -> None:
+    print(("PASS " if ok else "FAIL ") + what)
+    if not ok:
+        raise SystemExit(f"soak-smoke: {what}")
+
+
+def main() -> int:
+    t_start = time.monotonic()
+    from celestia_tpu import telemetry
+    from celestia_tpu.node.rpc import RpcServer
+    from celestia_tpu.testutil.chaosnet import RpcChaosNode
+    from celestia_tpu.tools import obs_report, tsdb
+
+    store_tmp = tempfile.TemporaryDirectory(prefix="soak-smoke-store-")
+    rec_tmp = tempfile.TemporaryDirectory(prefix="soak-smoke-rec-")
+    path = os.path.join(rec_tmp.name, "smoke.ctts")
+
+    telemetry.metrics.reset()
+    node = RpcChaosNode(k=2, seed=11, store_dir=store_tmp.name,
+                        store_durable=False)
+    server = RpcServer(node, port=0)
+    server.start()
+    state = {"base": f"http://127.0.0.1:{server.port}"}
+
+    # callable URL: the scraper follows the respawned server's new port
+    scraper = tsdb.Scraper(lambda: state["base"] + "/metrics", path,
+                           cadence_s=0.05, meta={"scenario": "soak-smoke"})
+
+    # synthetic leak vs flat control, both judged later from the file
+    leak_stop = threading.Event()
+
+    def _leak():
+        total = 0.0
+        while not leak_stop.is_set():
+            total += 1_048_576.0
+            telemetry.metrics.set_gauge("soak_leak_bytes", total)
+            telemetry.metrics.set_gauge("soak_flat_bytes", 7.0)
+            leak_stop.wait(0.02)
+
+    leak_thread = threading.Thread(target=_leak, daemon=True)
+    leak_thread.start()
+    scraper.start()
+
+    try:
+        for _ in range(60):
+            node.grow()
+            time.sleep(0.005)
+        gate(scraper.scrapes >= 5,
+             f"live /metrics recording under way "
+             f"({scraper.scrapes} scrapes)")
+
+        # -- kill + restart over the same store, mid-recording ---------- #
+        server.stop()
+        telemetry.metrics.reset()  # a real process death zeroes counters
+        node = RpcChaosNode(k=2, seed=11, store_dir=store_tmp.name,
+                            store_durable=False)
+        server = RpcServer(node, port=0)
+        server.start()
+        state["base"] = f"http://127.0.0.1:{server.port}"
+        for _ in range(60):
+            node.grow()
+            time.sleep(0.005)
+        time.sleep(0.2)  # a few post-restart scrapes
+    finally:
+        leak_stop.set()
+        leak_thread.join(timeout=2.0)
+        scraper.stop(final_scrape=True)
+        server.stop()
+
+    resets = sum(scraper.reset_counts.values())
+    gate(resets >= 1,
+         f"restart detected as counter reset ({resets} series rebased)")
+
+    rec = tsdb.read(path)
+    gate(len(rec.samples) >= 8 and len(rec.names) >= 5,
+         f"durable recording read back ({len(rec.samples)} samples / "
+         f"{len(rec.names)} series)")
+
+    # the rebase guarantee: every cumulative series stays monotone in
+    # the recording even though the raw counters went back to zero
+    dipped = []
+    for key in rec.names:
+        fam = key.split("{", 1)[0]
+        if rec.types.get(key) not in ("counter", "histogram"):
+            continue
+        pts = [v for _, v in rec.series(key)]
+        if any(b < a - 1e-9 for a, b in zip(pts, pts[1:])):
+            dipped.append(fam)
+    gate(not dipped,
+         f"all cumulative series monotone across the restart "
+         f"(checked {len(rec.names)} series)")
+    gate(sum(rec.resets.values()) >= 1,
+         "reset markers survived the round-trip to disk")
+
+    verdicts = {d["series"]: d for d in tsdb.analyze_drift(
+        rec, ("soak_leak_bytes", "soak_flat_bytes"))}
+    gate(verdicts["soak_leak_bytes"].get("drifting") is True,
+         f"drift verdict flags the synthetic leak "
+         f"(rel_growth={verdicts['soak_leak_bytes'].get('rel_growth')})")
+    gate(verdicts["soak_flat_bytes"].get("drifting") is False,
+         "drift verdict clears the flat control gauge")
+
+    # -- integrity: one flipped byte must make the reader refuse ------- #
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0x40
+    broken = os.path.join(rec_tmp.name, "broken.ctts")
+    with open(broken, "wb") as f:
+        f.write(bytes(blob))
+    try:
+        tsdb.read(broken)
+        gate(False, "flipped byte refused")
+    except tsdb.IntegrityError as e:
+        gate(True, f"flipped byte refused with IntegrityError ({e})")
+
+    # -- the renderer over the same file -------------------------------- #
+    report = obs_report.build_report(
+        rec, ("process_rss_bytes", "soak_*"), ("soak_leak_bytes",))
+    text = obs_report.render_text(report)
+    gate(any(r["series"] == "soak_leak_bytes" and r["spark"]
+             for r in report["rows"]) and "DRIFTING" in text,
+         "obs_report renders sparklines + drift verdict")
+    json.loads(json.dumps(report))  # machine report must round-trip
+    gate(True, "obs_report machine report round-trips through JSON")
+
+    store_tmp.cleanup()
+    rec_tmp.cleanup()
+    wall = time.monotonic() - t_start
+    gate(wall < 120, f"soak-smoke finished in {wall:.1f}s (< 120s)")
+    print("soak-smoke: all gates passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

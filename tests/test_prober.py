@@ -170,3 +170,42 @@ class TestProberThread:
             prober.stop()
         assert r.get_counter("probe_cycle_total") >= 2.0
         assert prober._thread is None  # stop() joins and clears
+
+
+class TestAbsoluteClockCadence:
+    """The prober fires on an absolute-clock grid: a slow cycle must
+    not stretch the interval (self-coordinated omission) — it overruns
+    its slot, the overrun is counted, and the cadence recovers."""
+
+    def test_slow_cycles_count_overruns(self, served):
+        import time as time_mod
+
+        _node, base = served
+        r = Registry()
+        prober = new_prober(base, r, interval=0.02, samples_per_cycle=1)
+        real_cycle = prober.probe_cycle
+
+        def slow_cycle():
+            time_mod.sleep(0.06)  # 3x the interval: every slot overruns
+            return real_cycle()
+
+        prober.probe_cycle = slow_cycle
+        prober.start()
+        time_mod.sleep(0.3)
+        prober.stop()
+        cycles = r.get_counter("probe_cycle_ok_total")
+        overruns = r.get_counter("probe_overrun_total")
+        assert cycles >= 2
+        assert overruns >= cycles - 1  # every completed slow slot counted
+
+    def test_fast_cycles_do_not_overrun(self, served):
+        import time as time_mod
+
+        _node, base = served
+        r = Registry()
+        prober = new_prober(base, r, interval=0.05, samples_per_cycle=1)
+        prober.start()
+        time_mod.sleep(0.3)
+        prober.stop()
+        assert r.get_counter("probe_cycle_ok_total") >= 3
+        assert r.get_counter("probe_overrun_total") == 0.0

@@ -371,7 +371,8 @@ class TestLibrary:
         assert set(SCENARIOS) == {"pfb-storm", "rolling-outage",
                                   "sdc-under-storm", "rejoin-under-load",
                                   "smoke", "gateway-fleet",
-                                  "scale-out-under-load"}
+                                  "scale-out-under-load", "soak",
+                                  "das-sweep"}
 
     @pytest.mark.parametrize("name", sorted(SCENARIOS))
     def test_constructs_and_name_matches(self, name):
@@ -405,3 +406,221 @@ class TestSmokeScenarioEndToEnd:
         assert r2["scenario_slo_pass"], r2["verdict"]
         assert r1["fault_timeline"] == r2["fault_timeline"]
         assert len(r1["fault_timeline"]) > 0
+
+
+# --------------------------------------------------------------------- #
+# open-loop load plane (scenarios/openload.py + the open_das driver)
+
+
+class TestOpenLoadMeter:
+    def test_offered_counts_at_schedule_not_completion(self):
+        from celestia_tpu.scenarios.openload import OpenLoadMeter
+
+        m = OpenLoadMeter()
+        m.begin_phase("p", planned_hz=10.0, now=0.0)
+        for _ in range(10):
+            m.note_offered()  # ten arrivals were DUE
+        for lat in (0.1, 0.2, 0.3):
+            m.note(lat, ok=True)  # only three ever completed
+        m.end(now=1.0)
+        (step,) = m.curve()
+        assert step["offered"] == 10 and step["done"] == 3
+        assert step["offered_hz"] == 10.0
+        assert step["goodput_hz"] == 3.0  # the backlog is visible
+
+    def test_curve_sorted_by_planned_rate_and_empty_phases_dropped(self):
+        from celestia_tpu.scenarios.openload import OpenLoadMeter
+
+        m = OpenLoadMeter()
+        m.begin_phase("big", 100.0, now=0.0)
+        m.note_offered()
+        m.note(0.01, ok=True)
+        m.begin_phase("idle", 0.0, now=1.0)  # no arrivals: dropped
+        m.begin_phase("small", 10.0, now=2.0)
+        m.note_offered()
+        m.note(0.02, ok=True)
+        m.end(now=3.0)
+        steps = m.curve()
+        assert [s["phase"] for s in steps] == ["small", "big"]
+        assert [s["planned_hz"] for s in steps] == [10.0, 100.0]
+
+
+class TestKneeDetection:
+    def _step(self, hz, goodput=None, p99=0.01):
+        return {"phase": f"s{hz}", "planned_hz": float(hz),
+                "offered_hz": float(hz),
+                "goodput_hz": float(goodput if goodput is not None else hz),
+                "p99_s": p99}
+
+    def test_healthy_sweep_reports_top_step(self):
+        from celestia_tpu.scenarios.openload import detect_knee
+
+        steps = [self._step(hz) for hz in (10, 50, 100)]
+        knee = detect_knee(steps)
+        assert knee["found"] is False
+        assert knee["knee_hz"] == 100.0
+
+    def test_goodput_collapse_puts_knee_before_it(self):
+        from celestia_tpu.scenarios.openload import detect_knee
+
+        steps = [self._step(10), self._step(50),
+                 self._step(100, goodput=60.0)]
+        knee = detect_knee(steps)
+        assert knee["found"] is True
+        assert knee["knee_index"] == 1 and knee["knee_hz"] == 50.0
+        assert knee["degraded_index"] == 2
+
+    def test_p99_blowup_also_degrades(self):
+        from celestia_tpu.scenarios.openload import detect_knee
+
+        steps = [self._step(10, p99=0.01), self._step(50, p99=0.02),
+                 self._step(100, p99=0.5)]
+        knee = detect_knee(steps)
+        assert knee["found"] is True and knee["knee_index"] == 1
+
+    def test_degraded_first_step_and_empty(self):
+        from celestia_tpu.scenarios.openload import detect_knee
+
+        assert detect_knee([])["found"] is False
+        knee = detect_knee([self._step(10, goodput=1.0)])
+        assert knee["found"] is True and knee["knee_index"] == 0
+
+
+class TestOpenDasIntendedBasis:
+    def test_slow_server_charges_backlog_to_latency(self, monkeypatch):
+        """The coordinated-omission fix, demonstrated: a server that
+        takes 40 ms per reply against a 100 Hz arrival schedule. A
+        closed-loop basis would record ~40 ms flat; the intended-basis
+        histogram must show the backlog growing far past it, and
+        offered must stay on the schedule while done falls behind."""
+        import threading as threading_mod
+        import time as time_mod
+
+        from celestia_tpu.scenarios import world as world_mod
+
+        sc = Scenario(
+            name="openload-unit", description="d", k=2,
+            initial_heights=5,
+            phases=(Phase(name="p", duration_s=1.0,
+                          loads=(LoadSpec(kind="open_das", clients=1,
+                                          rate_hz=100.0),)),),
+        )
+        w = world_mod.ScenarioWorld(sc, seed=3, registry=Registry())
+        w.url = "http://unused.invalid"
+
+        def slow_fetch(_base, _path, timeout=5.0):
+            time_mod.sleep(0.04)
+            return 200, b""
+
+        monkeypatch.setattr(world_mod, "_fetch", slow_fetch)
+        w.openload.begin_phase("p", 100.0, now=time_mod.monotonic())
+        stop = threading_mod.Event()
+        t = threading_mod.Thread(
+            target=w._open_das_client,
+            args=(sc.phases[0].loads[0], 7, stop), daemon=True)
+        t.start()
+        time_mod.sleep(0.6)
+        stop.set()
+        t.join(timeout=2.0)
+        w.openload.end(now=time_mod.monotonic())
+        (step,) = w.openload.curve()
+        # offered tracks the Poisson schedule (~100 Hz), done is
+        # bounded by the serial 40 ms server (~25 Hz)
+        assert step["offered"] > 2 * step["done"]
+        assert step["done"] >= 5
+        # intended-basis p90 carries the queue buildup: far above the
+        # 40 ms a closed-loop client would have recorded
+        assert step["p90_s"] > 0.12
+        assert w.node is not None  # world never started: no cleanup due
+
+
+# --------------------------------------------------------------------- #
+# soak spec validation + ledger fold
+
+
+class TestSoakSpec:
+    def _base(self, **kw):
+        kw.setdefault("name", "s")
+        kw.setdefault("description", "d")
+        kw.setdefault("phases", (Phase(name="p", duration_s=0.1),))
+        return kw
+
+    def test_open_das_requires_rate(self):
+        with pytest.raises(ValueError, match="rate_hz"):
+            LoadSpec(kind="open_das", clients=1)
+
+    def test_store_churn_requires_store(self):
+        with pytest.raises(ValueError, match="store"):
+            Scenario(**self._base(store_compact_budget_bytes=1 << 20))
+        with pytest.raises(ValueError, match="store"):
+            Scenario(**self._base(retain_heights=10))
+
+    def test_byte_identity_requires_store_and_lag(self):
+        with pytest.raises(ValueError, match="soak_byte_identity"):
+            Scenario(**self._base(invariants=("soak_byte_identity",)))
+
+    def test_drift_invariant_requires_series_and_recording(self):
+        with pytest.raises(ValueError, match="no_monotone_drift"):
+            Scenario(**self._base(invariants=("no_monotone_drift",)))
+
+    def test_store_excluded_from_fleet_modes(self):
+        with pytest.raises(ValueError, match="store"):
+            Scenario(**self._base(store=True, fleet=3))
+
+    def test_soak_scenario_constructs(self):
+        sc = library.get("soak")
+        assert sc.store and sc.soak_sample_lag > 0
+        assert sc.record_cadence_s > 0 and sc.drift_series
+        assert "no_monotone_drift" in sc.invariants
+        assert "soak_byte_identity" in sc.invariants
+        assert any(ls.kind == "open_das"
+                   for ph in sc.phases for ls in ph.loads)
+
+    def test_sweep_scenario_constructs(self):
+        sc = library.get("das-sweep")
+        rates = [ls.rate_hz for ph in sc.phases for ls in ph.loads
+                 if ls.kind == "open_das"]
+        assert rates == sorted(rates) and len(rates) >= 3
+
+
+class TestSoakLedger:
+    def _report(self, drift=0, knee_hz=None):
+        rep = {"scenario": "soak", "seed": 1, "scenario_slo_pass": True,
+               "breaches": 0, "wall_s": 10.0,
+               "drift": [{"series": f"s{i}", "drifting": i < drift}
+                         for i in range(4)]}
+        if knee_hz is not None:
+            rep["load_curve"] = {"steps": [],
+                                 "knee": {"found": False,
+                                          "knee_hz": knee_hz}}
+        return rep
+
+    def test_fold_and_perf_ledger_series(self, tmp_path):
+        from celestia_tpu.scenarios.engine import append_soak_ledger
+        from celestia_tpu.tools import perf_ledger
+
+        path = str(tmp_path / "soak_ledger.json")
+        for drift, knee in ((0, 200.0), (0, 210.0), (0, 190.0),
+                            (2, 50.0)):
+            append_soak_ledger(path, self._report(drift=drift,
+                                                  knee_hz=knee))
+        doc = json.loads(open(path).read())
+        assert len(doc["runs"]) == 4
+        assert doc["runs"][-1]["drift_breaches"] == 2
+
+        led = perf_ledger.load_ledger(str(tmp_path))
+        drifts = [v for _l, v in led["soak_drift_breaches"]]
+        knees = [v for _l, v in led["soak_knee_samples_per_sec"]]
+        assert drifts == [0.0, 0.0, 0.0, 2.0]
+        assert knees == [200.0, 210.0, 190.0, 50.0]
+        # a drifting run regresses against the all-zero baseline
+        j = perf_ledger.judge(led["soak_drift_breaches"],
+                              perf_ledger.DEFAULT_THRESHOLD,
+                              perf_ledger.DEFAULT_MIN_HISTORY)
+        assert j["regressed"]
+        # the knee collapse trips the higher-is-better gate
+        j = perf_ledger.judge(led["soak_knee_samples_per_sec"],
+                              perf_ledger.DEFAULT_THRESHOLD,
+                              perf_ledger.DEFAULT_MIN_HISTORY,
+                              higher_is_better=True)
+        assert j["regressed"]

@@ -218,3 +218,68 @@ class TestConcurrentHammer:
         # after the barrier the newest render may predate the last
         # writes; a fresh render must show the exact totals
         assert f"hammer_ops_total {float(total)}" in r.prometheus_text()
+
+
+class TestProcessGauges:
+    """Host-resource gauges (specs/observability.md): RSS, open fds and
+    thread count read from /proc/self at refresh time — pull-refreshed
+    on /metrics render, graceful zeros where procfs is absent."""
+
+    def test_refresh_sets_all_three(self):
+        from celestia_tpu.telemetry import refresh_process_gauges
+
+        r = Registry()
+        refresh_process_gauges(r)
+        rss = r.get_gauge("process_rss_bytes")
+        fds = r.get_gauge("process_open_fds")
+        threads = r.get_gauge("process_threads")
+        assert rss is not None and fds is not None and threads is not None
+        if sys.platform.startswith("linux"):
+            # a live CPython process holds megabytes, several fds and
+            # at least one thread
+            assert rss > 1 << 20
+            assert fds >= 3
+            assert threads >= 1
+        else:  # non-Linux: graceful zero, never an exception
+            assert rss == 0.0 and fds == 0.0 and threads == 0.0
+
+    def test_non_linux_graceful_zero(self, monkeypatch):
+        import celestia_tpu.telemetry as tel
+
+        real_open = open
+
+        def _no_procfs(path, *a, **kw):
+            if str(path).startswith("/proc/"):
+                raise OSError("no procfs here")
+            return real_open(path, *a, **kw)
+
+        monkeypatch.setattr("builtins.open", _no_procfs)
+        monkeypatch.setattr(
+            tel.os, "listdir",
+            lambda p: (_ for _ in ()).throw(OSError("no procfs")))
+        r = Registry()
+        tel.refresh_process_gauges(r)
+        assert r.get_gauge("process_rss_bytes") == 0.0
+        assert r.get_gauge("process_open_fds") == 0.0
+        assert r.get_gauge("process_threads") == 0.0
+
+    def test_metrics_route_renders_fresh_gauges(self):
+        """/metrics must carry the gauges without anyone calling
+        refresh explicitly — the route pull-refreshes."""
+        import urllib.request
+
+        from celestia_tpu.node.rpc import RpcServer
+        from celestia_tpu.testutil.chaosnet import RpcChaosNode
+
+        node = RpcChaosNode(k=2, seed=3)
+        server = RpcServer(node, port=0)
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/metrics"
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                text = resp.read().decode()
+        finally:
+            server.stop()
+        assert "process_rss_bytes" in text
+        assert "process_open_fds" in text
+        assert "process_threads" in text
