@@ -12,7 +12,7 @@ PY ?= python
 	scenario-gateway-fleet scenario-scale-out-under-load scenarios \
 	soak-smoke scenario-soak scenario-das-sweep \
 	kernel-smoke bench-fused analyze san multichip-smoke multichip-bench \
-	xor-smoke bench-xor
+	xor-smoke bench-xor devledger-smoke
 
 # Static analysis gate (specs/analysis.md, ADR-020): AST-level
 # concurrency lint (lock ordering vs the specs/serving.md partial
@@ -128,6 +128,14 @@ obs-smoke:
 # CPU-only, crypto-free, seconds warm.
 soak-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/soak_smoke.py
+
+# Device runtime ledger smoke (ADR-025): compile/retrace watchdog
+# semantics (strict raise before the build, lru eviction is not a
+# retrace), the HBM owner attribution flip, busy-ratio sanity, and the
+# /debug/device route + device_ledger_* exposition over the real RPC
+# handler. Crypto-free, CPU jax, seconds.
+devledger-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/devledger_smoke.py
 
 # SDC defense drill (ADR-015): arm a seeded bitflip at every integrity
 # injection point (extend output, repair output, transfer chunk), prove

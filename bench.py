@@ -1263,6 +1263,14 @@ def _stamp_host(result) -> None:
     result.setdefault("cpus", _os.cpu_count())
     result.setdefault("n_devices", _safe(
         lambda: len(__import__("jax").devices())))
+    # full runtime provenance (ADR-025): jax/jaxlib versions, backend,
+    # device kind, and the ADR-011 host fingerprint — setdefault keeps
+    # replayed entries' original stamps
+    prov = _safe(lambda: __import__(
+        "celestia_tpu.devledger", fromlist=["runtime_provenance"]
+    ).runtime_provenance(), {}) or {}
+    for key, value in prov.items():
+        result.setdefault(key, value)
 
 
 def main():

@@ -65,7 +65,7 @@ import collections
 import threading
 import time
 
-from celestia_tpu import faults, tracing
+from celestia_tpu import devledger, faults, tracing
 from celestia_tpu.log import logger
 from celestia_tpu.telemetry import metrics
 
@@ -492,9 +492,15 @@ class DeviceDispatcher:
                     faults.fire("dispatch.run", label=lead.label)
                     faults.fire("dispatch.batch", label=lead.label,
                                 jobs=len(live))
-                    with tracing.stage("exec"):
-                        results = lead.batch_exec(
-                            [j.payload for j in live])
+                    _exec_t0 = time.perf_counter()
+                    try:
+                        with tracing.stage("exec"):
+                            results = lead.batch_exec(
+                                [j.payload for j in live])
+                    finally:
+                        # device-lane occupancy (ADR-025): errors burn
+                        # the lane too, so count them
+                        devledger.note_busy(time.perf_counter() - _exec_t0)
                     if results is None or len(results) != len(live):
                         raise RuntimeError(
                             f"batch_exec returned "
@@ -570,14 +576,20 @@ class DeviceDispatcher:
                               label=job.label, internal=job.internal):
                 try:
                     faults.fire("dispatch.run", label=job.label)
-                    with tracing.stage("exec"):
-                        if job.fn is not None:
-                            job.result = job.fn()
-                        else:
-                            # batchable job running unbatched
-                            # (max_batch=1): a singleton group through
-                            # the same exec callable
-                            job.result = job.batch_exec([job.payload])[0]
+                    _exec_t0 = time.perf_counter()
+                    try:
+                        with tracing.stage("exec"):
+                            if job.fn is not None:
+                                job.result = job.fn()
+                            else:
+                                # batchable job running unbatched
+                                # (max_batch=1): a singleton group
+                                # through the same exec callable
+                                job.result = job.batch_exec(
+                                    [job.payload])[0]
+                    finally:
+                        # device-lane occupancy (ADR-025)
+                        devledger.note_busy(time.perf_counter() - _exec_t0)
                 except BaseException as e:  # noqa: BLE001 — waiter re-raises
                     self._attribute_error(e, job.label, "dispatch.run")
                     job.error = e

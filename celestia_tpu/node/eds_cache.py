@@ -43,6 +43,19 @@ import itertools
 import threading
 
 
+def _register_ledger_owner(name: str, fn) -> None:
+    """Enroll a cache in the device runtime ledger (ADR-025). The
+    ledger holds bound methods weakly, so a collected cache drops out
+    on the next audit; the guard keeps this module importable if the
+    ledger is absent (stripped environments import eds_cache directly)."""
+    try:
+        from celestia_tpu import devledger
+
+        devledger.register_owner(name, fn)
+    except Exception:  # noqa: BLE001 — accounting never blocks the cache
+        pass
+
+
 class ResidentEdsCache:
     """Pin-guarded LRU of retained EDS handles (the 2-deep serving
     cache for device-resident squares)."""
@@ -53,6 +66,18 @@ class ResidentEdsCache:
             collections.OrderedDict()
         self._pins: collections.Counter[int] = collections.Counter()
         self._lock = threading.Lock()
+        _register_ledger_owner("eds_cache_resident", self.device_bytes)
+
+    def device_bytes(self) -> int:
+        """Device bytes of every retained square — the devledger owner
+        callback (ADR-025). Entries without a device buffer (host-only
+        or opaque values) contribute zero."""
+        with self._lock:
+            total = 0
+            for value in self._entries.values():
+                dev = getattr(value, "device_data", None)
+                total += int(getattr(dev, "nbytes", 0) or 0)
+            return total
 
     def get(self, height: int):
         """Unpinned lookup — for callers that only hand the value on
@@ -403,6 +428,7 @@ class PagedEdsCache:
         self._cond = threading.Condition()
         self._tick = itertools.count(1)
         self.stats_counters = collections.Counter()  # hits/misses/...
+        _register_ledger_owner("eds_cache_paged", self.device_bytes)
 
     # -- the ResidentEdsCache-compatible height surface ----------------- #
 
@@ -518,7 +544,12 @@ class PagedEdsCache:
                 None,
             )
             if victim is None:
-                return  # everything borrowed: defer until a pin drops
+                # everything borrowed: defer until a pin drops. break,
+                # not return — evictions already performed this call
+                # must still reach the gauges below (an early return
+                # left eds_cache_device_bytes stale until the next
+                # unrelated publish)
+                break
             del self._entries[victim]
             self._drop_pages_locked(victim)
         self._publish_locked()
@@ -623,6 +654,10 @@ class PagedEdsCache:
             if page.dev is not None:
                 page.pins += 1
                 self.stats_counters["page_hits"] += 1
+                # the pin bump must reach eds_cache_pin_count — the hit
+                # path used to skip publishing, leaving the gauge low
+                # until the next miss/demote
+                self._publish_locked()
                 self._count("eds_cache_page_hits_total")
                 return page.dev
             # demoted: this reader performs the fault-in; `busy` makes
@@ -795,6 +830,13 @@ class PagedEdsCache:
 
     def _device_bytes_locked(self) -> int:
         return sum(p.nbytes for p in self._pages if p.dev is not None)
+
+    def device_bytes(self) -> int:
+        """Current HBM footprint (resident pages only) — the devledger
+        owner callback, and the ground truth the ledger audit reconciles
+        `eds_cache_device_bytes` against."""
+        with self._cond:
+            return self._device_bytes_locked()
 
     def _count(self, name: str) -> None:
         try:

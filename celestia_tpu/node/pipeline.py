@@ -54,7 +54,7 @@ import time
 
 import numpy as np
 
-from celestia_tpu import faults, tracing
+from celestia_tpu import devledger, faults, tracing
 from celestia_tpu.node.dispatch import Shed
 from celestia_tpu.telemetry import metrics
 
@@ -93,8 +93,22 @@ class BlockPipeline:
         self._fed = 0
         self._retired = 0
         self._stage_wall = {"h2d": 0.0, "compute": 0.0, "d2h": 0.0}
+        devledger.register_owner("pipeline_inflight", self.device_bytes)
 
     # -- introspection -------------------------------------------------- #
+
+    def device_bytes(self) -> int:
+        """Device bytes referenced by in-flight records — the devledger
+        owner callback (ADR-025). The pipeline is single-threaded by
+        contract, but the audit runs from scrape threads, so walk a
+        snapshot of the deque (list() is atomic) rather than the live
+        one."""
+        def walk(x) -> int:
+            if isinstance(x, (tuple, list)):
+                return sum(walk(v) for v in x)
+            return int(getattr(x, "nbytes", 0) or 0)
+
+        return walk(list(self._inflight))
 
     @property
     def inflight(self) -> int:

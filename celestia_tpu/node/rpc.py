@@ -313,6 +313,11 @@ def _handler_for(node: Node, dispatcher: DeviceDispatcher | None = None,
                     # host-resource gauges are pull-refreshed: nobody
                     # scraping = zero cycles spent reading procfs
                     refresh_process_gauges(metrics)
+                    # same pull discipline for the device runtime
+                    # ledger: owner audit + busy ratio on scrape
+                    from celestia_tpu import devledger
+
+                    devledger.publish(metrics)
                     body = metrics.prometheus_text().encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -408,6 +413,13 @@ def _handler_for(node: Node, dispatcher: DeviceDispatcher | None = None,
                         "checks": checks,
                         "probe_last": prober.last if prober else None,
                     })
+                elif parts == ["debug", "device"]:
+                    # device runtime ledger (ADR-025): compile/retrace
+                    # watchdog state, the per-owner HBM audit, busy
+                    # ratio, and runtime provenance
+                    from celestia_tpu import devledger
+
+                    self._reply(devledger.debug_doc())
                 elif parts == ["genesis"]:
                     # the download-genesis source (ref: cmd/celestia-appd/
                     # cmd/download-genesis.go fetches a chain's genesis;

@@ -26,6 +26,8 @@ import functools
 import hashlib
 import threading
 
+from celestia_tpu import devledger
+
 
 def blob_key(data: bytes) -> bytes:
     """Identity of pooled blob BYTES (content-addressed, like the CAT
@@ -40,6 +42,7 @@ def _pad_len(n: int) -> int:
 
 
 @functools.lru_cache(maxsize=16)
+@devledger.instrument_builder("blob_pool.insert")
 def _jitted_insert(pad: int):
     import jax
     import jax.numpy as jnp
@@ -103,6 +106,19 @@ class DeviceBlobArena:
         # (donate_argnums), and a half flip would rewrite bytes at
         # offsets the proposal already snapshotted.
         self._lock = threading.RLock()
+        # HBM attribution (ADR-025): the arena is a fixed device
+        # allocation; registration is weak, so a dropped arena leaves
+        # the ledger on the next snapshot
+        devledger.register_owner("blob_arena", self.device_bytes)
+
+    def device_bytes(self) -> int:
+        """The arena's device footprint (fixed at construction) — the
+        devledger owner callback, which runs with NO ledger lock held,
+        so taking the arena lock here creates no cross-module edge."""
+        with self._lock:
+            arena = self._arena
+            return (int(getattr(arena, "nbytes", 0))
+                    if arena is not None else 0)
 
     @property
     def lock(self):

@@ -42,7 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from celestia_tpu import faults, integrity
+from celestia_tpu import devledger, faults, integrity
 from celestia_tpu import namespace as ns
 from celestia_tpu import tracing
 from celestia_tpu.appconsts import (
@@ -336,6 +336,7 @@ def extend_and_roots_only(shares: jnp.ndarray, m2: jnp.ndarray):
 
 
 @functools.lru_cache(maxsize=8)
+@devledger.instrument_builder("extend.for_k")
 def _jitted_for_k(k: int):
     m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
 
@@ -347,6 +348,7 @@ def _jitted_for_k(k: int):
 
 
 @functools.lru_cache(maxsize=8)
+@devledger.instrument_builder("extend.roots_for_k")
 def _jitted_roots_for_k(k: int):
     m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
 
@@ -417,7 +419,18 @@ def _mesh_if_divisible(n_rows: int):
     return m
 
 
+def _mesh_compile_key():
+    """The mesh component of the sharded builders' compile key: a mesh
+    flip retraces even at the same k (the compiled program bakes the
+    mesh in — set_active_mesh clears the jit caches for the same
+    reason)."""
+    m = _ACTIVE_MESH
+    return None if m is None else tuple(sorted(m.shape.items()))
+
+
 @functools.lru_cache(maxsize=8)
+@devledger.instrument_builder("extend.rowsharded",
+                              key_extra=_mesh_compile_key)
 def _jitted_rowsharded(k: int):
     from celestia_tpu import parallel
 
@@ -425,6 +438,8 @@ def _jitted_rowsharded(k: int):
 
 
 @functools.lru_cache(maxsize=8)
+@devledger.instrument_builder("extend.rowsharded_roots",
+                              key_extra=_mesh_compile_key)
 def _jitted_rowsharded_roots(k: int):
     """Roots-only sharded spelling: the EDS stays out of the jit
     outputs (XLA drops the dead reassembly), matching roots_device's
@@ -436,6 +451,8 @@ def _jitted_rowsharded_roots(k: int):
 
 
 @functools.lru_cache(maxsize=8)
+@devledger.instrument_builder("extend.rowsharded_levels",
+                              key_extra=_mesh_compile_key)
 def _jitted_rowsharded_levels(k: int):
     from celestia_tpu import parallel
 
@@ -443,6 +460,8 @@ def _jitted_rowsharded_levels(k: int):
 
 
 @functools.lru_cache(maxsize=8)
+@devledger.instrument_builder("extend.rowsharded_full",
+                              key_extra=_mesh_compile_key)
 def _jitted_rowsharded_full(k: int):
     from celestia_tpu import parallel
 
@@ -572,6 +591,7 @@ def extend_roots_device_resident(shares: np.ndarray):
 
 
 @functools.lru_cache(maxsize=8)
+@devledger.instrument_builder("extend.eds_roots")
 def _jitted_eds_roots(k: int):
     @jax.jit
     def run(eds):
@@ -596,6 +616,7 @@ def eds_roots_device(eds):
 
 
 @functools.lru_cache(maxsize=8)
+@devledger.instrument_builder("extend.row_levels")
 def _jitted_row_levels(k: int):
     @jax.jit
     def run(eds):
@@ -678,10 +699,19 @@ def fused_roots_reference(shares: np.ndarray, tile: int | None = None,
     ], axis=0)
     digest_bytes = np.asarray(words_to_bytes(jnp.asarray(dig)))
     leaf_ns = np.asarray(_leaf_namespaces(jnp.asarray(q0_ns), k))
-    rows, cols = jax.jit(_digest_grid_roots)(
+    # cached builder, not a fresh jax.jit per call: the old spelling
+    # re-traced the digest-grid reduce on EVERY reference run — exactly
+    # the recompile-per-call pattern the devledger watchdog flags
+    rows, cols = _jitted_digest_grid_roots()(
         jnp.asarray(digest_bytes), jnp.asarray(leaf_ns)
     )
     return eds, np.asarray(rows), np.asarray(cols)
+
+
+@functools.lru_cache(maxsize=1)
+@devledger.instrument_builder("extend.digest_grid_roots")
+def _jitted_digest_grid_roots():
+    return jax.jit(_digest_grid_roots)
 
 
 # ------------------------------------------------------------------ #
@@ -782,6 +812,7 @@ def _assemble_square(arena, host_shares, blob_meta, host_sparse,
 
 
 @functools.lru_cache(maxsize=16)
+@devledger.instrument_builder("extend.assembled_roots")
 def _jitted_assembled_roots(k: int, h_pad: int, b_pad: int, hc_pad: int,
                             n_arena: int):
     m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
@@ -957,12 +988,14 @@ def roots_only_batched(shares: jnp.ndarray, m2: jnp.ndarray, chunk: int | None =
 
 
 @functools.lru_cache(maxsize=8)
+@devledger.instrument_builder("extend.batched_roots")
 def _jitted_batched_roots(k: int):
     m2 = jnp.asarray(rs_tpu.encode_bit_matrix(k))
     return jax.jit(lambda shares: roots_only_batched(shares, m2))
 
 
 @functools.lru_cache(maxsize=16)
+@devledger.instrument_builder("extend.chunk_roots")
 def _jitted_chunk_roots(k: int, chunk: int):
     """vmapped roots over a FIXED chunk of squares — the unit the
     large-k pipelined dispatch queues (see batched_roots_device)."""
@@ -971,6 +1004,7 @@ def _jitted_chunk_roots(k: int, chunk: int):
 
 
 @functools.lru_cache(maxsize=16)
+@devledger.instrument_builder("extend.roots_noeds")
 def _jitted_roots_noeds(k: int, fused: bool | None = None,
                         xor: bool | None = None):
     """fused=None / xor=None (the defaults every production caller

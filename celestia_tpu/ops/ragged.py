@@ -42,12 +42,13 @@ import time
 
 import numpy as np
 
-from celestia_tpu import tracing
+from celestia_tpu import devledger, tracing
 from celestia_tpu.ops import transfers
 from celestia_tpu.telemetry import metrics
 
 
 @functools.lru_cache(maxsize=None)
+@devledger.instrument_builder("ragged.gather")
 def _jitted_gather(page_shape: tuple):
     """One compiled ragged gather per page geometry.
 

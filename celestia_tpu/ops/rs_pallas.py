@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from celestia_tpu import devledger
 from celestia_tpu import namespace as ns
 from celestia_tpu.appconsts import NAMESPACE_SIZE, SHARE_SIZE
 from celestia_tpu.ops import rs_tpu
@@ -188,6 +189,7 @@ def _grid_tile(n: int) -> tuple[int, int]:
 
 
 @functools.lru_cache(maxsize=8)
+@devledger.instrument_builder("rs_pallas.encode2d")
 def _encode2d_call(k: int, n: int, interpret: bool):
     from jax.experimental import pallas as pl
 
@@ -206,6 +208,7 @@ def _encode2d_call(k: int, n: int, interpret: bool):
 
 
 @functools.lru_cache(maxsize=8)
+@devledger.instrument_builder("rs_pallas.fused")
 def _fused_call(k: int, n: int, interpret: bool):
     from jax.experimental import pallas as pl
 
@@ -231,6 +234,7 @@ def _fused_call(k: int, n: int, interpret: bool):
 
 
 @functools.lru_cache(maxsize=8)
+@devledger.instrument_builder("rs_pallas.leaf")
 def _leaf_call(k: int, n: int, interpret: bool):
     from jax.experimental import pallas as pl
 

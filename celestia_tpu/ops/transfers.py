@@ -50,7 +50,7 @@ import time
 
 import numpy as np
 
-from celestia_tpu import faults, integrity, tracing
+from celestia_tpu import devledger, faults, integrity, tracing
 
 # Bulk transfers split into row-block chunks of at least this many bytes
 # (smaller chunks are dispatch-bound: through this environment's ~8 MB/s
@@ -167,6 +167,7 @@ def _device_executor():
 
 
 @functools.lru_cache(maxsize=1)
+@devledger.instrument_builder("transfers.slicers")
 def _jitted_slicers():
     """Jitted row/col/cell extractors for a (w, w, B) device square.
 
@@ -246,6 +247,7 @@ def _eds_share_direct(dev, r: int, c: int, site: str) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=1)
+@devledger.instrument_builder("transfers.batch_slicers")
 def _jitted_batch_slicers():
     """Vmapped row/cell extractors for a (w, w, B) device square.
 

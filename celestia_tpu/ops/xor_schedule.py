@@ -51,7 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from celestia_tpu import tracing
+from celestia_tpu import devledger, tracing
 from celestia_tpu.appconsts import SHARE_SIZE
 from celestia_tpu.ops import rs_tpu
 
@@ -453,6 +453,7 @@ def _xor_encode_kernel(x_ref, a_ref, b_ref, r_ref, o_ref, *,
 
 
 @functools.lru_cache(maxsize=8)
+@devledger.instrument_builder("xor.encode")
 def _xor_encode_call(k: int, n: int, interpret: bool):
     from jax.experimental import pallas as pl
 
@@ -498,6 +499,7 @@ def _xor_fused_kernel(x_ref, a_ref, b_ref, r_ref, o_ref, d_ref, *,
 
 
 @functools.lru_cache(maxsize=8)
+@devledger.instrument_builder("xor.fused")
 def _xor_fused_call(k: int, n: int, interpret: bool):
     from jax.experimental import pallas as pl
 

@@ -40,6 +40,11 @@ def main(argv: list[str] | None = None) -> int:
                    help="run a synthetic monotone-gauge leak the drift "
                         "verdict MUST flag (red-path self-test; the "
                         "run is EXPECTED to fail)")
+    p.add_argument("--inject-retrace", action="store_true",
+                   help="churn synthetic post-warmup shape keys the "
+                        "zero_steadystate_retraces invariant MUST flag "
+                        "(red-path self-test; the run is EXPECTED to "
+                        "fail)")
     p.add_argument("--quiet", action="store_true",
                    help="suppress the report summary on stdout")
     p.add_argument("--san", action="store_true",
@@ -75,7 +80,8 @@ def main(argv: list[str] | None = None) -> int:
                               ledger_path=args.ledger,
                               record_path=args.record,
                               soak_ledger_path=args.soak_ledger,
-                              inject_leak=args.inject_leak)
+                              inject_leak=args.inject_leak,
+                              inject_retrace=args.inject_retrace)
     finally:
         if san_session is not None:
             sanitizer.deactivate(san_session)

@@ -27,7 +27,7 @@ import os
 import threading
 import time
 
-from celestia_tpu import faults, slo
+from celestia_tpu import devledger, faults, slo
 
 from . import verdict as verdict_mod
 from .spec import Scenario
@@ -58,6 +58,7 @@ def run_scenario(scenario: Scenario, *, seed: int = 1337,
                  record_path: str | None = None,
                  soak_ledger_path: str | None = None,
                  inject_leak: bool = False,
+                 inject_retrace: bool = False,
                  registry=None) -> dict:
     """Execute one scenario end to end; returns the scenario report.
 
@@ -66,7 +67,10 @@ def run_scenario(scenario: Scenario, *, seed: int = 1337,
     the recorded-SLO replay from the resulting ``.ctts``.
     ``inject_leak`` runs a synthetic monotone-gauge leak
     (``soak_leak_bytes``) that the drift verdict MUST flag — the
-    red-path self-test of the no_monotone_drift invariant."""
+    red-path self-test of the no_monotone_drift invariant.
+    ``inject_retrace`` churns synthetic post-warmup shape keys on a
+    known jitted entry — the `zero_steadystate_retraces` invariant
+    MUST flag it (the compile watchdog's red-path self-test)."""
     if registry is None:
         from celestia_tpu.telemetry import metrics as registry
     if getattr(scenario, "fleet_processes", 0):
@@ -86,20 +90,31 @@ def run_scenario(scenario: Scenario, *, seed: int = 1337,
     recording_meta: dict | None = None
     t_start = time.monotonic()
     with faults.inject(injector=injector):
+        # compile-watchdog warmup bracket: everything up to the END of
+        # the first phase (world warm-produce included) may trace new
+        # shapes freely; from then on a new key on a known entry is a
+        # steady-state retrace the verdict judges
+        devledger.begin_warmup()
         world.start()
         scraper, rec_path, rec_tmp = _start_recording(
             scenario, world, registry, record_path, seed)
         leak_stop = _start_leak(registry) if inject_leak else None
+        churn_stop = _start_retrace_churn() if inject_retrace else None
         run_cap0 = engine.capture()
-        for ph in scenario.phases:
+        for i, ph in enumerate(scenario.phases):
             phases.append(_run_phase(scenario, ph, world, injector,
                                      engine, seed, duration_scale))
+            if i == 0:
+                devledger.end_warmup()
         world.openload.end(time.monotonic())
         world.quiesce()
         world.freeze()  # heights stable: probes judge a fixed chain
         world.settle_follower()
         if leak_stop is not None:
             leak_stop.set()
+        if churn_stop is not None:
+            churn_stop.set()
+        steadystate_retraces = devledger.ledger.retrace_count()
         recording_meta = _finish_recording(scenario, world, engine,
                                            scraper, rec_path,
                                            inject_leak)
@@ -118,6 +133,12 @@ def run_scenario(scenario: Scenario, *, seed: int = 1337,
         "seed": seed,
         "duration_scale": duration_scale,
         "wall_s": round(time.monotonic() - t_start, 3),
+        # host/runtime identity: longitudinal soak series are only
+        # comparable within one fingerprint (ADR-011)
+        "provenance": devledger.runtime_provenance(),
+        # post-warmup recompiles of known jitted entries — folded into
+        # the perf ledger as a lower-is-better series
+        "steadystate_retraces": steadystate_retraces,
         "phases": phases,
         "slo": {"whole_run": whole_run, "final_ok": final["ok"]},
         "invariants": invariants,
@@ -183,7 +204,8 @@ def _start_recording(scenario: Scenario, world, registry,
         rec_tmp = tempfile.TemporaryDirectory(prefix="ctts-")
         path = os.path.join(rec_tmp.name, f"{scenario.name}.ctts")
     cadence = scenario.record_cadence_s or tsdb.DEFAULT_CADENCE_S
-    meta = {"scenario": scenario.name, "seed": seed}
+    meta = {"scenario": scenario.name, "seed": seed,
+            "provenance": devledger.runtime_provenance()}
     if registry is telemetry.metrics and getattr(world, "url", None):
         scraper = tsdb.Scraper(world.url + "/metrics", path,
                                cadence_s=cadence, meta=meta)
@@ -208,6 +230,33 @@ def _start_leak(registry) -> threading.Event:
             stop.wait(0.1)
 
     threading.Thread(target=_leak, daemon=True, name="soak-leak").start()
+    return stop
+
+
+def _start_retrace_churn() -> threading.Event:
+    """Synthetic steady-state geometry churn: a known jitted entry
+    keeps seeing NEW shape keys after warmup ends. The
+    `zero_steadystate_retraces` invariant MUST flag it — the red-path
+    self-test proving the compile watchdog can actually fail a run."""
+    stop = threading.Event()
+    ledger = devledger.ledger
+    # make the entry KNOWN while still in warmup, so the churned keys
+    # below are judged as retraces, not first compiles
+    ledger.note_build("scenario.churn", "(warmup)")
+
+    def _churn():
+        n = 0
+        while not stop.is_set():
+            if ledger.warm:
+                n += 1
+                try:
+                    ledger.note_build("scenario.churn", f"(churn-{n})")
+                except devledger.RetraceError:
+                    pass  # strict mode in the embedding process
+            stop.wait(0.1)
+
+    threading.Thread(target=_churn, daemon=True,
+                     name="retrace-churn").start()
     return stop
 
 
@@ -274,6 +323,8 @@ def append_soak_ledger(path: str, report: dict) -> None:
         "pass": report["scenario_slo_pass"],
         "drift_breaches": sum(1 for d in drift if d.get("drifting")),
         "knee_samples_per_sec": knee.get("knee_hz"),
+        "steadystate_retraces": report.get("steadystate_retraces", 0),
+        "provenance": report.get("provenance"),
         "wall_s": report["wall_s"],
     })
     doc["runs"] = doc["runs"][-LEDGER_MAX_RUNS:]

@@ -317,7 +317,8 @@ def _soak() -> Scenario:
         soak_sample_lag=1000,
         drift_series=("process_rss_bytes", "process_open_fds",
                       "eds_cache_pages_resident", "eds_cache_pin_count",
-                      "store_bytes", "probe_sample:p99"),
+                      "store_bytes", "probe_sample:p99",
+                      "device_ledger_unattributed_bytes"),
         phases=(
             Phase(name="warmup", duration_s=2.0, loads=(
                 LoadSpec(kind="das", clients=2),
@@ -332,7 +333,8 @@ def _soak() -> Scenario:
             )),
         ),
         invariants=("prober_verified", "readyz_well_ordered",
-                    "no_monotone_drift", "soak_byte_identity"),
+                    "no_monotone_drift", "soak_byte_identity",
+                    "zero_steadystate_retraces"),
     )
 
 

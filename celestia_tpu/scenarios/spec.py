@@ -40,7 +40,7 @@ INVARIANTS = ("prober_verified", "dah_byte_identical",
               "readyz_well_ordered", "zero_undetected_sdc",
               "follower_caught_up", "restarted_serves_from_store",
               "fleet_scaled_out", "no_monotone_drift",
-              "soak_byte_identity")
+              "soak_byte_identity", "zero_steadystate_retraces")
 
 #: fault sites whose bitflips are silent-data-corruption injections —
 #: the zero_undetected_sdc probe counts timeline entries at these

@@ -58,6 +58,7 @@ def run_invariants(scenario: Scenario, world, injector, registry,
         "fleet_scaled_out": _probe_fleet_scaled_out,
         "no_monotone_drift": _probe_no_monotone_drift,
         "soak_byte_identity": _probe_soak_byte_identity,
+        "zero_steadystate_retraces": _probe_zero_steadystate_retraces,
     }
     out = []
     for name in scenario.invariants:
@@ -299,6 +300,28 @@ def _probe_no_monotone_drift(scenario, world, injector, registry,
     return True, (f"{len(judged)} series judged over the recording, "
                   f"0 drifting "
                   f"({len(report) - len(judged)} absent, not judged)")
+
+
+def _probe_zero_steadystate_retraces(scenario, world, injector, registry,
+                                     cap0, cap1):
+    """The compile watchdog saw no post-warmup recompile of a known
+    jitted entry (ADR-011: geometry is stable in steady state). Read
+    from the devledger directly — the SLO capture only freezes
+    objective-referenced counters, and warmup-bracket accounting (the
+    first phase is free) lives in the ledger, not the registry."""
+    from celestia_tpu import devledger
+
+    events = devledger.ledger.retraces()
+    if events:
+        entries = sorted({e["entry"] for e in events})
+        return False, (f"{len(events)} steady-state retraces on "
+                       f"{entries} — geometry churned after warmup")
+    if not devledger.ledger.warm:
+        return False, ("warmup never ended — the watchdog judged "
+                       "nothing (vacuous)")
+    return True, ("0 post-warmup retraces across "
+                  f"{len(devledger.ledger.debug_doc()['compile']['entries'])} "
+                  "known jitted entries")
 
 
 def _probe_soak_byte_identity(scenario, world, injector, registry,

@@ -32,10 +32,14 @@ from celestia_tpu.tools import tsdb
 _TICKS = "▁▂▃▄▅▆▇█"
 
 # when --series is not given: host-resource gauges plus the store and
-# cache residency series a soak watches
+# cache residency series a soak watches, and the device runtime ledger
+# plane (ADR-025): per-owner HBM attribution, the unattributed
+# remainder, compile/retrace counters, and device-lane occupancy
 DEFAULT_SELECT = (
     "process_rss_bytes", "process_open_fds", "process_threads",
     "store_bytes", "store_heights", "eds_cache_*",
+    "device_ledger_*", "device_busy_ratio", "xla_compile_total*",
+    "xla_retrace_total*",
 )
 
 

@@ -133,6 +133,13 @@ TRACKED: dict[str, list[tuple[str | None, str]]] = {
     # knee means the serving path lost headroom. Folded from
     # soak_ledger.json runs that carry a knee.
     "soak_knee_samples_per_sec": [],
+    # compile watchdog (ADR-025): post-warmup recompiles of known
+    # jitted entries per recorded run. Lower is better and the healthy
+    # trajectory is all zeros — a geometry-churn regression (a builder
+    # keyed on something unstable, a cache losing its shape memo)
+    # regresses against the all-zero baseline exactly like
+    # soak_drift_breaches. Folded from soak_ledger.json.
+    "soak_steadystate_retraces": [],
 }
 
 # throughput series: the regression direction is inverted — the gate
@@ -365,6 +372,10 @@ def load_ledger(root: str) -> dict[str, list[tuple[str, float]]]:
                 if isinstance(k, (int, float)):
                     ledger["soak_knee_samples_per_sec"].append(
                         (f"soak_ledger.json#{idx}:{name}", float(k)))
+                sr = run.get("steadystate_retraces")
+                if isinstance(sr, (int, float)):
+                    ledger["soak_steadystate_retraces"].append(
+                        (f"soak_ledger.json#{idx}:{name}", float(sr)))
     return ledger
 
 

@@ -180,18 +180,26 @@ def _family_of(name: str, types: dict[str, str]) -> str:
 
 
 def series_key(name: str, labels: dict) -> str:
-    """The canonical series key — matches telemetry.Registry._key so a
-    recorded counter is addressable by the same key the SLO objectives
-    name (``probe_sample_total`` etc.)."""
+    """The canonical series key — for the repo's identifier-shaped
+    label values it matches telemetry.Registry._key, so a recorded
+    counter is addressable by the same key the SLO objectives name
+    (``probe_sample_total`` etc.). Values are exposition-escaped
+    (telemetry._escape's scheme) so ``split_key`` is a true inverse
+    even for values carrying backslash/quote/newline — the device
+    ledger's ``owner`` label is an arbitrary registration string."""
     if not labels:
         return name
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(
+        '{}="{}"'.format(
+            k,
+            v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n"))
+        for k, v in sorted(labels.items()))
     return f"{name}{{{inner}}}"
 
 
 def split_key(key: str):
-    """Inverse of ``series_key`` for benign label values (no embedded
-    quotes — the repo's label values are identifiers)."""
+    """Inverse of ``series_key``: the label block is escape-aware, so
+    values round-trip exactly."""
     brace = key.find("{")
     if brace == -1:
         return key, {}
@@ -654,9 +662,14 @@ class RegistryScraper(Scraper):
         self._registry = registry
 
     def fetch_text(self) -> str:
+        from celestia_tpu import devledger
         from celestia_tpu.telemetry import refresh_process_gauges
 
         refresh_process_gauges(self._registry)
+        # the device runtime ledger is pull-driven like the process
+        # gauges: each scrape runs one owner audit, so recordings carry
+        # device_ledger_* / device_busy_ratio series for the drift judge
+        devledger.publish(self._registry)
         return self._registry.prometheus_text()
 
 

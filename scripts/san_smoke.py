@@ -63,7 +63,7 @@ def _preimport() -> None:
     jax.config.update("jax_platforms", "cpu")
     jax.devices()
     from celestia_tpu import blob, da, faults, integrity, state  # noqa: F401
-    from celestia_tpu import telemetry, tracing  # noqa: F401
+    from celestia_tpu import devledger, telemetry, tracing  # noqa: F401
     from celestia_tpu.node import dispatch, eds_cache, gateway  # noqa: F401
     from celestia_tpu.ops import blob_pool, transfers  # noqa: F401
     from celestia_tpu.store import BlockStore  # noqa: F401
@@ -193,6 +193,22 @@ def _drive(seed: int, tmpdir: pathlib.Path) -> None:
             metrics.incr_counter("san_hammer_total")
     finally:
         tracing.disable()
+
+    # -- device runtime ledger: the leaf devledger._lock edge against
+    #    an owner callback that takes the paged cache's _cond (the
+    #    callbacks-run-unlocked contract, specs/serving.md) -------------
+    from celestia_tpu import devledger
+
+    led = devledger.DeviceLedger()
+    led.register_owner("san.paged", paged.device_bytes)
+    led.register_owner("san.flat", lambda: 64)
+    led.note_build("san.entry", "(warm)")
+    led.end_warmup()
+    led.note_build("san.entry", "(churn)")  # retrace: counter + emit path
+    led.note_busy(0.001)
+    led.snapshot()
+    led.publish(metrics)
+    led.debug_doc()
 
 
 def run_hammer(seed: int):

@@ -18,7 +18,15 @@ handler, numpy-only. Fails (non-zero exit) unless:
   4. flipping one byte of a complete frame makes `tsdb.read` refuse
      the file with IntegrityError (rotted bytes are never analyzed),
   5. the obs_report renderer produces a sparkline dashboard and its
-     machine report round-trips through JSON.
+     machine report round-trips through JSON,
+  6. RED PATH (ADR-025): an injected steady-state retrace (geometry
+     churn on a known jitted entry after warmup) is caught by the
+     compile watchdog AND its `xla_retrace_total` counter lands in the
+     recording,
+  7. RED PATH (ADR-025): an unregistered device allocation held live
+     drives `device_ledger_unattributed_bytes` into a monotone-drift
+     FAIL judged from the durable recording — the device-side leak the
+     RSS gauge cannot see.
 """
 
 from __future__ import annotations
@@ -76,6 +84,34 @@ def main() -> int:
 
     leak_thread = threading.Thread(target=_leak, daemon=True)
     leak_thread.start()
+
+    # device-runtime red paths (ADR-025), running for the whole
+    # recording: (a) geometry churn on a known jitted entry after
+    # warmup — every churned key is a steady-state retrace; (b) jax
+    # arrays allocated OUTSIDE any registered owner and held live —
+    # device_ledger_unattributed_bytes must climb monotonically
+    from celestia_tpu import devledger
+
+    import jax.numpy as jnp  # noqa: E402 — the leak needs real arrays
+
+    devledger.ledger.reset_watchdog()
+    devledger.ledger.note_build("smoke.churn", "(warmup)")
+    devledger.end_warmup()
+    unregistered: list = []
+
+    def _device_red():
+        n = 0
+        while not leak_stop.is_set():
+            n += 1
+            devledger.ledger.note_build("smoke.churn", f"(churn-{n})")
+            # faster than the scrape cadence, so every consecutive
+            # scrape pair sees growth (the drift judge requires the
+            # increases to be CONSISTENT, not just large)
+            unregistered.append(jnp.zeros((128 * 1024,), jnp.uint8))
+            leak_stop.wait(0.02)
+
+    red_thread = threading.Thread(target=_device_red, daemon=True)
+    red_thread.start()
     scraper.start()
 
     try:
@@ -101,6 +137,7 @@ def main() -> int:
     finally:
         leak_stop.set()
         leak_thread.join(timeout=2.0)
+        red_thread.join(timeout=2.0)
         scraper.stop(final_scrape=True)
         server.stop()
 
@@ -136,6 +173,31 @@ def main() -> int:
          f"(rel_growth={verdicts['soak_leak_bytes'].get('rel_growth')})")
     gate(verdicts["soak_flat_bytes"].get("drifting") is False,
          "drift verdict clears the flat control gauge")
+
+    # -- device-runtime red paths (ADR-025) ----------------------------- #
+    events = devledger.ledger.retraces()
+    gate(len(events) >= 3 and all(e["entry"] == "smoke.churn"
+                                  for e in events),
+         f"compile watchdog caught the injected steady-state retraces "
+         f"({len(events)} events on smoke.churn)")
+    retrace_series = [k for k in rec.names
+                      if k.split("{", 1)[0] == "xla_retrace_total"]
+    gate(bool(retrace_series),
+         "xla_retrace_total landed in the durable recording "
+         f"({retrace_series})")
+    unattr = tsdb.analyze_drift(
+        rec, ("device_ledger_unattributed_bytes",))[0]
+    gate(unattr.get("drifting") is True,
+         f"unregistered device allocation judged as monotone drift "
+         f"(rel_growth={unattr.get('rel_growth')})")
+    # releasing the hoard must flow back through the audit: the
+    # unattributed remainder returns to (near) its pre-leak level
+    leaked = sum(int(a.nbytes) for a in unregistered)
+    unregistered.clear()
+    after = devledger.ledger.snapshot()["unattributed_bytes"]
+    gate(after < leaked,
+         f"released hoard left the audit ({after} unattributed bytes "
+         f"< {leaked} leaked)")
 
     # -- integrity: one flipped byte must make the reader refuse ------- #
     blob = bytearray(open(path, "rb").read())
