@@ -6,10 +6,11 @@ PY ?= python
 .PHONY: test test-all test-slow chaos bench bench-transfers dryrun native \
 	trace-smoke bench-gate obs-smoke sdc-smoke storm-smoke storm-bench \
 	ragged-smoke \
-	store-smoke gateway-bench fleet-smoke \
+	store-smoke crash-smoke gateway-bench fleet-smoke \
 	scenario-smoke scenario-pfb-storm scenario-rolling-outage \
 	scenario-sdc-under-storm scenario-rejoin-under-load \
-	scenario-gateway-fleet scenario-scale-out-under-load scenarios \
+	scenario-gateway-fleet scenario-scale-out-under-load \
+	scenario-disk-pressure scenarios \
 	soak-smoke scenario-soak scenario-das-sweep \
 	kernel-smoke bench-fused analyze san multichip-smoke multichip-bench \
 	xor-smoke bench-xor devledger-smoke
@@ -51,8 +52,10 @@ JIT_B = tests/test_device_resident.py tests/test_blob_pool.py \
 JIT_HEAVY = $(JIT_A) $(JIT_B)
 # analyze first: the static gate costs ~3 s and fails fast on lint;
 # san next: the runtime sanitizer gate is ~30 s and catches what the
-# AST cannot (observed inversions, spec drift) before the long tiers
-test: analyze san
+# AST cannot (observed inversions, spec drift) before the long tiers;
+# crash-smoke last of the gates: the powercut sweep + ENOSPC drill is
+# ~2 s and guards the durability contract the store tests assume
+test: analyze san crash-smoke
 	$(PY) -m pytest $(JIT_HEAVY) -q
 	$(PY) -m pytest tests/ -q $(addprefix --ignore=,$(JIT_HEAVY))
 
@@ -175,6 +178,19 @@ ragged-smoke:
 store-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/store_smoke.py
 
+# Crash-consistency gate (specs/store.md §Durability contract,
+# ADR-026): the powercut explorer replays a power loss at EVERY prefix
+# of the put/compact/re-put/reindex effect trace under a simulated
+# page cache (un-fsynced bytes volatile, renames need the parent-dir
+# fsync) across lost/applied/torn variants — zero recovery-invariant
+# violations allowed — then proves the harness has teeth (the
+# no-dirsync world MUST lose acknowledged heights) and drills ENOSPC
+# graceful degradation + recovery over the real RPC stack. CPU-only,
+# crypto-free, seconds. `--inject-no-dirsync` is the red-path
+# self-test: it must FAIL with the missing-height report.
+crash-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/crash_smoke.py
+
 # Continuous-batching throughput gate (specs/serving.md, ADR-017): the
 # full das-storm — 32 concurrent light clients through the real RPC
 # stack, unbatched phase then batched phase on identical config with
@@ -293,6 +309,15 @@ scenario-scale-out-under-load:
 	JAX_PLATFORMS=cpu $(PY) -m celestia_tpu.scenarios \
 		scale-out-under-load --ledger scenario_ledger.json
 
+# Disk-pressure campaign (ADR-026): open-loop DAS storm with ENOSPC
+# injected at store.write mid-storm — the store must degrade to sticky
+# read-only (visible on /readyz and as the REQUIRED store_writable
+# breach) while reads keep serving with zero verification failures,
+# then recover to writable once space is freed.
+scenario-disk-pressure:
+	JAX_PLATFORMS=cpu $(PY) -m celestia_tpu.scenarios disk-pressure \
+		--ledger scenario_ledger.json
+
 # Longitudinal soak (specs/observability.md §Longitudinal telemetry):
 # thousands of heights under store compaction churn with the whole run
 # recorded to a durable .ctts; judged by Theil-Sen drift detectors
@@ -313,11 +338,11 @@ scenario-das-sweep:
 	JAX_PLATFORMS=cpu $(PY) -m celestia_tpu.scenarios das-sweep \
 		--ledger scenario_ledger.json --soak-ledger soak_ledger.json
 
-# All six suites back to back.
+# All the suites back to back.
 scenarios: scenario-pfb-storm scenario-rolling-outage \
 	scenario-sdc-under-storm scenario-rejoin-under-load \
 	scenario-gateway-fleet scenario-scale-out-under-load \
-	scenario-soak scenario-das-sweep
+	scenario-disk-pressure scenario-soak scenario-das-sweep
 
 # Multi-chip block-pipeline smoke gate (specs/parallel.md §Block
 # pipeline): stream blocks through the 3-deep H2D/compute/D2H pipeline

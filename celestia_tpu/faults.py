@@ -22,6 +22,10 @@ touching production code paths:
     cache.faultin          paged-cache page H2D fault-in  (node/eds_cache.py)
     store.write            block-store put, pre-write     (store/__init__.py)
     store.read             block-store page read          (store/__init__.py)
+    store.fsync            block-store data fsync         (store/__init__.py)
+    store.rename           block-store tmp->final rename  (store/__init__.py)
+    store.dirsync          block-store parent-dir fsync   (store/__init__.py)
+    store.unlink           block-store unlink (tmp/evict) (store/__init__.py)
     gateway.route          gateway ring routing decision  (node/gateway.py)
     gateway.hedge          gateway hedged retry hop       (node/gateway.py)
     pipeline.block         block-pipeline admission       (node/pipeline.py)
@@ -40,7 +44,13 @@ caught by the page CRC before any reader sees the bytes. The
 ``store.*`` pair is the disk analogue: a ``bitflip`` at
 ``store.write`` mangles a page payload after its CRC was stamped —
 rot-on-disk the read path must refuse — while ``store.read`` faults
-the page fetch itself. The ``gateway.*`` pair drills fleet routing:
+the page fetch itself. The ``store.fsync`` / ``store.rename`` /
+``store.dirsync`` / ``store.unlink`` quartet is the OS-failure model:
+each fires at the matching syscall boundary of the store's write-path
+shim, so ``enospc`` / ``fsync_fail`` / ``short_write`` rules strike
+exactly where a real kernel would fail them, and the powercut explorer
+(store/powercut.py) interposes the same shim to record the effect
+trace it replays crashes over. The ``gateway.*`` pair drills fleet routing:
 ``gateway.route`` fires at the ring-ownership decision, and
 ``gateway.hedge`` on every retry hop to the next ring position. The
 ``fleet.*`` pair drills supervision itself: an ``error`` rule at
@@ -64,6 +74,17 @@ Fault kinds:
                  framed payload), ``bitflip`` is the minimal corruption
                  an integrity audit must still catch.
     unavailable  raise DeviceUnavailable (device gone / backend down)
+    enospc       raise DiskFault carrying errno ENOSPC (disk full). A
+                 DiskFault is also an OSError, so code handling a real
+                 ENOSPC handles the injected one identically — the
+                 store's graceful-degradation trigger.
+    short_write  the site applies the returned truncator to the bytes
+                 it was about to persist — a seeded prefix lands, the
+                 rest does not — and MUST treat the write as failed
+                 (the torn-tmp-file model for put abort paths)
+    fsync_fail   raise DiskFault carrying errno EIO: an fsync that
+                 returned failure, after which the durability of every
+                 previously written byte is UNKNOWN
 
 Scoping and determinism: ``with faults.inject(rule(...), seed=N):``
 pushes a FaultInjector onto a process-global stack and pops it on exit —
@@ -82,6 +103,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import errno
 import fnmatch
 import random
 import threading
@@ -107,7 +129,15 @@ class DeviceUnavailable(FaultError):
     """Injected device/backend unavailability (TPU gone, sidecar down)."""
 
 
-KINDS = ("delay", "error", "reset", "corrupt", "bitflip", "unavailable")
+class DiskFault(FaultError, OSError):
+    """Injected OS/disk failure. Also an OSError carrying a real errno
+    (ENOSPC for ``enospc``, EIO for ``fsync_fail``), so code that
+    handles the real kernel failure handles the injected kind through
+    the exact same ``except OSError`` path."""
+
+
+KINDS = ("delay", "error", "reset", "corrupt", "bitflip", "unavailable",
+         "enospc", "short_write", "fsync_fail")
 
 
 @dataclasses.dataclass
@@ -194,6 +224,21 @@ def _bitflipper(pos_draw: int, bit_draw: int):
     return flip
 
 
+def _truncator(cut_draw: int):
+    """Seeded short-write model: the site applies the returned callable
+    to the bytes it was about to persist — only a prefix survives — and
+    must then treat the write as FAILED (``short_write`` attribute lets
+    the site distinguish this from a corrupt/bitflip mangler)."""
+
+    def truncate(payload: bytes) -> bytes:
+        if not payload:
+            return payload
+        return bytes(payload[: cut_draw % len(payload)])
+
+    truncate.short_write = True
+    return truncate
+
+
 class FaultInjector:
     """Seeded decision engine over a set of FaultRules.
 
@@ -271,6 +316,8 @@ class FaultInjector:
                     corrupt = _bitflipper(
                         self.rng.randrange(1 << 24), self.rng.randrange(8)
                     )
+                elif r.kind == "short_write":
+                    corrupt = _truncator(self.rng.randrange(1 << 16))
                 else:
                     actions.append(r)
         for r in actions:
@@ -282,6 +329,11 @@ class FaultInjector:
                 raise ConnectionResetFault(f"injected connection reset at {site}")
             elif r.kind == "unavailable":
                 raise DeviceUnavailable(f"injected unavailability at {site}")
+            elif r.kind == "enospc":
+                raise DiskFault(errno.ENOSPC, f"injected ENOSPC at {site}")
+            elif r.kind == "fsync_fail":
+                raise DiskFault(errno.EIO,
+                                f"injected fsync failure at {site}")
         return corrupt
 
 

@@ -31,16 +31,28 @@ serving, then obeys newline commands on stdin:
                     (auto-compacts when --store-budget is set)
     compact <b> <r> run store.compact(byte_budget=b, keep_recent=r)
     drain           dispatcher stops admitting (503 sheds)
+    readonly on|off force the store read-only / try to recover it
+                    (the storage-degradation drill lever, ADR-026)
     stop            graceful stop; write the trace file; exit
 
 Supervisor member states::
 
-    starting -> warming -> ready
+    starting -> warming -> ready <-> degraded
         ^          |         |
         |       (crash)   (crash)
         +--- backoff <-------+        backoff doubles 2x per crash
                 |                     (capped), resets after a
             crashloop (terminal)      crash-free window
+
+Storage degradation (ADR-026) is NOT a crash: a member whose `/readyz`
+answers 503 failing ONLY the `store_writable` check still serves every
+read it has — restarting it would trade a full cache for the same full
+disk. The supervisor classifies it **degraded**: it keeps its ring
+arcs (reads keep routing to it), keeps being probed, and is excluded
+from `advance()` head adoption (a read-only store cannot persist new
+heights) until `/readyz` recovers to 200 — then it is re-warmed to the
+fleet head and promoted back to ready. Degraded members never count
+toward `fleet_health_fail_total` or the crash-loop ledger.
 
 Fault sites (specs/faults.md): `fleet.spawn` fires before each process
 launch (error rules model a fork/exec failure; delay rules a slow
@@ -76,6 +88,7 @@ log = logger("fleet")
 STARTING = "starting"
 WARMING = "warming"
 READY = "ready"
+DEGRADED = "degraded"
 BACKOFF = "backoff"
 CRASHLOOP = "crashloop"
 STOPPED = "stopped"
@@ -90,6 +103,23 @@ def _http_status(url: str, timeout: float) -> int:
             return resp.status
     except urllib.error.HTTPError as e:
         return e.code
+
+
+def _http_get_json(url: str, timeout: float):
+    """(status, parsed body) of one GET; HTTP error codes are answers
+    and their bodies are read too — /readyz 503s carry the check list
+    that tells storage degradation apart from real sickness."""
+    import json
+    import urllib.error
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except ValueError:
+            return e.code, {}
 
 
 class FleetMember:
@@ -315,27 +345,47 @@ class FleetSupervisor:
                 continue
             proc = m.proc
             if proc is not None and proc.poll() is not None \
-                    and m.state in (READY, WARMING, STARTING):
+                    and m.state in (READY, DEGRADED, WARMING, STARTING):
                 self._on_crash(m, proc.returncode)
                 continue
             if m.state == BACKOFF:
                 if now >= m.restart_at:
                     self._restart(m)
                 continue
-            if m.state == READY:
+            if m.state in (READY, DEGRADED):
                 self._probe(m, now)
         self._publish()
 
     def _probe(self, m: FleetMember, now: float) -> None:
-        ok = True
+        status, failing = -1, set()
         try:
             faults.fire("fleet.health", member=m.index, url=m.url)
-            ok = _http_status(m.url + "/readyz",
-                              timeout=self.health_timeout_s) == 200
+            status, body = _http_get_json(m.url + "/readyz",
+                                          timeout=self.health_timeout_s)
+            failing = {c.get("name") for c in body.get("checks", ())
+                       if not c.get("ok", False)}
         except Exception:  # noqa: BLE001 — a failing health checker
             # (armed error rule, dead socket) is a failed probe, not a
             # supervisor crash; only process EXIT triggers a restart
-            ok = False
+            status = -1
+        # a 503 failing ONLY store_writable is storage degradation, not
+        # sickness: the member still serves every read it has (ADR-026)
+        storage_only = (status == 503 and failing
+                        and failing <= {"store_writable"})
+        if m.state == DEGRADED:
+            if status == 200:
+                self._recover(m)
+            elif storage_only:
+                m.healthy = True  # still degraded, still serving reads
+            else:
+                m.healthy = False
+                m.health_fails += 1
+                metrics.incr_counter("fleet_health_fail_total")
+            return
+        if storage_only:
+            self._degrade(m)
+            return
+        ok = status == 200
         m.healthy = ok
         if not ok:
             m.health_fails += 1
@@ -345,6 +395,45 @@ class FleetSupervisor:
             m.backoff_s = 0.0        # stable: forgive crash history
             m.crash_times = [t for t in m.crash_times
                              if now - t <= self.crash_loop_window_s]
+
+    def _degrade(self, m: FleetMember) -> None:
+        """READY -> DEGRADED: keep the ring arcs (reads keep routing),
+        keep probing, exclude from head adoption; no restart, no
+        health-fail accounting — a full cache beats an empty one."""
+        m.state = DEGRADED
+        m.healthy = True
+        metrics.incr_counter("fleet_degraded_total")
+        log.warn("fleet member storage-degraded; serving reads, "
+                 "excluded from head adoption", member=m.index)
+        with self._lock:
+            self._events.append({
+                "event": "degraded", "member": m.index,
+                "check": "store_writable",
+                "t": round(time.monotonic() - self._t0, 3)})
+
+    def _recover(self, m: FleetMember) -> None:
+        """DEGRADED -> READY: the store is writable again; re-warm to
+        the fleet head it missed while degraded, then promote."""
+        with self._lock:
+            head = self._head
+        try:
+            warmed_to = self._warm(m, head)
+        except Exception as e:  # noqa: BLE001 — a failed re-warm keeps
+            # the member degraded; the next probe pass retries and a
+            # mid-warm crash is caught by the poll() reaper
+            log.warn("fleet member recovery warm failed",
+                     member=m.index, error=str(e))
+            return
+        m.state = READY
+        m.healthy = True
+        m.ready_since = time.monotonic()
+        log.info("fleet member recovered from storage degradation",
+                 member=m.index, warmed_to=warmed_to)
+        with self._lock:
+            self._events.append({
+                "event": "recovered", "member": m.index,
+                "warmed_to": warmed_to,
+                "t": round(time.monotonic() - self._t0, 3)})
 
     def _on_crash(self, m: FleetMember, code: int | None) -> None:
         m.last_exit = code
@@ -596,8 +685,11 @@ class FleetSupervisor:
         with self._lock:
             n = len(self._members)
             ready = sum(1 for m in self._members if m.state == READY)
+            degraded = sum(1 for m in self._members
+                           if m.state == DEGRADED)
         metrics.set_gauge("fleet_members", float(n))
         metrics.set_gauge("fleet_members_ready", float(ready))
+        metrics.set_gauge("fleet_members_degraded", float(degraded))
 
 
 # -- worker mode --------------------------------------------------------- #
@@ -643,6 +735,15 @@ def backend_main(args) -> int:
             elif parts[0] == "drain":
                 server.dispatcher.begin_drain()
                 print("OK drain", flush=True)
+            elif parts[0] == "readonly":
+                if node.store is None:
+                    print("ERR no store", flush=True)
+                elif len(parts) > 1 and parts[1] == "on":
+                    node.store.force_read_only("operator")
+                    print("OK readonly on", flush=True)
+                else:
+                    ok = node.store.try_recover()
+                    print(f"OK readonly off {int(ok)}", flush=True)
             elif parts[0] == "stop":
                 break
             else:

@@ -32,6 +32,13 @@ Each is a production-emulation campaign judged by the SLO board:
                       must backfill to the fleet head before taking
                       ring traffic and pre-join heights must still
                       NMT-verify through the grown ring (ADR-023).
+    disk-pressure     open-loop DAS storm with ENOSPC injected at
+                      store.write mid-storm: the store must degrade to
+                      sticky read-only (visible on /readyz and the SLO
+                      board via the store_writable breach) while every
+                      read keeps serving from the cache tiers, then
+                      recover to writable once space is freed
+                      (ADR-026).
     soak              duration-scalable long-chain soak: thousands of
                       heights with store compaction churn + retention
                       pruning, judged by Theil-Sen drift over the
@@ -295,6 +302,57 @@ def _scale_out_under_load() -> Scenario:
     )
 
 
+def _disk_pressure() -> Scenario:
+    return Scenario(
+        name="disk-pressure",
+        description=("open-loop DAS storm over a store-backed node "
+                     "with ENOSPC injected at store.write mid-storm: "
+                     "sticky read-only degradation that the SLO board "
+                     "MUST see (store_writable breach) and /readyz "
+                     "must name, zero sample-verification failures "
+                     "throughout, full recovery to writable once "
+                     "space is freed (ADR-026)"),
+        k=4,
+        queue_capacity=64,
+        block_interval_s=0.25,
+        initial_heights=1,
+        store=True,
+        phases=(
+            Phase(name="steady", duration_s=2.0, loads=(
+                LoadSpec(kind="das", clients=3),
+            )),
+            # the disk fills mid-storm: the FIRST persisted put strikes
+            # ENOSPC and flips the store read-only; later strikes only
+            # re-feed the sticky state if a reprobe put fires under a
+            # stretched --duration-scale (count-gated headroom)
+            Phase(name="pressure-storm", duration_s=4.0,
+                  enter_actions=("disk_pressure_on",),
+                  loads=(
+                      LoadSpec(kind="das", clients=4),
+                      LoadSpec(kind="open_das", clients=2, rate_hz=10.0,
+                               profile="mixed-namespaces"),
+                  ), campaigns=(
+                      CampaignRule(site="store.write", kind="enospc",
+                                   times=8),
+                  )),
+            # space freed as the NEXT phase's enter action (not the
+            # storm's exit action): the campaign rule is already
+            # dormant when try_recover probes, so recovery cannot race
+            # a residual strike
+            Phase(name="space-freed", duration_s=3.0,
+                  enter_actions=("disk_pressure_off",),
+                  loads=(
+                      LoadSpec(kind="das", clients=3),
+                  )),
+        ),
+        # the degradation MUST surface on the board — a silent
+        # read-only store is the failure mode this scenario exists for
+        required_breaches=frozenset({"store_writable"}),
+        invariants=("prober_verified", "dah_byte_identical",
+                    "readyz_well_ordered", "store_recovered_writable"),
+    )
+
+
 def _soak() -> Scenario:
     return Scenario(
         name="soak",
@@ -416,7 +474,8 @@ SCENARIOS = {
     fn().name: fn
     for fn in (_pfb_storm, _rolling_outage, _sdc_under_storm,
                _rejoin_under_load, _gateway_fleet,
-               _scale_out_under_load, _soak, _das_sweep, _smoke)
+               _scale_out_under_load, _disk_pressure, _soak,
+               _das_sweep, _smoke)
 }
 
 

@@ -33,14 +33,16 @@ LOAD_KINDS = ("das", "pfb", "follower_sync", "open_das")
 
 #: phase-boundary world actions engine.py may apply
 ACTIONS = ("tpu_strike", "tpu_recover", "sdc_clear", "follower_boot",
-           "backend_restart", "fleet_scale_out")
+           "backend_restart", "fleet_scale_out",
+           "disk_pressure_on", "disk_pressure_off")
 
 #: invariant probes verdict.py implements
 INVARIANTS = ("prober_verified", "dah_byte_identical",
               "readyz_well_ordered", "zero_undetected_sdc",
               "follower_caught_up", "restarted_serves_from_store",
               "fleet_scaled_out", "no_monotone_drift",
-              "soak_byte_identity", "zero_steadystate_retraces")
+              "soak_byte_identity", "zero_steadystate_retraces",
+              "store_recovered_writable")
 
 #: fault sites whose bitflips are silent-data-corruption injections —
 #: the zero_undetected_sdc probe counts timeline entries at these
@@ -230,6 +232,16 @@ class Scenario:
                 and not self.store:
             raise ValueError("compaction budget / retention require "
                              "store=True")
+        uses_disk_pressure = any(
+            a in ("disk_pressure_on", "disk_pressure_off")
+            for p in self.phases
+            for a in p.enter_actions + p.exit_actions)
+        if (uses_disk_pressure or "store_recovered_writable"
+                in self.invariants) and not self.store:
+            raise ValueError("disk_pressure actions / store_recovered_"
+                             "writable require store=True (ENOSPC "
+                             "degradation needs a durable tier under "
+                             "the node)")
         if "soak_byte_identity" in self.invariants and not (
                 self.store and self.soak_sample_lag > 0):
             raise ValueError("soak_byte_identity requires store=True "

@@ -59,6 +59,7 @@ def run_invariants(scenario: Scenario, world, injector, registry,
         "no_monotone_drift": _probe_no_monotone_drift,
         "soak_byte_identity": _probe_soak_byte_identity,
         "zero_steadystate_retraces": _probe_zero_steadystate_retraces,
+        "store_recovered_writable": _probe_store_recovered_writable,
     }
     out = []
     for name in scenario.invariants:
@@ -132,7 +133,7 @@ def _probe_readyz_well_ordered(scenario, world, injector, registry,
     # quarantine that never flipped /readyz means the serving-fit
     # surface lied to the load balancer
     for d in world.degradations:
-        if d["kind"] not in ("tpu_strike", "sdc"):
+        if d["kind"] not in ("tpu_strike", "sdc", "store"):
             continue  # overload windows may never fill the queue
         t1 = d["t1"] if d["t1"] is not None else float("inf")
         seen = any(not ready and d["t0"] - READYZ_SLACK_S <= t
@@ -369,6 +370,36 @@ def _probe_soak_byte_identity(scenario, world, injector, registry,
     return True, (f"{verified}/{len(eligible)} aged anchors re-served "
                   f"byte-identically + NMT-verified at lag {lag} "
                   f"(head {latest}, {len(world.soak_anchors)} anchored)")
+
+
+def _probe_store_recovered_writable(scenario, world, injector, registry,
+                                    cap0, cap1):
+    """The disk-pressure story COMPLETED (ADR-026): injected ENOSPC
+    actually degraded the store (vacuous otherwise — pressure that
+    never struck proved nothing), the degradation aborted puts with
+    honest accounting, and the run ends with the store recovered to
+    writable, gauge cleared."""
+    store = getattr(world.node, "store", None)
+    if store is None:
+        return False, "world has no store under the node"
+    entered = registry.get_counter("store_read_only_total")
+    recovered = registry.get_counter("store_read_only_recovered_total")
+    if entered < 1:
+        return False, ("store never entered read-only — the ENOSPC "
+                       "campaign never struck a put (vacuous)")
+    if store.read_only:
+        return False, (f"store still read-only at teardown "
+                       f"({store.read_only_reason})")
+    if recovered < 1:
+        return False, ("store exited read-only without a recovery "
+                       "event — the counter ledger is inconsistent")
+    if registry.get_gauge("store_read_only") != 0.0:
+        return False, "store_read_only gauge not cleared at teardown"
+    aborted = registry.get_counter("store_put_aborted_total",
+                                   reason="enospc")
+    return True, (f"{entered:.0f} degradation(s), {recovered:.0f} "
+                  f"recovery(ies), {aborted:.0f} enospc-aborted puts; "
+                  "store writable at teardown")
 
 
 def _probe_follower_caught_up(scenario, world, injector, registry,

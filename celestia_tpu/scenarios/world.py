@@ -290,6 +290,23 @@ class ScenarioWorld:
         self.node.app.sdc_quarantined = False
         self.end_degradation("sdc")
 
+    def _action_disk_pressure_on(self) -> None:
+        """Open the declared storage-degradation window (ADR-026). The
+        flipping itself is the campaign's job — enospc rules armed at
+        `store.write` strike the next persisted put — this action only
+        tells the readiness verdict the window during which a
+        store_writable 503 is EXPLAINED rather than stray."""
+        self.note_degradation("store")
+
+    def _action_disk_pressure_off(self) -> None:
+        """Operator freed disk space: recover the store (the probe
+        write rides the real shim sites, so it stays read-only if the
+        pressure is actually still on) and close the window."""
+        store = getattr(self.node, "store", None)
+        if store is not None and store.read_only:
+            store.try_recover()
+        self.end_degradation("store")
+
     def _action_follower_boot(self) -> None:
         from celestia_tpu.node.rpc import RpcServer
 

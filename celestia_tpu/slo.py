@@ -121,6 +121,14 @@ def default_objectives() -> list[Objective]:
         # breach: the store exists so restarts can TRUST it.
         Objective(name="store_integrity", kind="counter_max",
                   counter="store_read_corrupt_total", limit=0.0),
+        # durable-store writability (ADR-026): the store flipping to
+        # sticky read-only (ENOSPC, real or injected) is GRACEFUL —
+        # reads keep serving from every tier — but the node is no
+        # longer extending its durable history, so any entry into the
+        # degraded state must surface on the SLO board. The counter is
+        # written only by BlockStore._enter_read_only.
+        Objective(name="store_writable", kind="counter_max",
+                  counter="store_read_only_total", limit=0.0),
     ]
 
 
@@ -477,6 +485,20 @@ def readiness(node) -> tuple[bool, list[dict]]:
         check("not_overloaded", not (saturated or draining),
               f"queue={dispatcher.depth}/{dispatcher.capacity}"
               + (" draining" if draining else ""))
+
+    # durable-store writability (ADR-026): a read-only store still
+    # SERVES — but a load balancer placing fresh traffic should prefer
+    # replicas whose durable history is still growing, and the fleet
+    # supervisor reads this exact check name to classify the member
+    # storage-degraded instead of unhealthy (node/fleet.py)
+    store = getattr(node, "store", None)
+    if store is None:
+        check("store_writable", True, "no store attached")
+    else:
+        ro = bool(getattr(store, "read_only", False))
+        check("store_writable", not ro,
+              "" if not ro else
+              f"store read-only ({getattr(store, 'read_only_reason', '?')})")
 
     # a DA node with no data cannot answer a single /sample — not ready
     # until the first block lands (this is the 503→200 startup flip the

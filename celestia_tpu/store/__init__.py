@@ -22,6 +22,26 @@ tiers, `integrity.crc32c`): a read whose checksum mismatches raises
 never returned. Writes are atomic (temp file + rename), so a crash
 mid-put leaves at worst a `.tmp` orphan, never a half-indexed height.
 
+Durability contract (specs/store.md §Durability contract, ADR-026): in
+durable mode a put is ACKNOWLEDGED DURABLE only after data fsync +
+rename + parent-directory fsync — the dirsync is what makes the rename
+itself survive power loss. Every persisted byte crosses the `FsShim`
+syscall boundary (write/fsync/rename/dirsync/unlink), which both fires
+the `store.*` fault sites and gives the powercut explorer
+(store/powercut.py) its interposition point: it records the ordered
+effect trace of put/compact sequences and replays a kill at every
+prefix under a simulated page cache, gating the recovery invariants.
+
+Disk-fault degradation: an `OSError` with `errno.ENOSPC` (real or
+injected `enospc` kind) flips the store to STICKY READ-ONLY —
+`store_read_only` gauge 1, one warn log, best-effort `.tmp` cleanup,
+optional emergency compact — and subsequent puts are skipped
+(`store_put_aborted_total{reason=read_only}`) so the node keeps
+serving from the cache tiers instead of crash-looping the put path.
+Recovery: puts periodically re-probe (the put itself is the probe,
+one per `reprobe_interval_s`), or `try_recover()` probes explicitly;
+`/readyz` surfaces the state through its `store_writable` check.
+
 Re-index (`reindex()`) is how a restarted node adopts the directory:
 damaged files — truncated tail records, corrupt headers, CRC-mismatched
 pages, duplicate heights — are SKIPPED with a
@@ -52,10 +72,14 @@ Layout (specs/store.md is the normative format doc):
 
 Fault sites (specs/faults.md): `store.write` fires once per `put`
 before the file lands (corrupt/bitflip rules mangle the first page
-payload AFTER its CRC was computed — the on-disk-rot drill);
-`store.read` fires on every page read with the bytes in hand
-(bitflip rules mangle them BEFORE the CRC check, so the drill proves
-detection, not luck).
+payload AFTER its CRC was computed — the on-disk-rot drill; a
+`short_write` rule lands only a seeded prefix of the file and fails
+the put); `store.read` fires on every page read with the bytes in
+hand (bitflip rules mangle them BEFORE the CRC check, so the drill
+proves detection, not luck). The syscall quartet `store.fsync` /
+`store.rename` / `store.dirsync` / `store.unlink` fires inside the
+`FsShim` at the matching kernel boundary — `enospc` / `fsync_fail`
+rules there strike exactly where the real failure would.
 
 Stdlib-importable: numpy is imported lazily inside the methods that
 touch share bytes, mirroring node/eds_cache.py.
@@ -65,11 +89,13 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import errno
 import json
 import os
 import pathlib
 import struct
 import threading
+import time
 
 from celestia_tpu import faults
 from celestia_tpu.integrity import IntegrityError, crc32c, record_sdc
@@ -164,6 +190,43 @@ def unpack_levels(blob: bytes):
     return levels
 
 
+class FsShim:
+    """Syscall-boundary shim: every byte the store persists crosses one
+    of these methods. Each fires its `store.*` fault site
+    (specs/faults.md) before touching the kernel, so `enospc` /
+    `fsync_fail` / `short_write` rules strike exactly where a real
+    kernel failure would. This is ALSO the powercut explorer's
+    interposition point: store/powercut.py swaps a recording shim onto
+    a store instance to capture the ordered effect trace it replays
+    crashes over."""
+
+    def open_w(self, path, **ctx):
+        return open(path, "wb")
+
+    def fsync(self, f, *, path, **ctx) -> None:
+        faults.fire("store.fsync", path=str(path), **ctx)
+        os.fsync(f.fileno())
+
+    def replace(self, src, dst, **ctx) -> None:
+        faults.fire("store.rename", src=str(src), dst=str(dst), **ctx)
+        os.replace(src, dst)
+
+    def dirsync(self, dirpath, **ctx) -> None:
+        faults.fire("store.dirsync", path=str(dirpath), **ctx)
+        fd = os.open(dirpath, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def unlink(self, path, *, missing_ok: bool = True, **ctx) -> None:
+        faults.fire("store.unlink", path=str(path), **ctx)
+        pathlib.Path(path).unlink(missing_ok=missing_ok)
+
+
+_FS = FsShim()
+
+
 class BlockStore:
     """CRC32C-guarded on-disk block store under one directory.
 
@@ -173,20 +236,33 @@ class BlockStore:
     math run UNLOCKED: records are immutable once renamed into place,
     so readers only need the entry snapshot."""
 
-    def __init__(self, root: str | os.PathLike, *, durable: bool = True):
+    def __init__(self, root: str | os.PathLike, *, durable: bool = True,
+                 reprobe_interval_s: float = 5.0,
+                 emergency_compact_bytes: int | None = None):
         self.root = pathlib.Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
-        # durable=False skips the per-put fsync (atomic tmp+rename is
-        # kept, so a torn write still can't surface): soak/CI harnesses
-        # producing thousands of heights are fsync-bound otherwise.
-        # Production nodes never pass this.
+        # durable=False skips the per-put fsync AND dirsync (atomic
+        # tmp+rename is kept, so a torn write still can't surface):
+        # soak/CI harnesses producing thousands of heights are
+        # fsync-bound otherwise. Production nodes never pass this.
         self.durable = bool(durable)
+        # syscall boundary (FsShim); powercut.py swaps in a recorder
+        self._fs = _FS
+        # read-only degradation state machine (module docstring):
+        # _read_only is a GIL-atomic bool read unlocked on hot paths;
+        # transitions and the reprobe clock are under _index_lock.
+        self.reprobe_interval_s = float(reprobe_interval_s)
+        self.emergency_compact_bytes = emergency_compact_bytes
+        self._read_only = False
+        self._read_only_reason: str | None = None
+        self._reprobe_after = 0.0
         self._index_lock = threading.Lock()
         self._index: dict[int, StoreEntry] = {}
         self._skipped: dict[str, int] = {}
         self._page_reads = 0
         self._puts = 0
         self._write_errors = 0
+        self._put_aborts = 0
         self._compactions = 0
         self._evicted = 0
 
@@ -198,8 +274,27 @@ class BlockStore:
         """Persist one height: the host EDS array split into row-group
         pages, the served DAH JSON, and (optionally) the device
         row-tree levels. Atomic — the height is visible only after the
-        rename, and a re-put replaces the old file in one step."""
+        rename, and a re-put replaces the old file in one step.
+
+        In durable mode the height is ACKNOWLEDGED DURABLE only when
+        this returns: data fsync + rename + parent-dir fsync have all
+        happened (specs/store.md §Durability contract). Read-only mode
+        (ENOSPC degradation) skips the put and returns None — except
+        one put per ``reprobe_interval_s``, which runs as the recovery
+        probe and clears the degradation if it lands."""
         import numpy as np
+
+        # lint: allow(C005) reason=_read_only is a GIL-atomic bool read; transitions serialize under _index_lock and a one-read-stale value only delays (never corrupts) a single put
+        if self._read_only:
+            now = time.monotonic()
+            with self._index_lock:
+                reprobe = now >= self._reprobe_after
+                if reprobe:  # this put becomes the probe; peers skip
+                    self._reprobe_after = now + self.reprobe_interval_s
+            if not reprobe:
+                metrics.incr_counter("store_put_aborted_total",
+                                     reason="read_only")
+                return None
 
         arr = np.ascontiguousarray(np.asarray(eds_np, dtype=np.uint8))
         width, _w2, share_size = arr.shape
@@ -219,14 +314,6 @@ class BlockStore:
             payload = arr[lo:hi].tobytes()
             pages.append((payload, crc32c(payload)))
 
-        # the write drill: corrupt/bitflip rules mangle the first page
-        # payload AFTER its CRC was computed — rot-on-disk that the
-        # next read MUST catch. Fired before any bytes land so delay/
-        # error rules hold or fail the put itself.
-        flip = faults.fire("store.write", height=height, pages=page_count)
-        if flip is not None and pages:
-            pages[0] = (flip(pages[0][0]), pages[0][1])
-
         fields = {
             "height": height, "k": original_width,
             "share_size": share_size, "rows_per_page": rows_per_page,
@@ -237,23 +324,51 @@ class BlockStore:
         path = self.root / f"{height}{SUFFIX}"
         tmp = self.root / f"{height}{SUFFIX}.tmp"
         try:
-            with open(tmp, "wb") as f:
-                f.write(_pack_header(fields))
-                f.write(dah_bytes)
-                f.write(levels_bytes)
-                for payload, crc in pages:
-                    f.write(_RECORD.pack(len(payload), crc, 0))
-                    f.write(payload.ljust(page_slot, b"\x00"))
+            # the write drill: corrupt/bitflip rules mangle the first
+            # page payload AFTER its CRC was computed — rot-on-disk
+            # that the next read MUST catch. Fired before any bytes
+            # land, INSIDE the abort scope, so enospc/error strikes
+            # count as aborted puts and clean up like the real thing.
+            # A short_write rule returns a truncator instead: only a
+            # seeded prefix of the file body lands, then the put fails
+            # like a real torn write.
+            truncate = None
+            flip = faults.fire("store.write", height=height,
+                               pages=page_count)
+            if flip is not None and getattr(flip, "short_write", False):
+                truncate = flip
+            elif flip is not None and pages:
+                pages[0] = (flip(pages[0][0]), pages[0][1])
+            parts = [_pack_header(fields), dah_bytes, levels_bytes]
+            for payload, crc in pages:
+                parts.append(_RECORD.pack(len(payload), crc, 0))
+                parts.append(payload.ljust(page_slot, b"\x00"))
+            with self._fs.open_w(tmp, height=height) as f:
+                if truncate is not None:
+                    f.write(truncate(b"".join(parts)))
+                    f.flush()
+                    err = faults.DiskFault(
+                        errno.EIO,
+                        f"short write persisting height {height}")
+                    err.short_write = True
+                    raise err
+                for part in parts:
+                    f.write(part)
                 f.flush()
                 if self.durable:
-                    os.fsync(f.fileno())
-            os.replace(tmp, path)
-        except Exception:
-            with self._index_lock:
-                self._write_errors += 1
-            metrics.incr_counter("store_write_error_total")
-            tmp.unlink(missing_ok=True)
+                    self._fs.fsync(f, path=tmp, height=height)
+            self._fs.replace(tmp, path, height=height)
+            if self.durable:
+                # the rename itself is not crash-durable until the
+                # parent directory's entry is (ADR-026): without this
+                # dirsync an acknowledged height can vanish after
+                # power loss — the bug the powercut explorer finds
+                self._fs.dirsync(self.root, height=height)
+        except Exception as exc:
+            self._abort_put(tmp, exc, height)
             raise
+        if self._read_only:  # this put was the recovery probe, and won
+            self._exit_read_only()
         entry = StoreEntry(path=path, **fields)
         with self._index_lock:
             self._index[height] = entry
@@ -261,6 +376,114 @@ class BlockStore:
         metrics.incr_counter("store_put_total")
         self._publish()
         return entry
+
+    def _abort_put(self, tmp: pathlib.Path, exc: BaseException,
+                   height: int) -> None:
+        """Mid-put failure: classify + count the abort, best-effort
+        unlink the `.tmp` orphan (instead of leaving it for the next
+        reindex), and flip read-only on ENOSPC."""
+        if getattr(exc, "short_write", False):
+            reason = "short_write"
+        elif isinstance(exc, OSError) and exc.errno == errno.ENOSPC:
+            reason = "enospc"
+        else:
+            reason = "error"
+        with self._index_lock:
+            self._write_errors += 1
+            self._put_aborts += 1
+        metrics.incr_counter("store_write_error_total")
+        metrics.incr_counter("store_put_aborted_total", reason=reason)
+        try:
+            self._fs.unlink(tmp, missing_ok=True, height=height)
+        except (OSError, faults.FaultError):
+            pass  # disk too sick to even unlink; reindex ignores .tmp
+        if reason == "enospc":
+            self._enter_read_only("enospc")
+
+    # -- read-only degradation (ENOSPC state machine) ------------------- #
+
+    @property
+    def read_only(self) -> bool:
+        # lint: allow(C005) reason=GIL-atomic bool snapshot for telemetry/readiness; transitions serialize under _index_lock
+        return self._read_only
+
+    @property
+    def read_only_reason(self) -> str | None:
+        # lint: allow(C005) reason=GIL-atomic str reference snapshot; written only inside _index_lock, a stale read mislabels one readiness detail at worst
+        return self._read_only_reason
+
+    def force_read_only(self, reason: str = "operator") -> None:
+        """Operations hook: degrade to read-only WITHOUT an automatic
+        put-side reprobe (recovery needs an explicit `try_recover`) —
+        how a fleet worker models its disk being pulled out from under
+        it (node/fleet.py `readonly` command)."""
+        self._enter_read_only(reason)
+        with self._index_lock:
+            self._reprobe_after = float("inf")
+
+    def try_recover(self) -> bool:
+        """Explicit writability probe — the recovery edge of the
+        read-only state machine. Writes, fsyncs and unlinks a tiny
+        probe file through the same FsShim the put path uses, so
+        injected disk faults at those sites keep the store read-only
+        exactly as a still-full disk would. True = writable now."""
+        if not self._read_only:
+            return True
+        probe = self.root / ".writable.probe"
+        try:
+            with self._fs.open_w(probe) as f:
+                f.write(b"ok")
+                f.flush()
+                if self.durable:
+                    self._fs.fsync(f, path=probe)
+            self._fs.unlink(probe, missing_ok=True)
+        except (OSError, faults.FaultError):
+            with self._index_lock:
+                self._reprobe_after = (time.monotonic()
+                                       + self.reprobe_interval_s)
+            return False
+        self._exit_read_only()
+        return True
+
+    def _enter_read_only(self, reason: str) -> None:
+        with self._index_lock:
+            first = not self._read_only
+            self._read_only = True
+            self._read_only_reason = reason
+            self._reprobe_after = (time.monotonic()
+                                   + self.reprobe_interval_s)
+        metrics.set_gauge("store_read_only", 1.0)
+        if not first:
+            return  # sticky: re-strikes only push the reprobe clock
+        metrics.incr_counter("store_read_only_total")
+        log.warn("store degraded to read-only", reason=reason,
+                 root=str(self.root))
+        self._cleanup_tmp()
+        if self.emergency_compact_bytes:
+            try:  # free what we can so reads keep their hot window
+                self.compact(int(self.emergency_compact_bytes))
+            except (OSError, faults.FaultError):
+                pass
+
+    def _exit_read_only(self) -> None:
+        with self._index_lock:
+            if not self._read_only:
+                return
+            self._read_only = False
+            self._read_only_reason = None
+            self._reprobe_after = 0.0
+        metrics.set_gauge("store_read_only", 0.0)
+        metrics.incr_counter("store_read_only_recovered_total")
+        log.info("store writable again", root=str(self.root))
+
+    def _cleanup_tmp(self) -> None:
+        """Free what a full disk can still give back: abandoned `.tmp`
+        orphans (unlink needs no free space on mainstream filesystems)."""
+        for tmp in self.root.glob(f"*{SUFFIX}.tmp"):
+            try:
+                self._fs.unlink(tmp, missing_ok=True)
+            except (OSError, faults.FaultError):
+                pass
 
     # -- re-index ------------------------------------------------------- #
 
@@ -349,12 +572,22 @@ class BlockStore:
             if entry is None:
                 continue  # lost a race with a concurrent compaction
             try:
-                entry.path.unlink(missing_ok=True)
+                self._fs.unlink(entry.path, missing_ok=True, height=h)
             except OSError:
                 pass  # the index drop already hid the height
             evicted.append(h)
             freed += sizes[h]
             metrics.incr_counter("store_compact_evicted_total")
+        if evicted and self.durable:
+            # make the unlinks crash-durable in one directory sync; a
+            # lost unlink would only resurrect an already-evicted
+            # height after a crash (re-adopted by reindex, re-evicted
+            # by the next compaction), so failure here is a warn, not
+            # an error
+            try:
+                self._fs.dirsync(self.root)
+            except (OSError, faults.FaultError):
+                log.warn("compact dirsync failed", root=str(self.root))
         with self._index_lock:
             self._compactions += 1
             self._evicted += len(evicted)
@@ -539,6 +772,7 @@ class BlockStore:
             page_reads = self._page_reads
             puts = self._puts
             write_errors = self._write_errors
+            put_aborts = self._put_aborts
             compactions = self._compactions
             evicted = self._evicted
             nbytes = sum(e.page_offset(e.page_count)
@@ -553,6 +787,9 @@ class BlockStore:
             "puts": puts,
             "page_reads": page_reads,
             "write_errors": write_errors,
+            "put_aborts": put_aborts,
+            "read_only": self._read_only,
+            "read_only_reason": self._read_only_reason,
             "compactions": compactions,
             "evicted": evicted,
             "reindex_skipped": skipped,
@@ -565,3 +802,5 @@ class BlockStore:
                          for e in self._index.values())
         metrics.set_gauge("store_heights", float(n))
         metrics.set_gauge("store_bytes", float(nbytes))
+        metrics.set_gauge("store_read_only",
+                          1.0 if self._read_only else 0.0)

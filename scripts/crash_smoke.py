@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Crash-consistency smoke gate (specs/store.md §Durability contract,
+ADR-026, `make crash-smoke`).
+
+Sweeps the powercut explorer (celestia_tpu/store/powercut.py) over the
+durable tier and drills the ENOSPC degradation path over the real
+serving stack; fails (non-zero exit) unless:
+
+  1. the full crash-point sweep over a put/compact/re-put/reindex
+     workload — every trace prefix x every page-cache variant
+     (lost / applied / torn) — reports ZERO recovery-invariant
+     violations: acknowledged heights recover byte-identical,
+     unacknowledged heights recover absent-or-quarantined, nothing
+     indexed ever fails to serve, compact never loses a retained
+     height,
+  2. the harness still has TEETH: the same sweep with dirsyncs
+     suppressed (the pre-fix write path) MUST report missing-height
+     violations — a sweep that passes both worlds proves nothing,
+  3. ENOSPC degrades GRACEFULLY over the real node/rpc.py stack: an
+     injected `enospc` at `store.write` flips the store to sticky
+     read-only (gauge + counter + aborted-put accounting + `.tmp`
+     cleanup), /readyz answers 503 naming `store_writable`, reads
+     keep serving 200s the whole time,
+  4. the store RECOVERS: once the fault clears, `try_recover()`
+     restores writability, /readyz flips back to 200, and new heights
+     persist again.
+
+`--inject-no-dirsync` runs gate 1 with dirsyncs suppressed instead:
+the run then FAILS with the missing-height report — the red-path
+self-test proving the explorer finds the bug the dirsync fix fixed.
+
+CPU-only, crypto-free, seconds (budget: well under 120 s).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def fetch(base: str, path: str):
+    req = urllib.request.Request(base + path)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except ValueError:
+            return e.code, {}
+
+
+def gate(ok: bool, what: str) -> None:
+    print(("PASS " if ok else "FAIL ") + what)
+    if not ok:
+        raise SystemExit(f"crash-smoke: {what}")
+
+
+def failing_checks(body: dict) -> set:
+    return {c["name"] for c in body.get("checks", ()) if not c["ok"]}
+
+
+def main() -> int:
+    t0 = time.time()
+    from celestia_tpu import faults
+    from celestia_tpu.store.powercut import explore
+
+    if "--inject-no-dirsync" in sys.argv:
+        # red path: the pre-fix write path MUST fail the sweep
+        rep = explore(no_dirsync=True)
+        print(f"crash-smoke[--inject-no-dirsync]: {rep.effects} effects, "
+              f"{rep.states} crash states, "
+              f"{len(rep.violations)} violations")
+        for v in rep.violations[:5]:
+            print(f"  {v.kind} h={v.height} cut={v.cut} "
+                  f"variant={v.variant}: {v.detail}")
+        print("crash-smoke: FAILING as expected — the un-dirsynced "
+              "rename loses acknowledged heights across power loss")
+        return 1 if rep.violations else 0
+
+    # -- 1: the crash-point sweep over the fixed tree ------------------ #
+    rep = explore()
+    for v in rep.violations[:8]:
+        print(f"  VIOLATION {v.kind} h={v.height} cut={v.cut} "
+              f"variant={v.variant}: {v.detail}")
+    gate(rep.ok,
+         f"powercut sweep clean: {rep.effects} effects, {rep.cuts} cuts, "
+         f"{rep.states} crash states, 0 invariant violations")
+
+    # -- 2: harness sensitivity (the sweep must catch the old bug) ----- #
+    red = explore(no_dirsync=True)
+    gate(any(v.kind == "missing_height" for v in red.violations),
+         f"no-dirsync world caught: {len(red.violations)} violations "
+         "(acknowledged height lost without the parent-dir fsync)")
+
+    # -- 3+4: ENOSPC graceful degradation over the real stack ---------- #
+    from celestia_tpu.node.rpc import RpcServer
+    from celestia_tpu.telemetry import metrics
+    from celestia_tpu.testutil.chaosnet import RpcChaosNode
+
+    root = tempfile.mkdtemp(prefix="crash-smoke-")
+    try:
+        node = RpcChaosNode(heights=2, k=4, seed=7, store_dir=root)
+        node.store.reprobe_interval_s = 0.2  # fast recovery for CI
+        server = RpcServer(node, port=0)
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        status, _body = fetch(base, "/readyz")
+        gate(status == 200, "healthy node starts ready")
+
+        orphan = os.path.join(root, "999.ctps.tmp")
+        with open(orphan, "wb") as f:
+            f.write(b"abandoned by a previous crash")
+        ro0 = metrics.get_counter("store_read_only_total")
+        ab0 = metrics.get_counter("store_put_aborted_total",
+                                  reason="enospc")
+        with faults.inject(faults.rule("store.write", "enospc")):
+            node.grow()  # the put hits the injected full disk
+            gate(node.store.read_only
+                 and node.store.read_only_reason == "enospc",
+                 "injected ENOSPC flips the store to sticky read-only")
+            status, body = fetch(base, "/readyz")
+            gate(status == 503 and failing_checks(body)
+                 == {"store_writable"},
+                 "/readyz answers 503 naming exactly store_writable")
+            status, _dah = fetch(base, "/dah/1")
+            gate(status == 200, "reads still serve while read-only")
+            gate(not os.path.exists(orphan),
+                 "degradation cleaned up the orphaned .tmp")
+            gate(metrics.get_counter("store_read_only_total") == ro0 + 1,
+                 "store_read_only_total counted one degradation")
+            gate(metrics.get_counter("store_put_aborted_total",
+                                     reason="enospc") > ab0,
+                 "aborted put counted with reason=enospc")
+            gate(metrics.get_gauge("store_read_only") == 1.0,
+                 "store_read_only gauge raised")
+        persisted0 = len(node.store)
+        gate(node.store.try_recover(),
+             "try_recover restores writability once space returns")
+        status, _body = fetch(base, "/readyz")
+        gate(status == 200, "/readyz recovers to 200")
+        gate(metrics.get_gauge("store_read_only") == 0.0,
+             "store_read_only gauge cleared")
+        node.grow()
+        gate(len(node.store) > persisted0,
+             "puts land again after recovery")
+        server.stop(drain_timeout=5.0)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    wall = time.time() - t0
+    gate(wall < 120.0, f"crash-smoke finished in {wall:.1f}s (< 120 s)")
+    print("crash-smoke: all gates passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,7 @@ import pytest
 from celestia_tpu.node.fleet import (
     BACKOFF,
     CRASHLOOP,
+    DEGRADED,
     READY,
     FleetSupervisor,
 )
@@ -334,6 +335,69 @@ class TestSupervisorStateMachine:
         assert m.state == READY, ("only process EXIT restarts a member; "
                                   "a failed probe just counts")
 
+    def test_storage_degraded_probe_demotes_without_health_fail(
+            self, tmp_path):
+        """A /readyz 503 failing ONLY store_writable classifies the
+        member DEGRADED (ADR-026): no health-fail accounting, no
+        restart, still ring-resident and probed; a 200 promotes it
+        back to READY."""
+        node, server, url = _backend(tmp_path, name="m0")
+        sup = self._sup(tmp_path)
+        m = self._member(sup)
+        m.state = READY
+        m.url = url
+        fails0 = metrics.get_counter("fleet_health_fail_total")
+        try:
+            node.store.force_read_only("operator")
+            sup._probe(m, time.monotonic())
+            assert m.state == DEGRADED
+            assert m.healthy, "a degraded member still serves reads"
+            assert m.health_fails == 0
+            assert metrics.get_counter(
+                "fleet_health_fail_total") == fails0
+            events = [e["event"] for e in sup.report()["events"]]
+            assert "degraded" in events
+            # still degraded: the repeat probe holds state quietly
+            sup._probe(m, time.monotonic())
+            assert m.state == DEGRADED
+            assert metrics.get_counter(
+                "fleet_health_fail_total") == fails0
+            sup._publish()
+            assert metrics.get_gauge("fleet_members_degraded") == 1.0
+            # store recovers -> /readyz 200 -> promoted back to READY
+            assert node.store.try_recover()
+            sup._probe(m, time.monotonic())
+            assert m.state == READY
+            events = [e["event"] for e in sup.report()["events"]]
+            assert "recovered" in events
+            sup._publish()
+            assert metrics.get_gauge("fleet_members_degraded") == 0.0
+        finally:
+            server.stop(drain_timeout=0.5)
+
+    def test_degraded_member_with_other_failures_counts_fails(
+            self, tmp_path):
+        """Once degraded, anything WORSE than storage (another failing
+        check, a dead socket) is a real failed probe again."""
+        node, server, url = _backend(tmp_path, name="m0")
+        sup = self._sup(tmp_path)
+        m = self._member(sup)
+        m.state = READY
+        m.url = url
+        try:
+            node.store.force_read_only("operator")
+            sup._probe(m, time.monotonic())
+            assert m.state == DEGRADED
+            node.app._tpu_disabled = True  # now sick beyond storage
+            before = metrics.get_counter("fleet_health_fail_total")
+            sup._probe(m, time.monotonic())
+            assert m.state == DEGRADED
+            assert not m.healthy
+            assert metrics.get_counter(
+                "fleet_health_fail_total") == before + 1
+        finally:
+            server.stop(drain_timeout=0.5)
+
 
 class TestStoreCompaction:
     def _grown_store(self, tmp_path, heights=30):
@@ -427,6 +491,68 @@ class TestStoreCompaction:
         for t in threads:
             t.join(timeout=5)
         assert not errors, f"racing reader saw {errors[0]!r}"
+
+
+@pytest.mark.slow
+class TestStorageDegradedMembershipEndToEnd:
+    def _wait_state(self, sup, index, state, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for m in sup.members():
+                if m.index == index and m.state == state:
+                    return True
+            time.sleep(0.05)
+        return False
+
+    def test_readonly_member_stays_serving_and_recovers(self, tmp_path):
+        """The ADR-026 fleet contract over real OS processes: a
+        backend whose store goes read-only is classified degraded —
+        ring-resident and serving reads, excluded from head adoption,
+        never restarted or crash-looped — and rejoins block production
+        at the fleet head once its store recovers."""
+        gw = Gateway([])
+        gw.start()
+        sup = FleetSupervisor(2, tmp_path / "fleet", gateway=gw, k=4,
+                              heights=2, seed=7, chain_id="fleet-ro",
+                              backoff_base_s=0.1)
+        crashloops0 = metrics.get_counter("fleet_crashloop_total")
+        restarts0 = metrics.get_counter("fleet_restart_total")
+        try:
+            sup.start()
+            sup.advance(3)
+            victim = sup.members()[0]
+            assert sup._cmd(victim.proc, "readonly on") == \
+                "OK readonly on"
+            assert self._wait_state(sup, 0, DEGRADED), \
+                sup.member_states()
+            # ring-resident: the member itself still serves its heights
+            status, _ = _get(victim.url + "/dah/3")
+            assert status == 200
+            # the gateway path never 500s while one member is degraded
+            status, _ = _get(gw.url + "/dah/3")
+            assert status == 200
+            # excluded from head adoption: the fleet advances without it
+            sup.advance(5)
+            status, _ = _get(victim.url + "/dah/5")
+            assert status == 404, ("a read-only member must not adopt "
+                                   "new heights")
+            # and none of this looked like a crash to the supervisor
+            assert metrics.get_counter(
+                "fleet_crashloop_total") == crashloops0
+            assert metrics.get_counter(
+                "fleet_restart_total") == restarts0
+            assert victim.restarts == 0
+            # space freed: recovery re-warms the member to the head
+            assert sup._cmd(victim.proc, "readonly off").startswith(
+                "OK readonly off 1")
+            assert self._wait_state(sup, 0, READY), sup.member_states()
+            status, _ = _get(victim.url + "/dah/5")
+            assert status == 200, "recovery must backfill to the head"
+            events = [e["event"] for e in sup.report()["events"]]
+            assert "degraded" in events and "recovered" in events
+        finally:
+            sup.stop()
+            gw.stop()
 
 
 @pytest.mark.slow

@@ -578,7 +578,8 @@ class TestFaultSiteCoverage:
             paged = cache.get(1)
             for i in range(eds.data.shape[0]):
                 paged.row(i)
-        elif site in ("store.write", "store.read"):
+        elif site in ("store.write", "store.read", "store.fsync",
+                      "store.rename", "store.dirsync", "store.unlink"):
             import shutil
             import tempfile
 
@@ -588,11 +589,16 @@ class TestFaultSiteCoverage:
             dah = da.new_data_availability_header(eds)
             root = tempfile.mkdtemp(prefix="site-coverage-")
             try:
-                store = BlockStore(root)
+                # a durable put crosses every write-path syscall site:
+                # open/write, fsync(tmp), rename(tmp -> final),
+                # dirsync(parent); compact's eviction crosses unlink
+                store = BlockStore(root, durable=True)
                 store.put_eds(1, eds.data, eds.original_width,
                               dah_doc=dah.to_json())
                 if site == "store.read":
                     store.read_page(1, 0)
+                elif site == "store.unlink":
+                    store.compact(0, keep_recent=0)
             finally:
                 shutil.rmtree(root, ignore_errors=True)
         elif site == "pipeline.block":
@@ -671,6 +677,10 @@ class TestFaultSiteCoverage:
         "cache.faultin",
         "store.write",
         "store.read",
+        "store.fsync",
+        "store.rename",
+        "store.dirsync",
+        "store.unlink",
         "gateway.route",
         "gateway.hedge",
         "pipeline.block",

@@ -371,8 +371,8 @@ class TestLibrary:
         assert set(SCENARIOS) == {"pfb-storm", "rolling-outage",
                                   "sdc-under-storm", "rejoin-under-load",
                                   "smoke", "gateway-fleet",
-                                  "scale-out-under-load", "soak",
-                                  "das-sweep"}
+                                  "scale-out-under-load", "disk-pressure",
+                                  "soak", "das-sweep"}
 
     @pytest.mark.parametrize("name", sorted(SCENARIOS))
     def test_constructs_and_name_matches(self, name):

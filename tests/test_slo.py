@@ -165,7 +165,8 @@ class TestObjectiveDeclaration:
         names = {o.name for o in default_objectives()}
         assert names == {"sample_availability", "extend_block_p99",
                          "tpu_not_sticky_disabled", "sdc_detected",
-                         "rpc_admission", "store_integrity"}
+                         "rpc_admission", "store_integrity",
+                         "store_writable"}
 
 
 # ---------------------------------------------------------------------- #
